@@ -1,0 +1,27 @@
+"""tensorlink-tpu: a TPU-native distributed deep-learning framework.
+
+A ground-up re-design of the capabilities of tensorlink/tensorlink
+(decentralized model partitioning + pipelined training across recruited
+workers, reference: /root/reference/src) for TPU hardware:
+
+- Data plane: jit-compiled XLA programs on a ``jax.sharding.Mesh`` with axes
+  ``(data, pipe, model, seq)``; stage-to-stage activation exchange is
+  ``jax.lax.ppermute`` over ICI instead of pickled tensors over TCP sockets
+  (reference: src/p2p/torch_node.py:138-162).
+- Control plane: asyncio typed-message overlay (handshake, DHT, job
+  lifecycle, stats) — same protocol concepts as src/p2p/smart_node.py but
+  with msgpack-typed messages and safetensors-style array shipping, never
+  pickle.
+- Roles: User / Worker / Validator (reference: src/roles) re-imagined so a
+  "worker" is a host agent binding TPU chips as schedulable mesh capacity.
+"""
+
+__version__ = "0.1.0"
+
+from tensorlink_tpu.config import (  # noqa: F401
+    MeshConfig,
+    TrainConfig,
+    NodeConfig,
+    FrameworkConfig,
+)
+from tensorlink_tpu.runtime.mesh import MeshRuntime, make_mesh  # noqa: F401

@@ -1,0 +1,150 @@
+"""Typed configuration for the whole framework.
+
+The reference scatters configuration across `.env` keys read at import time,
+a hardcoded contract-ABI path, constructor kwargs, and inline magic constants
+(survey of src/p2p/smart_node.py:20-41, src/p2p/connection.py:39,
+src/ml/distributed.py:16). Here all of it is a single tree of frozen
+dataclasses with no import-time side effects; every subsystem takes its
+config object explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh: axes (data, pipe, model, seq).
+
+    The product of the axis sizes must equal the number of participating
+    devices. ``pipe`` maps to pipeline stages (the TPU-native replacement for
+    the reference's one-worker-per-submodule vertical partitioning,
+    src/ml/distributed.py:305-378), ``data`` to data-parallel replicas
+    (the reference's planned-but-unbuilt dp_factor, src/roles/user.py:161),
+    ``model`` to tensor-parallel shards, ``seq`` to sequence/context
+    parallelism (ring attention).
+    """
+
+    data: int = 1
+    pipe: int = 1
+    model: int = 1
+    seq: int = 1
+
+    AXIS_NAMES = ("data", "pipe", "model", "seq")
+
+    @property
+    def num_devices(self) -> int:
+        return self.data * self.pipe * self.model * self.seq
+
+    @property
+    def shape(self) -> tuple[int, int, int, int]:
+        return (self.data, self.pipe, self.model, self.seq)
+
+    def axis_sizes(self) -> dict[str, int]:
+        return dict(zip(self.AXIS_NAMES, self.shape))
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Training hyperparameters + micro-batching.
+
+    ``micro_batches`` plays the role of the reference's
+    batch_size // micro_batch_size thread count (src/ml/distributed.py:91),
+    but here it is the static length of the pipeline schedule loop.
+    """
+
+    batch_size: int = 32
+    micro_batches: int = 4
+    learning_rate: float = 2e-5
+    weight_decay: float = 0.0
+    optimizer: str = "adamw"  # adam | adamw | sgd
+    warmup_steps: int = 0
+    total_steps: int = 1000
+    schedule: str = "constant"  # constant | linear | cosine
+    grad_clip_norm: float | None = 1.0
+    seed: int = 0
+    dtype: str = "bfloat16"  # compute dtype; params stay f32
+    remat: bool = False  # jax.checkpoint each stage/block
+
+    @property
+    def micro_batch_size(self) -> int:
+        if self.batch_size % self.micro_batches:
+            raise ValueError(
+                f"batch_size={self.batch_size} not divisible by "
+                f"micro_batches={self.micro_batches}"
+            )
+        return self.batch_size // self.micro_batches
+
+
+@dataclass(frozen=True)
+class NodeConfig:
+    """Control-plane node identity + transport settings.
+
+    Replaces the reference's SmartNode ctor kwargs + BASE_PORT scanning
+    (src/p2p/smart_node.py:41,103-112,949-967).
+    """
+
+    role: str = "worker"  # user | worker | validator
+    host: str = "127.0.0.1"
+    port: int = 0  # 0 = OS-assigned
+    base_port: int = 38751
+    max_connections: int = 64
+    handshake_timeout_s: float = 10.0
+    request_timeout_s: float = 5.0
+    dht_replication: int = 3
+    dht_buckets: int = 256
+    heartbeat_interval_s: float = 2.0
+    heartbeat_miss_limit: int = 3
+    compression: str = "zstd"  # none | zlib | zstd
+    compression_min_bytes: int = 4096
+    off_chain: bool = True  # in-memory Registry instead of web3
+    key_dir: str | None = None  # None = ephemeral in-memory identity
+    http_status_port: int | None = None  # aiohttp status endpoint
+
+
+@dataclass(frozen=True)
+class FrameworkConfig:
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    node: NodeConfig = field(default_factory=NodeConfig)
+
+    # ------------------------------------------------------------------
+    # (De)serialization — configs travel inside job records on the wire.
+    # ------------------------------------------------------------------
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "FrameworkConfig":
+        return cls(
+            mesh=MeshConfig(**d.get("mesh", {})),
+            train=TrainConfig(**d.get("train", {})),
+            node=NodeConfig(**d.get("node", {})),
+        )
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, s: str) -> "FrameworkConfig":
+        return cls.from_dict(json.loads(s))
+
+    def replace(self, **kw: Any) -> "FrameworkConfig":
+        return dataclasses.replace(self, **kw)
+
+
+def config_from_env(env: Mapping[str, str] | None = None) -> FrameworkConfig:
+    """Optional env-var overrides (explicit, never at import time)."""
+    env = dict(os.environ if env is None else env)
+    mesh = MeshConfig(
+        data=int(env.get("TLTPU_MESH_DATA", 1)),
+        pipe=int(env.get("TLTPU_MESH_PIPE", 1)),
+        model=int(env.get("TLTPU_MESH_MODEL", 1)),
+        seq=int(env.get("TLTPU_MESH_SEQ", 1)),
+    )
+    return FrameworkConfig(mesh=mesh)

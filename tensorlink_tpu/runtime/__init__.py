@@ -1,0 +1,2 @@
+from tensorlink_tpu.runtime.mesh import MeshRuntime, make_mesh  # noqa: F401
+from tensorlink_tpu.runtime.metrics import Metrics, StepTimer  # noqa: F401

@@ -1,0 +1,102 @@
+"""Device mesh management.
+
+The reference's notion of capacity is a per-process `get_gpu_memory()` poll
+(src/p2p/torch_node.py:27, src/ml/model_analyzer.py:10-27) and placement is
+one worker socket per offloaded submodule. Here capacity is a set of TPU
+devices arranged into one logical `jax.sharding.Mesh`; placement means
+assigning pipeline stages / shards to mesh coordinates, and XLA inserts the
+ICI collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensorlink_tpu.config import MeshConfig
+
+
+def make_mesh(cfg: MeshConfig, devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build the global mesh with axes (data, pipe, model, seq).
+
+    Axis order puts ``model`` and ``seq`` innermost so tensor/sequence
+    collectives (the highest-bandwidth traffic) ride adjacent-device ICI
+    links, while ``data`` (lowest-frequency traffic: one allreduce per step)
+    is outermost and may span DCN on multi-host topologies.
+    """
+    devices = list(jax.devices() if devices is None else devices)
+    if cfg.num_devices > len(devices):
+        raise ValueError(
+            f"mesh {cfg.shape} needs {cfg.num_devices} devices, "
+            f"have {len(devices)}"
+        )
+    grid = np.array(devices[: cfg.num_devices]).reshape(cfg.shape)
+    return Mesh(grid, MeshConfig.AXIS_NAMES)
+
+
+@dataclasses.dataclass
+class MeshRuntime:
+    """Owns the mesh + common shardings for one job."""
+
+    cfg: MeshConfig
+    mesh: Mesh
+
+    @classmethod
+    def create(
+        cls, cfg: MeshConfig | None = None, devices: Sequence[jax.Device] | None = None
+    ) -> "MeshRuntime":
+        cfg = cfg or MeshConfig(data=len(devices or jax.devices()))
+        return cls(cfg=cfg, mesh=make_mesh(cfg, devices))
+
+    # Common shardings --------------------------------------------------
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def batch_sharded(self) -> NamedSharding:
+        """Batch dim over (data,); used for inputs."""
+        return NamedSharding(self.mesh, P(("data",)))
+
+    def shard_batch(self, batch):
+        return jax.device_put(batch, self.batch_sharded)
+
+    def replicate(self, tree):
+        return jax.device_put(tree, self.replicated)
+
+    # Introspection -----------------------------------------------------
+    def describe(self) -> dict:
+        return {
+            "axes": self.cfg.axis_sizes(),
+            "num_devices": self.cfg.num_devices,
+            "device_kinds": sorted({d.device_kind for d in self.mesh.devices.flat}),
+        }
+
+
+def local_device_info() -> list[dict]:
+    """Per-device capacity info, the TPU analogue of the reference's
+    get_gpu_memory worker self-report (src/roles/worker.py:363-381)."""
+    out = []
+    for d in jax.devices():
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            pass
+        out.append(
+            {
+                "id": d.id,
+                "platform": d.platform,
+                "device_kind": d.device_kind,
+                "bytes_limit": stats.get("bytes_limit"),
+                "bytes_in_use": stats.get("bytes_in_use"),
+            }
+        )
+    return out

@@ -1,0 +1,95 @@
+"""Step metrics + timing.
+
+The reference's observability is per-peer message counters and debug prints
+(src/p2p/smart_node.py:855-876). Here: structured per-step metrics — loss,
+samples/sec/chip, pipeline-bubble %, step latency — the BASELINE.json
+metric set — plus a lightweight rolling aggregator a node can publish over
+its HTTP status endpoint.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Deque
+
+
+class StepTimer:
+    """Wall-clock step timer with warmup discard."""
+
+    def __init__(self, warmup: int = 2):
+        self.warmup = warmup
+        self.times: list[float] = []
+        self._t0: float | None = None
+        self._steps = 0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        self._steps += 1
+        if self._steps > self.warmup:
+            self.times.append(dt)
+
+    @property
+    def mean_s(self) -> float:
+        return sum(self.times) / len(self.times) if self.times else math.nan
+
+    @property
+    def p50_s(self) -> float:
+        if not self.times:
+            return math.nan
+        s = sorted(self.times)
+        return s[len(s) // 2]
+
+
+def pipeline_bubble_fraction(num_stages: int, num_micro: int) -> float:
+    """Ideal GPipe bubble fraction (S-1)/(M+S-1).
+
+    The reference never scheduled its pipeline (ordering emerged from thread
+    timing + a 0.5 s stagger, src/ml/distributed.py:107); here the schedule
+    is explicit so the bubble is a closed-form, reportable quantity.
+    """
+    s, m = num_stages, num_micro
+    return (s - 1) / (m + s - 1) if s > 1 else 0.0
+
+
+@dataclass
+class Metrics:
+    """Rolling metrics registry. json-serializable snapshots."""
+
+    window: int = 100
+    series: dict[str, Deque[float]] = field(default_factory=dict)
+    counters: collections.Counter = field(default_factory=collections.Counter)
+
+    def observe(self, name: str, value: float) -> None:
+        q = self.series.setdefault(name, collections.deque(maxlen=self.window))
+        q.append(float(value))
+
+    def incr(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+
+    def snapshot(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"counters": dict(self.counters)}
+        for name, q in self.series.items():
+            if q:
+                vals = list(q)
+                out[name] = {
+                    "last": vals[-1],
+                    "mean": sum(vals) / len(vals),
+                    "n": len(vals),
+                }
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+
+def throughput(samples: int, seconds: float, chips: int = 1) -> float:
+    """samples/sec/chip — headline metric per BASELINE.json."""
+    return samples / seconds / max(chips, 1) if seconds > 0 else math.nan

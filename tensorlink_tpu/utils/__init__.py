@@ -1,0 +1,7 @@
+from tensorlink_tpu.utils.logging import get_logger  # noqa: F401
+from tensorlink_tpu.utils.trees import (  # noqa: F401
+    tree_bytes,
+    tree_size,
+    global_norm,
+    tree_cast,
+)

@@ -1,0 +1,46 @@
+"""Structured logging.
+
+Replaces the reference's root-logger file handler configured at import time
+plus ANSI debug_print (src/p2p/smart_node.py:32-39,286-292) with namespaced
+loggers configured on first use, JSON-formatted records optional.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+
+_CONFIGURED = False
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": time.time(),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+def get_logger(name: str, json_format: bool = False, level: int = logging.INFO):
+    global _CONFIGURED
+    logger = logging.getLogger(f"tensorlink_tpu.{name}")
+    if not _CONFIGURED:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            JsonFormatter()
+            if json_format
+            else logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+        root = logging.getLogger("tensorlink_tpu")
+        root.addHandler(handler)
+        root.setLevel(level)
+        root.propagate = False
+        _CONFIGURED = True
+    return logger

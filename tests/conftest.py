@@ -1,0 +1,42 @@
+"""Test harness: N logical devices in one process.
+
+The reference's only multi-node story was N threading.Thread role instances
+over localhost sockets (tests/ml/test_job.py:38-46). The TPU-native analogue
+is an 8-device virtual CPU mesh so DP/PP/TP/SP paths run hermetically.
+Must set XLA flags before jax initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell may export axon/tpu
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+# A sitecustomize may have registered/initialized a TPU backend before this
+# conftest ran; re-point jax at the 8-device virtual CPU platform.
+jax.config.update("jax_platforms", "cpu")
+if jax.devices()[0].platform != "cpu" or len(jax.devices()) < 8:
+    import jax.extend.backend as _jeb
+
+    _jeb.clear_backends()
+
+jax.config.update("jax_threefry_partitionable", True)
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,74 @@
+"""Core runtime: config, mesh, metrics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.config import FrameworkConfig, MeshConfig, TrainConfig
+from tensorlink_tpu.runtime.mesh import MeshRuntime, make_mesh, local_device_info
+from tensorlink_tpu.runtime.metrics import (
+    Metrics,
+    StepTimer,
+    pipeline_bubble_fraction,
+    throughput,
+)
+
+
+def test_config_roundtrip():
+    cfg = FrameworkConfig(
+        mesh=MeshConfig(data=2, pipe=4), train=TrainConfig(batch_size=16)
+    )
+    assert FrameworkConfig.from_json(cfg.to_json()) == cfg
+
+
+def test_micro_batch_size_validation():
+    with pytest.raises(ValueError):
+        TrainConfig(batch_size=10, micro_batches=3).micro_batch_size
+    assert TrainConfig(batch_size=12, micro_batches=3).micro_batch_size == 4
+
+
+def test_mesh_shapes(devices):
+    mesh = make_mesh(MeshConfig(data=2, pipe=2, model=2))
+    assert mesh.shape == {"data": 2, "pipe": 2, "model": 2, "seq": 1}
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(data=16))
+
+
+def test_mesh_runtime_shard_batch(devices):
+    rt = MeshRuntime.create(MeshConfig(data=8))
+    x = jnp.arange(32.0).reshape(16, 2)
+    xs = rt.shard_batch(x)
+    assert xs.sharding.spec == jax.sharding.PartitionSpec(("data",))
+    np.testing.assert_allclose(np.asarray(xs), np.asarray(x))
+    assert rt.describe()["num_devices"] == 8
+
+
+def test_local_device_info():
+    info = local_device_info()
+    assert len(info) >= 1 and "platform" in info[0]
+
+
+def test_bubble_fraction():
+    assert pipeline_bubble_fraction(1, 8) == 0.0
+    assert pipeline_bubble_fraction(4, 4) == pytest.approx(3 / 7)
+    assert pipeline_bubble_fraction(4, 32) < 0.1
+
+
+def test_metrics_snapshot():
+    m = Metrics()
+    for i in range(5):
+        m.observe("loss", 1.0 / (i + 1))
+    m.incr("steps", 5)
+    snap = m.snapshot()
+    assert snap["counters"]["steps"] == 5
+    assert snap["loss"]["n"] == 5
+    assert throughput(100, 2.0, 4) == 12.5
+
+
+def test_step_timer():
+    t = StepTimer(warmup=1)
+    for _ in range(3):
+        with t:
+            pass
+    assert len(t.times) == 2 and t.mean_s >= 0
