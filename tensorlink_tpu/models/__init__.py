@@ -1,0 +1,1 @@
+from tensorlink_tpu.models.mlp import MLP, MLPConfig  # noqa: F401

@@ -1,0 +1,48 @@
+"""MLP classifier — the minimum end-to-end model (BASELINE.json config[0]:
+2-layer MLP on MNIST, matching the reference's smallest implied workload)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+
+from tensorlink_tpu.nn.module import Module, Sequential, Lambda
+from tensorlink_tpu.nn.layers import Dense
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden_dim: int = 256
+    out_dim: int = 10
+    num_layers: int = 2
+    activation: str = "relu"
+
+
+class MLP(Module):
+    """Sequential stack so the pipeline partitioner can slice it into
+    stages like any transformer."""
+
+    def __init__(self, cfg: MLPConfig = MLPConfig()):
+        super().__init__()
+        self.cfg_obj = cfg
+        act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[cfg.activation]
+        layers: list[Module] = []
+        dims = (
+            [cfg.in_dim]
+            + [cfg.hidden_dim] * (cfg.num_layers - 1)
+            + [cfg.out_dim]
+        )
+        for i in range(cfg.num_layers):
+            layers.append(Dense(dims[i], dims[i + 1]))
+            if i < cfg.num_layers - 1:
+                layers.append(Lambda(act, name=cfg.activation))
+        self.child("seq", Sequential(layers))
+
+    @property
+    def seq(self) -> Sequential:
+        return self.children["seq"]  # type: ignore[return-value]
+
+    def apply(self, params, x, **kw):
+        return self.seq.apply(params["seq"], x)

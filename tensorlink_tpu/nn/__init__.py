@@ -1,0 +1,14 @@
+from tensorlink_tpu.nn.module import Module, Sequential, init_module  # noqa: F401
+from tensorlink_tpu.nn.layers import (  # noqa: F401
+    Dense,
+    Embedding,
+    LayerNorm,
+    RMSNorm,
+    Dropout,
+)
+from tensorlink_tpu.nn.attention import MultiHeadAttention, dot_product_attention  # noqa: F401
+from tensorlink_tpu.nn.transformer import (  # noqa: F401
+    FeedForward,
+    TransformerBlock,
+    TransformerStack,
+)

@@ -1,0 +1,158 @@
+"""Attention: reference jnp implementation + multi-head module.
+
+Supports causal masking, padding masks, RoPE, grouped-query attention, and
+incremental decoding with a KV cache. The inner kernel is pluggable so the
+Pallas flash-attention kernel (ops/pallas/flash_attention.py) and ring
+attention (parallel/sp.py) can drop in without touching module code.
+
+Tensor-parallel layout is standard Megatron: q/k/v projections column-split
+(heads spread over the `model` axis), output projection row-split, so one
+psum per attention block.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tensorlink_tpu.nn.module import Module
+from tensorlink_tpu.nn.layers import Dense
+
+
+def dot_product_attention(
+    q: jax.Array,  # [B, Tq, H, D]
+    k: jax.Array,  # [B, Tk, Hkv, D]
+    v: jax.Array,  # [B, Tk, Hkv, D]
+    *,
+    causal: bool = False,
+    mask: jax.Array | None = None,  # [B, 1|H, Tq, Tk] bool, True=attend
+    bias: jax.Array | None = None,
+    q_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Reference attention, f32 softmax. ``q_offset`` shifts query positions
+    for causal masking during incremental decode (cache len Tk > Tq)."""
+    B, Tq, H, D = q.shape
+    Hkv = k.shape[2]
+    if Hkv != H:  # grouped-query: repeat kv heads
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = D ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        Tk = k.shape[1]
+        qpos = jnp.arange(Tq)[:, None] + q_offset
+        kpos = jnp.arange(Tk)[None, :]
+        causal_mask = qpos >= kpos
+        logits = jnp.where(causal_mask[None, None], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float = 10000.0) -> jax.Array:
+    """Rotary position embedding over the last dim. x: [B, T, H, D]."""
+    D = x.shape[-1]
+    half = D // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B?, T, half]
+    # broadcast to [B, T, 1, half]
+    while angles.ndim < x.ndim:
+        angles = angles[..., None, :] if angles.ndim == x.ndim - 1 else angles[None]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class MultiHeadAttention(Module):
+    def __init__(
+        self,
+        dim: int,
+        num_heads: int,
+        num_kv_heads: int | None = None,
+        head_dim: int | None = None,
+        use_bias: bool = True,
+        rope: bool = False,
+        rope_theta: float = 10000.0,
+        causal: bool = False,
+        attn_impl: Callable = dot_product_attention,
+    ):
+        super().__init__()
+        self.dim = dim
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads or num_heads
+        self.head_dim = head_dim or dim // num_heads
+        self.use_bias = use_bias
+        self.rope = rope
+        self.rope_theta = rope_theta
+        self.causal = causal
+        self._attn = attn_impl
+        qdim = self.num_heads * self.head_dim
+        kvdim = self.num_kv_heads * self.head_dim
+        self.child("q", Dense(dim, qdim, use_bias=use_bias, shard="col"))
+        self.child("k", Dense(dim, kvdim, use_bias=use_bias, shard="col"))
+        self.child("v", Dense(dim, kvdim, use_bias=use_bias, shard="col"))
+        self.child("o", Dense(qdim, dim, use_bias=use_bias, shard="row"))
+
+    def apply(
+        self,
+        params,
+        x,
+        *,
+        mask=None,
+        cache=None,  # {"k": [B,Tmax,Hkv,D], "v": ..., "index": int32}
+        positions=None,
+        **kw,
+    ):
+        B, T, _ = x.shape
+        q = self.children["q"].apply(params["q"], x).reshape(B, T, self.num_heads, self.head_dim)
+        k = self.children["k"].apply(params["k"], x).reshape(B, T, self.num_kv_heads, self.head_dim)
+        v = self.children["v"].apply(params["v"], x).reshape(B, T, self.num_kv_heads, self.head_dim)
+
+        q_offset = 0
+        if cache is not None:
+            q_offset = cache["index"]
+            if positions is None:  # caller-supplied positions win (padded decode)
+                positions = cache["index"] + jnp.arange(T)[None, :]
+        elif positions is None:
+            positions = jnp.arange(T)[None, :]
+
+        if self.rope:
+            q = apply_rope(q, positions, self.rope_theta)
+            k = apply_rope(k, positions, self.rope_theta)
+
+        new_cache = None
+        if cache is not None:
+            ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k.astype(cache["k"].dtype), cache["index"], axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v.astype(cache["v"].dtype), cache["index"], axis=1)
+            k, v = ck, cv
+            new_cache = {"k": ck, "v": cv, "index": cache["index"] + T}
+            # mask out cache positions beyond what's been written
+            Tk = ck.shape[1]
+            valid = jnp.arange(Tk)[None, None, None, :] < (cache["index"] + T)
+            mask = valid if mask is None else jnp.logical_and(mask, valid)
+
+        out = self._attn(
+            q, k.astype(q.dtype), v.astype(q.dtype),
+            causal=self.causal, mask=mask, q_offset=q_offset,
+        )
+        out = out.reshape(B, T, self.num_heads * self.head_dim)
+        out = self.children["o"].apply(params["o"], out)
+        if cache is not None:
+            return out, new_cache
+        return out
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16):
+        shape = (batch, max_len, self.num_kv_heads, self.head_dim)
+        return {
+            "k": jnp.zeros(shape, dtype),
+            "v": jnp.zeros(shape, dtype),
+            "index": jnp.zeros((), jnp.int32),
+        }

@@ -1,0 +1,177 @@
+"""Core layers.
+
+Each layer owns its initializer, forward math, and tensor-parallel
+PartitionSpec. Compute favors the MXU: Dense keeps a single large matmul;
+norms/activations are elementwise (XLA fuses them into neighbors).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tensorlink_tpu.nn.module import Module
+
+
+def _lecun_normal(key, shape, dtype=jnp.float32, fan_in=None):
+    fan_in = fan_in if fan_in is not None else shape[0]
+    return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / fan_in)
+
+
+def _normal(key, shape, stddev=0.02, dtype=jnp.float32):
+    return jax.random.normal(key, shape, dtype) * stddev
+
+
+class Dense(Module):
+    """y = x @ W + b.
+
+    ``shard``: tensor-parallel role —
+      - "col": W split on output dim  -> P(None, model_axis)   (Megatron column)
+      - "row": W split on input dim   -> P(model_axis, None)   (Megatron row)
+      - None:  replicated.
+    """
+
+    def __init__(
+        self,
+        in_dim: int,
+        out_dim: int,
+        use_bias: bool = True,
+        shard: str | None = None,
+        init: str = "lecun",
+    ):
+        super().__init__()
+        self.in_dim = in_dim
+        self.out_dim = out_dim
+        self.use_bias = use_bias
+        self.shard = shard
+        self.init_scheme = init
+
+    def init(self, key):
+        wkey, _ = jax.random.split(key)
+        if self.init_scheme == "normal":
+            w = _normal(wkey, (self.in_dim, self.out_dim))
+        else:
+            w = _lecun_normal(wkey, (self.in_dim, self.out_dim))
+        params = {"w": w}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.out_dim,))
+        return params
+
+    def param_spec(self, model_axis: str = "model"):
+        if self.shard == "col":
+            spec = {"w": P(None, model_axis)}
+            if self.use_bias:
+                spec["b"] = P(model_axis)
+        elif self.shard == "row":
+            spec = {"w": P(model_axis, None)}
+            if self.use_bias:
+                spec["b"] = P()
+        else:
+            spec = {"w": P()}
+            if self.use_bias:
+                spec["b"] = P()
+        return spec
+
+    def apply(self, params, x, **_):
+        y = x @ params["w"].astype(x.dtype)
+        if self.use_bias:
+            y = y + params["b"].astype(x.dtype)
+        return y
+
+
+class Embedding(Module):
+    """Token embedding; ``attend`` reuses the table as the LM head
+    (weight tying)."""
+
+    def __init__(self, vocab_size: int, dim: int, shard: str | None = None):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.dim = dim
+        self.shard = shard
+
+    def init(self, key):
+        return {"table": _normal(key, (self.vocab_size, self.dim))}
+
+    def param_spec(self, model_axis: str = "model"):
+        # Vocab-sharded: big table, gather stays local-ish under XLA SPMD.
+        return {"table": P(model_axis, None) if self.shard else P()}
+
+    def apply(self, params, ids, **_):
+        return params["table"][ids]
+
+    def attend(self, params, x):
+        return x @ params["table"].astype(x.dtype).T
+
+
+class LayerNorm(Module):
+    def __init__(self, dim: int, eps: float = 1e-6, use_bias: bool = True):
+        super().__init__()
+        self.dim = dim
+        self.eps = eps
+        self.use_bias = use_bias
+
+    def init(self, key):
+        p = {"scale": jnp.ones((self.dim,))}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((self.dim,))
+        return p
+
+    def param_spec(self, model_axis: str = "model"):
+        p = {"scale": P()}
+        if self.use_bias:
+            p["bias"] = P()
+        return p
+
+    def apply(self, params, x, **_):
+        # Normalize in f32 for stability, cast back to compute dtype.
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + self.eps)
+        y = y * params["scale"]
+        if self.use_bias:
+            y = y + params["bias"]
+        return y.astype(x.dtype)
+
+
+class RMSNorm(Module):
+    def __init__(self, dim: int, eps: float = 1e-6):
+        super().__init__()
+        self.dim = dim
+        self.eps = eps
+
+    def init(self, key):
+        return {"scale": jnp.ones((self.dim,))}
+
+    def param_spec(self, model_axis: str = "model"):
+        return {"scale": P()}
+
+    def apply(self, params, x, **_):
+        xf = x.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+        y = xf * jax.lax.rsqrt(ms + self.eps) * params["scale"]
+        return y.astype(x.dtype)
+
+
+class Dropout(Module):
+    """Explicit-rng dropout; no-op unless train=True and rng given."""
+
+    def __init__(self, rate: float):
+        super().__init__()
+        self.rate = rate
+
+    def init(self, key):
+        return {}
+
+    def param_spec(self, model_axis: str = "model"):
+        return {}
+
+    def apply(self, params, x, *, rng=None, train: bool = False, **_):
+        if not train or self.rate == 0.0 or rng is None:
+            return x
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)

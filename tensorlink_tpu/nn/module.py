@@ -1,0 +1,154 @@
+"""Minimal functional module system.
+
+Design goals (vs the reference, which ships whole pickled ``nn.Module``
+objects to workers — src/p2p/torch_node.py:159-162):
+
+- A module is a *description* (hyperparameters only, hashable/serializable);
+  parameters are a separate pytree. This is what makes spec-shipping (send
+  the description + raw weight arrays, never code) possible, and is the
+  natural fit for jax transforms: ``apply`` is a pure function of
+  ``(params, inputs)``.
+- Every module can report a ``param_spec`` pytree of
+  ``jax.sharding.PartitionSpec`` mirroring its params, so tensor-parallel
+  placement is declared where the shapes are known instead of being patched
+  in afterwards.
+
+API:
+    m = Dense(128, 256, shard="col")
+    params = m.init(jax.random.key(0))
+    y = m.apply(params, x)
+    specs = m.param_spec(model_axis="model")
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class Module:
+    """Base class. Subclasses set hyperparams in __init__ and implement
+    ``init(key) -> params`` and ``apply(params, *args, **kw)``.
+
+    Composite modules register children with ``self.child(name, module)``;
+    ``init``/``param_spec`` then recurse automatically for registered
+    children (a subclass may still override to add its own leaves).
+    """
+
+    def __init__(self) -> None:
+        self._children: dict[str, "Module"] = {}
+
+    # -- composition ----------------------------------------------------
+    def child(self, name: str, module: "Module") -> "Module":
+        self._children[name] = module
+        return module
+
+    @property
+    def children(self) -> Mapping[str, "Module"]:
+        return self._children
+
+    # -- parameters -----------------------------------------------------
+    def init(self, key: jax.Array) -> dict[str, Any]:
+        """Default: recurse into children."""
+        params: dict[str, Any] = {}
+        keys = jax.random.split(key, max(len(self._children), 1))
+        for k, (name, mod) in zip(keys, self._children.items()):
+            params[name] = mod.init(k)
+        return params
+
+    def apply(self, params, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, params, *args, **kwargs):
+        return self.apply(params, *args, **kwargs)
+
+    # -- sharding -------------------------------------------------------
+    def param_spec(self, model_axis: str = "model") -> dict[str, Any]:
+        """PartitionSpec pytree mirroring ``init``'s output. Default:
+        children recurse; leaf modules override."""
+        return {
+            name: mod.param_spec(model_axis) for name, mod in self._children.items()
+        }
+
+    # -- introspection --------------------------------------------------
+    def config(self) -> dict[str, Any]:
+        """Serializable hyperparameter description (for spec shipping)."""
+        out = {
+            k: v
+            for k, v in self.__dict__.items()
+            if not k.startswith("_") and isinstance(v, (int, float, str, bool, tuple, type(None)))
+        }
+        out["__type__"] = type(self).__name__
+        if self._children:
+            out["__children__"] = {n: m.config() for n, m in self._children.items()}
+        return out
+
+
+class Sequential(Module):
+    """Chain of modules; params keyed "0", "1", ... Stage partitioning for
+    pipeline parallelism slices this list (the TPU-native analogue of the
+    reference's module-tree walk in src/ml/distributed.py:305-378)."""
+
+    def __init__(self, layers: Sequence[Module]):
+        super().__init__()
+        self.layers = list(layers)
+        for i, l in enumerate(self.layers):
+            self.child(str(i), l)
+
+    def apply(self, params, x, **kwargs):
+        for i, layer in enumerate(self.layers):
+            x = layer.apply(params[str(i)], x, **kwargs)
+        return x
+
+    def __getitem__(self, idx) -> Module | "Sequential":
+        if isinstance(idx, slice):
+            return Sequential(self.layers[idx])
+        return self.layers[idx]
+
+    def __len__(self) -> int:
+        return len(self.layers)
+
+
+class Lambda(Module):
+    """Stateless function as a module (activations, reshapes)."""
+
+    def __init__(self, fn: Callable, name: str = "lambda"):
+        super().__init__()
+        self.name = name
+        self._fn = fn
+
+    def init(self, key):
+        return {}
+
+    def param_spec(self, model_axis: str = "model"):
+        return {}
+
+    def apply(self, params, x, **kwargs):
+        return self._fn(x)
+
+
+def init_module(module: Module, key: jax.Array, dtype=jnp.float32):
+    """Init + optional cast of floating leaves."""
+    params = module.init(key)
+    if dtype != jnp.float32:
+        params = jax.tree.map(
+            lambda x: x.astype(dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            params,
+        )
+    return params
+
+
+def spec_tree_to_shardings(spec_tree, mesh):
+    """PartitionSpec pytree -> NamedSharding pytree."""
+    from jax.sharding import NamedSharding
+
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P),
+    )

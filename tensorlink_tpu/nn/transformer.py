@@ -1,0 +1,171 @@
+"""Transformer building blocks shared by BERT / GPT-2 / ViT / Llama.
+
+A `TransformerStack` is a `Sequential` of homogeneous blocks — which is
+exactly what the pipeline partitioner slices into stages (the reference
+instead walked arbitrary nn.Module trees and shipped whatever subtree fit,
+src/roles/user.py:316-425)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from tensorlink_tpu.nn.module import Module, Sequential
+from tensorlink_tpu.nn.layers import Dense, Dropout, LayerNorm, RMSNorm
+from tensorlink_tpu.nn.attention import MultiHeadAttention
+
+
+ACTIVATIONS = {
+    "gelu": jax.nn.gelu,
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+}
+
+
+class FeedForward(Module):
+    """MLP block; ``gated=True`` gives the SwiGLU variant (Llama)."""
+
+    def __init__(
+        self,
+        dim: int,
+        hidden_dim: int,
+        activation: str = "gelu",
+        use_bias: bool = True,
+        gated: bool = False,
+        dropout: float = 0.0,
+    ):
+        super().__init__()
+        self.dim = dim
+        self.hidden_dim = hidden_dim
+        self.activation = activation
+        self.gated = gated
+        self.child("up", Dense(dim, hidden_dim, use_bias=use_bias, shard="col"))
+        if gated:
+            self.child("gate", Dense(dim, hidden_dim, use_bias=use_bias, shard="col"))
+        self.child("down", Dense(hidden_dim, dim, use_bias=use_bias, shard="row"))
+        self.child("drop", Dropout(dropout))
+
+    def apply(self, params, x, *, rng=None, train=False, **_):
+        act = ACTIVATIONS[self.activation]
+        h = self.children["up"].apply(params["up"], x)
+        if self.gated:
+            h = act(self.children["gate"].apply(params["gate"], x)) * h
+        else:
+            h = act(h)
+        h = self.children["drop"].apply(params["drop"], h, rng=rng, train=train)
+        return self.children["down"].apply(params["down"], h)
+
+
+class TransformerBlock(Module):
+    """One attention + MLP block.
+
+    ``norm_style``: "pre" (GPT-2/ViT/Llama) or "post" (BERT).
+    ``norm``: "layer" or "rms".
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        num_heads: int,
+        hidden_dim: int | None = None,
+        num_kv_heads: int | None = None,
+        norm_style: str = "pre",
+        norm: str = "layer",
+        activation: str = "gelu",
+        use_bias: bool = True,
+        gated_mlp: bool = False,
+        causal: bool = False,
+        rope: bool = False,
+        rope_theta: float = 10000.0,
+        dropout: float = 0.0,
+    ):
+        super().__init__()
+        self.dim = dim
+        self.norm_style = norm_style
+        hidden_dim = hidden_dim or 4 * dim
+        norm_cls = RMSNorm if norm == "rms" else LayerNorm
+        self.child("norm1", norm_cls(dim))
+        self.child("norm2", norm_cls(dim))
+        self.child(
+            "attn",
+            MultiHeadAttention(
+                dim,
+                num_heads,
+                num_kv_heads=num_kv_heads,
+                use_bias=use_bias,
+                causal=causal,
+                rope=rope,
+                rope_theta=rope_theta,
+            ),
+        )
+        self.child(
+            "mlp",
+            FeedForward(
+                dim,
+                hidden_dim,
+                activation=activation,
+                use_bias=use_bias,
+                gated=gated_mlp,
+                dropout=dropout,
+            ),
+        )
+        self.child("drop", Dropout(dropout))
+
+    def apply(self, params, x, *, mask=None, cache=None, rng=None, train=False, **_):
+        attn = self.children["attn"]
+        mlp = self.children["mlp"]
+        n1, n2 = self.children["norm1"], self.children["norm2"]
+        drop = self.children["drop"]
+        r1, r2, r3 = (
+            jax.random.split(rng, 3) if rng is not None else (None, None, None)
+        )
+
+        new_cache = None
+        if self.norm_style == "pre":
+            h = n1.apply(params["norm1"], x)
+            a = attn.apply(params["attn"], h, mask=mask, cache=cache)
+            if cache is not None:
+                a, new_cache = a
+            x = x + drop.apply(params["drop"], a, rng=r1, train=train)
+            h = n2.apply(params["norm2"], x)
+            m = mlp.apply(params["mlp"], h, rng=r2, train=train)
+            x = x + drop.apply(params["drop"], m, rng=r3, train=train)
+        else:  # post-LN (BERT)
+            a = attn.apply(params["attn"], x, mask=mask, cache=cache)
+            if cache is not None:
+                a, new_cache = a
+            x = n1.apply(params["norm1"], x + drop.apply(params["drop"], a, rng=r1, train=train))
+            m = mlp.apply(params["mlp"], x, rng=r2, train=train)
+            x = n2.apply(params["norm2"], x + drop.apply(params["drop"], m, rng=r3, train=train))
+        if cache is not None:
+            return x, new_cache
+        return x
+
+
+class TransformerStack(Module):
+    """N homogeneous blocks. params: {"0": block0, ...}."""
+
+    def __init__(self, num_layers: int, make_block, **block_kw):
+        super().__init__()
+        self.num_layers = num_layers
+        for i in range(num_layers):
+            self.child(str(i), make_block(**block_kw))
+
+    def apply(self, params, x, *, mask=None, caches=None, rng=None, train=False, **_):
+        new_caches = [] if caches is not None else None
+        for i in range(self.num_layers):
+            r = jax.random.fold_in(rng, i) if rng is not None else None
+            blk = self.children[str(i)]
+            if caches is not None:
+                x, c = blk.apply(
+                    params[str(i)], x, mask=mask, cache=caches[i], rng=r, train=train
+                )
+                new_caches.append(c)
+            else:
+                x = blk.apply(params[str(i)], x, mask=mask, rng=r, train=train)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+    def blocks(self) -> list[Module]:
+        return [self.children[str(i)] for i in range(self.num_layers)]

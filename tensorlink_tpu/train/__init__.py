@@ -1,0 +1,14 @@
+from tensorlink_tpu.train.optim import (  # noqa: F401
+    Optimizer,
+    sgd,
+    adam,
+    adamw,
+    make_optimizer,
+    make_schedule,
+)
+from tensorlink_tpu.train.trainer import (  # noqa: F401
+    TrainState,
+    Trainer,
+    softmax_cross_entropy,
+    mse_loss,
+)

@@ -1,0 +1,161 @@
+"""Native optimizers + LR schedules.
+
+The reference uses a per-worker ``torch.optim.Adam`` stepped inside the
+worker train loop (src/roles/worker.py:231,320-321 — where zero_grad is
+called *before* step, losing the update; not replicated here). Our
+optimizers are pure functions over pytrees: state lives alongside params in
+the TrainState and shards with them under the same PartitionSpecs, which is
+what makes ZeRO-style sharded optimizer state free on a mesh.
+
+API mirrors the (init, update) gradient-transformation style:
+    opt = adamw(lr=1e-3, weight_decay=0.01)
+    state = opt.init(params)
+    updates, state = opt.update(grads, state, params, step)
+    params = apply_updates(params, updates)
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tensorlink_tpu.utils.trees import global_norm
+
+
+Schedule = Callable[[jax.Array], jax.Array]
+
+
+def make_schedule(
+    kind: str = "constant",
+    base_lr: float = 1e-3,
+    warmup_steps: int = 0,
+    total_steps: int = 1000,
+    final_lr_frac: float = 0.0,
+) -> Schedule:
+    def sched(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = jnp.minimum(1.0, (step + 1) / max(warmup_steps, 1)) if warmup_steps else 1.0
+        if kind == "constant":
+            decay = 1.0
+        elif kind == "linear":
+            frac = jnp.clip(
+                (step - warmup_steps) / max(total_steps - warmup_steps, 1), 0.0, 1.0
+            )
+            decay = 1.0 - (1.0 - final_lr_frac) * frac
+        elif kind == "cosine":
+            frac = jnp.clip(
+                (step - warmup_steps) / max(total_steps - warmup_steps, 1), 0.0, 1.0
+            )
+            decay = final_lr_frac + (1.0 - final_lr_frac) * 0.5 * (
+                1 + jnp.cos(math.pi * frac)
+            )
+        else:
+            raise ValueError(f"unknown schedule {kind!r}")
+        return base_lr * warm * decay
+
+    return sched
+
+
+@dataclass(frozen=True)
+class Optimizer:
+    init: Callable[[Any], Any]
+    update: Callable[..., tuple[Any, Any]]  # (grads, state, params, step)
+
+
+def clip_by_global_norm(grads, max_norm: float):
+    norm = global_norm(grads)
+    scale = jnp.minimum(1.0, max_norm / (norm + 1e-9))
+    return jax.tree.map(lambda g: g * scale, grads), norm
+
+
+def sgd(
+    lr: float | Schedule = 1e-2, momentum: float = 0.0, weight_decay: float = 0.0
+) -> Optimizer:
+    sched = lr if callable(lr) else (lambda _: jnp.asarray(lr))
+
+    def init(params):
+        if momentum:
+            return {"mu": jax.tree.map(jnp.zeros_like, params)}
+        return {}
+
+    def update(grads, state, params, step):
+        lr_t = sched(step)
+        if weight_decay:
+            grads = jax.tree.map(lambda g, p: g + weight_decay * p, grads, params)
+        if momentum:
+            mu = jax.tree.map(lambda m, g: momentum * m + g, state["mu"], grads)
+            updates = jax.tree.map(lambda m: -lr_t * m, mu)
+            return updates, {"mu": mu}
+        return jax.tree.map(lambda g: -lr_t * g, grads), state
+
+    return Optimizer(init, update)
+
+
+def adam(
+    lr: float | Schedule = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+    decoupled: bool = False,
+) -> Optimizer:
+    sched = lr if callable(lr) else (lambda _: jnp.asarray(lr))
+
+    def init(params):
+        return {
+            "m": jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params),
+            "v": jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params),
+        }
+
+    def update(grads, state, params, step):
+        step_f = jnp.asarray(step, jnp.float32) + 1.0
+        lr_t = sched(step)
+        if weight_decay and not decoupled:
+            grads = jax.tree.map(lambda g, p: g + weight_decay * p, grads, params)
+        m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g.astype(jnp.float32), state["m"], grads)
+        v = jax.tree.map(
+            lambda v_, g: b2 * v_ + (1 - b2) * jnp.square(g.astype(jnp.float32)),
+            state["v"],
+            grads,
+        )
+        mhat_scale = 1.0 / (1 - b1**step_f)
+        vhat_scale = 1.0 / (1 - b2**step_f)
+
+        def upd(m_, v_, p):
+            u = -lr_t * (m_ * mhat_scale) / (jnp.sqrt(v_ * vhat_scale) + eps)
+            if weight_decay and decoupled:
+                u = u - lr_t * weight_decay * p.astype(jnp.float32)
+            return u.astype(p.dtype)
+
+        updates = jax.tree.map(upd, m, v, params)
+        return updates, {"m": m, "v": v}
+
+    return Optimizer(init, update)
+
+
+def adamw(
+    lr: float | Schedule = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 0.01,
+) -> Optimizer:
+    return adam(lr, b1, b2, eps, weight_decay=weight_decay, decoupled=True)
+
+
+def apply_updates(params, updates):
+    return jax.tree.map(lambda p, u: p + u.astype(p.dtype), params, updates)
+
+
+def make_optimizer(name: str, lr: float | Schedule, weight_decay: float = 0.0) -> Optimizer:
+    if name == "sgd":
+        return sgd(lr, weight_decay=weight_decay)
+    if name == "adam":
+        return adam(lr, weight_decay=weight_decay)
+    if name == "adamw":
+        return adamw(lr, weight_decay=weight_decay)
+    raise ValueError(f"unknown optimizer {name!r}")

@@ -1,0 +1,156 @@
+"""Single-host training engine: TrainState + jit train step.
+
+Replaces the reference's thread-per-micro-batch forward/backward with one
+jit-compiled step; micro-batching for gradient accumulation is a lax.scan
+(pipeline micro-batching lives in parallel/pp.py). The loss/grad math runs
+in the configured compute dtype (bf16 on TPU) with f32 params + f32
+optimizer state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from tensorlink_tpu.config import TrainConfig
+from tensorlink_tpu.nn.module import Module
+from tensorlink_tpu.train.optim import (
+    Optimizer,
+    apply_updates,
+    clip_by_global_norm,
+    make_optimizer,
+    make_schedule,
+)
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean CE; labels are int ids. Computed in f32."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - ll)
+
+
+def mse_loss(pred: jax.Array, target: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.square(pred.astype(jnp.float32) - target.astype(jnp.float32)))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array
+
+    @classmethod
+    def create(cls, params, optimizer: Optimizer) -> "TrainState":
+        return cls(
+            params=params,
+            opt_state=optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+
+class Trainer:
+    """Builds jit train/eval steps for a (module, loss_fn) pair.
+
+    loss_fn(module, params, batch, rng) -> scalar loss. The Trainer handles
+    optimizer state, grad clipping, dtype policy, and optional gradient
+    accumulation over micro-batches.
+    """
+
+    def __init__(
+        self,
+        module: Module,
+        loss_fn: Callable,
+        cfg: TrainConfig = TrainConfig(),
+        optimizer: Optimizer | None = None,
+        donate: bool = True,
+    ):
+        self.module = module
+        self.loss_fn = loss_fn
+        self.cfg = cfg
+        sched = make_schedule(
+            cfg.schedule, cfg.learning_rate, cfg.warmup_steps, cfg.total_steps
+        )
+        self.optimizer = optimizer or make_optimizer(
+            cfg.optimizer, sched, cfg.weight_decay
+        )
+        self.compute_dtype = jnp.dtype(cfg.dtype)
+        self._train_step = jax.jit(
+            self._step, donate_argnums=(0,) if donate else ()
+        )
+        self._eval_step = jax.jit(self._eval)
+
+    # -- state ----------------------------------------------------------
+    def init_state(self, key: jax.Array) -> TrainState:
+        params = self.module.init(key)
+        return TrainState.create(params, self.optimizer)
+
+    # -- inner step (traced) --------------------------------------------
+    def _loss_for_grad(self, params, batch, rng):
+        cast = jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            params,
+        )
+        return self.loss_fn(self.module, cast, batch, rng)
+
+    def _step(self, state: TrainState, batch, rng):
+        micro = self.cfg.micro_batches
+
+        if micro <= 1:
+            loss, grads = jax.value_and_grad(self._loss_for_grad)(
+                state.params, batch, rng
+            )
+        else:
+            # gradient accumulation over micro-batches via scan
+            def micro_batches(b):
+                return jax.tree.map(
+                    lambda x: x.reshape(micro, x.shape[0] // micro, *x.shape[1:]), b
+                )
+
+            mb = micro_batches(batch)
+            zero = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+
+            def body(acc, xs):
+                mb_i, r = xs
+                loss_i, g = jax.value_and_grad(self._loss_for_grad)(
+                    state.params, mb_i, r
+                )
+                acc = jax.tree.map(
+                    lambda a, gi: a + gi.astype(jnp.float32) / micro, acc, g
+                )
+                return acc, loss_i
+
+            rngs = jax.random.split(rng, micro)
+            grads, losses = jax.lax.scan(body, zero, (mb, rngs))
+            loss = jnp.mean(losses)
+
+        if self.cfg.grad_clip_norm:
+            grads, gnorm = clip_by_global_norm(grads, self.cfg.grad_clip_norm)
+        else:
+            gnorm = jnp.zeros(())
+        updates, opt_state = self.optimizer.update(
+            grads, state.opt_state, state.params, state.step
+        )
+        params = apply_updates(state.params, updates)
+        new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
+        return new_state, {"loss": loss, "grad_norm": gnorm}
+
+    def _eval(self, params, batch, rng):
+        return self._loss_for_grad(params, batch, rng)
+
+    # -- public ----------------------------------------------------------
+    def train_step(self, state: TrainState, batch, rng):
+        return self._train_step(state, batch, rng)
+
+    def eval_loss(self, state: TrainState, batch, rng=None):
+        return self._eval_step(state.params, batch, rng)

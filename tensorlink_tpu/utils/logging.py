@@ -28,19 +28,25 @@ class JsonFormatter(logging.Formatter):
         return json.dumps(out)
 
 
-def get_logger(name: str, json_format: bool = False, level: int = logging.INFO):
+def get_logger(
+    name: str, json_format: bool | None = None, level: int | None = None
+):
+    """Namespaced logger. ``json_format``/``level`` reconfigure the shared
+    root handler whenever passed explicitly (not just on first call)."""
     global _CONFIGURED
     logger = logging.getLogger(f"tensorlink_tpu.{name}")
+    root = logging.getLogger("tensorlink_tpu")
     if not _CONFIGURED:
-        handler = logging.StreamHandler(sys.stderr)
-        handler.setFormatter(
+        root.addHandler(logging.StreamHandler(sys.stderr))
+        root.setLevel(logging.INFO)
+        root.propagate = False
+        _CONFIGURED = True
+    if json_format is not None or not root.handlers[0].formatter:
+        root.handlers[0].setFormatter(
             JsonFormatter()
             if json_format
             else logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
         )
-        root = logging.getLogger("tensorlink_tpu")
-        root.addHandler(handler)
+    if level is not None:
         root.setLevel(level)
-        root.propagate = False
-        _CONFIGURED = True
     return logger
