@@ -1,0 +1,128 @@
+"""BERT, TPU-native.
+
+Replaces the reference's opaque HF ``BertModel`` submodule shipping
+(reference workload: tests/ml/test_full_train.py:56-175 fine-tunes
+``BertForSequenceClassification``) with a native implementation whose
+blocks are the framework's own `TransformerBlock`s — so the pipeline
+partitioner, TP specs, and spec-shipping all apply directly. Weights
+import from HF checkpoints via models/hf_import.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from tensorlink_tpu.nn.module import Module
+from tensorlink_tpu.nn.layers import Dense, Dropout, Embedding, LayerNorm
+from tensorlink_tpu.nn.transformer import TransformerBlock, TransformerStack
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_dim: int = 3072
+    max_len: int = 512
+    type_vocab_size: int = 2
+    dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+
+    @classmethod
+    def base(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        return cls(vocab_size=128, dim=32, num_layers=2, num_heads=2, hidden_dim=64, max_len=64)
+
+
+class Bert(Module):
+    def __init__(self, cfg: BertConfig = BertConfig()):
+        super().__init__()
+        self.cfg_obj = cfg
+        self.child("tok_emb", Embedding(cfg.vocab_size, cfg.dim))
+        self.child("pos_emb", Embedding(cfg.max_len, cfg.dim))
+        self.child("type_emb", Embedding(cfg.type_vocab_size, cfg.dim))
+        self.child("emb_norm", LayerNorm(cfg.dim, eps=cfg.layer_norm_eps))
+        self.child("emb_drop", Dropout(cfg.dropout))
+        self.child(
+            "encoder",
+            TransformerStack(
+                cfg.num_layers,
+                TransformerBlock,
+                dim=cfg.dim,
+                num_heads=cfg.num_heads,
+                hidden_dim=cfg.hidden_dim,
+                norm_style="post",
+                norm="layer",
+                norm_eps=cfg.layer_norm_eps,
+                activation="gelu_exact",
+                use_bias=True,
+                dropout=cfg.dropout,
+            ),
+        )
+        self.child("pooler", Dense(cfg.dim, cfg.dim))
+
+    def apply(
+        self,
+        params,
+        input_ids,
+        *,
+        token_type_ids=None,
+        attention_mask=None,  # [B, T] 1=real token
+        rng=None,
+        train=False,
+        **_,
+    ):
+        B, T = input_ids.shape
+        pos = jnp.arange(T)[None, :]
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = (
+            self.children["tok_emb"].apply(params["tok_emb"], input_ids)
+            + self.children["pos_emb"].apply(params["pos_emb"], pos)
+            + self.children["type_emb"].apply(params["type_emb"], token_type_ids)
+        )
+        x = self.children["emb_norm"].apply(params["emb_norm"], x)
+        r0, r1 = jax.random.split(rng) if rng is not None else (None, None)
+        x = self.children["emb_drop"].apply(params["emb_drop"], x, rng=r0, train=train)
+
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+
+        h = self.children["encoder"].apply(
+            params["encoder"], x, mask=mask, rng=r1, train=train
+        )
+        pooled = jnp.tanh(self.children["pooler"].apply(params["pooler"], h[:, 0]))
+        return {"last_hidden_state": h, "pooled": pooled}
+
+
+class BertClassifier(Module):
+    """BertForSequenceClassification equivalent — the reference's e2e
+    fine-tune workload (tests/ml/test_full_train.py:75)."""
+
+    def __init__(self, cfg: BertConfig, num_classes: int):
+        super().__init__()
+        self.num_classes = num_classes
+        self.child("bert", Bert(cfg))
+        self.child("drop", Dropout(cfg.dropout))
+        self.child("head", Dense(cfg.dim, num_classes))
+
+    def apply(self, params, input_ids, *, attention_mask=None, rng=None, train=False, **kw):
+        r0, r1 = jax.random.split(rng) if rng is not None else (None, None)
+        out = self.children["bert"].apply(
+            params["bert"],
+            input_ids,
+            attention_mask=attention_mask,
+            rng=r0,
+            train=train,
+            **kw,
+        )
+        pooled = self.children["drop"].apply(params["drop"], out["pooled"], rng=r1, train=train)
+        return self.children["head"].apply(params["head"], pooled)

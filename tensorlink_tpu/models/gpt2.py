@@ -1,0 +1,109 @@
+"""GPT-2, TPU-native (BASELINE.json config[2]: GPT-2-medium 8PP x 2DP)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from tensorlink_tpu.nn.module import Module
+from tensorlink_tpu.nn.layers import Dropout, Embedding, LayerNorm
+from tensorlink_tpu.nn.transformer import TransformerBlock, TransformerStack
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_len: int = 1024
+    dropout: float = 0.1
+    layer_norm_eps: float = 1e-5
+
+    @classmethod
+    def small(cls) -> "GPT2Config":
+        return cls()
+
+    @classmethod
+    def medium(cls) -> "GPT2Config":
+        return cls(dim=1024, num_layers=24, num_heads=16)
+
+    @classmethod
+    def tiny(cls) -> "GPT2Config":
+        return cls(vocab_size=128, dim=32, num_layers=2, num_heads=2, max_len=64)
+
+
+class GPT2(Module):
+    """Pre-LN decoder with learned positions and tied LM head."""
+
+    def __init__(self, cfg: GPT2Config = GPT2Config()):
+        super().__init__()
+        self.cfg_obj = cfg
+        self.child("wte", Embedding(cfg.vocab_size, cfg.dim))
+        self.child("wpe", Embedding(cfg.max_len, cfg.dim))
+        self.child("drop", Dropout(cfg.dropout))
+        self.child(
+            "blocks",
+            TransformerStack(
+                cfg.num_layers,
+                TransformerBlock,
+                dim=cfg.dim,
+                num_heads=cfg.num_heads,
+                hidden_dim=4 * cfg.dim,
+                norm_style="pre",
+                norm="layer",
+                norm_eps=cfg.layer_norm_eps,
+                activation="gelu",  # gelu_new (tanh approx)
+                use_bias=True,
+                causal=True,
+                dropout=cfg.dropout,
+            ),
+        )
+        self.child("ln_f", LayerNorm(cfg.dim, eps=cfg.layer_norm_eps))
+
+    def apply(
+        self,
+        params,
+        input_ids,
+        *,
+        caches=None,
+        positions=None,
+        rng=None,
+        train=False,
+        logits: bool = True,
+        **_,
+    ):
+        B, T = input_ids.shape
+        if positions is None:
+            if caches is not None:
+                positions = caches[0]["attn"]["index"] + jnp.arange(T)[None, :]
+            else:
+                positions = jnp.arange(T)[None, :]
+        x = self.children["wte"].apply(params["wte"], input_ids)
+        x = x + self.children["wpe"].apply(params["wpe"], positions)
+        r0, r1 = jax.random.split(rng) if rng is not None else (None, None)
+        x = self.children["drop"].apply(params["drop"], x, rng=r0, train=train)
+
+        blocks = self.children["blocks"]
+        if caches is not None:
+            attn_caches = [c["attn"] for c in caches]
+            x, new_attn = blocks.apply(params["blocks"], x, caches=attn_caches, rng=r1, train=train)
+            new_caches = [{"attn": c} for c in new_attn]
+        else:
+            new_caches = None
+            x = blocks.apply(params["blocks"], x, rng=r1, train=train)
+
+        x = self.children["ln_f"].apply(params["ln_f"], x)
+        out = self.children["wte"].attend(params["wte"], x) if logits else x
+        if caches is not None:
+            return out, new_caches
+        return out
+
+    def init_caches(self, batch: int, max_len: int, dtype=jnp.bfloat16):
+        stack = self.children["blocks"]
+        return [
+            {"attn": blk.children["attn"].init_cache(batch, max_len, dtype)}
+            for blk in stack.blocks()
+        ]

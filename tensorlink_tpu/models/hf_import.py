@@ -1,0 +1,165 @@
+"""HuggingFace checkpoint import.
+
+The reference ships live pickled ``nn.Module`` objects to workers
+(src/p2p/torch_node.py:159-162). Here model code is native and only
+*weights* move: a flat ``{name: numpy array}`` state dict — from
+``safetensors`` files or a torch ``state_dict()`` — is remapped into the
+native param pytree. torch Linear weights are [out, in] and transposed;
+GPT-2 Conv1D weights are already [in, out].
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+import numpy as np
+import jax.numpy as jnp
+
+from tensorlink_tpu.models.bert import BertConfig
+from tensorlink_tpu.models.gpt2 import GPT2Config
+
+
+def _t(x) -> np.ndarray:  # torch Linear -> our [in, out]
+    return np.asarray(x).T
+
+
+def _a(x) -> np.ndarray:
+    return np.asarray(x)
+
+
+def load_safetensors(path: str) -> dict[str, np.ndarray]:
+    from safetensors.numpy import load_file
+
+    return load_file(path)
+
+
+def strip_prefix(sd: Mapping[str, np.ndarray], prefix: str) -> dict[str, np.ndarray]:
+    return {
+        (k[len(prefix):] if k.startswith(prefix) else k): v for k, v in sd.items()
+    }
+
+
+def bert_params_from_hf(sd: Mapping[str, np.ndarray], cfg: BertConfig) -> dict:
+    """Map an HF BertModel state dict onto the native `Bert` param tree."""
+    p: dict = {
+        "tok_emb": {"table": _a(sd["embeddings.word_embeddings.weight"])},
+        "pos_emb": {"table": _a(sd["embeddings.position_embeddings.weight"])},
+        "type_emb": {"table": _a(sd["embeddings.token_type_embeddings.weight"])},
+        "emb_norm": {
+            "scale": _a(sd["embeddings.LayerNorm.weight"]),
+            "bias": _a(sd["embeddings.LayerNorm.bias"]),
+        },
+        "emb_drop": {},
+        "encoder": {},
+        "pooler": {
+            "w": _t(sd["pooler.dense.weight"]),
+            "b": _a(sd["pooler.dense.bias"]),
+        },
+    }
+    for i in range(cfg.num_layers):
+        pre = f"encoder.layer.{i}."
+        p["encoder"][str(i)] = {
+            "attn": {
+                "q": {
+                    "w": _t(sd[pre + "attention.self.query.weight"]),
+                    "b": _a(sd[pre + "attention.self.query.bias"]),
+                },
+                "k": {
+                    "w": _t(sd[pre + "attention.self.key.weight"]),
+                    "b": _a(sd[pre + "attention.self.key.bias"]),
+                },
+                "v": {
+                    "w": _t(sd[pre + "attention.self.value.weight"]),
+                    "b": _a(sd[pre + "attention.self.value.bias"]),
+                },
+                "o": {
+                    "w": _t(sd[pre + "attention.output.dense.weight"]),
+                    "b": _a(sd[pre + "attention.output.dense.bias"]),
+                },
+            },
+            "norm1": {
+                "scale": _a(sd[pre + "attention.output.LayerNorm.weight"]),
+                "bias": _a(sd[pre + "attention.output.LayerNorm.bias"]),
+            },
+            "mlp": {
+                "up": {
+                    "w": _t(sd[pre + "intermediate.dense.weight"]),
+                    "b": _a(sd[pre + "intermediate.dense.bias"]),
+                },
+                "down": {
+                    "w": _t(sd[pre + "output.dense.weight"]),
+                    "b": _a(sd[pre + "output.dense.bias"]),
+                },
+                "drop": {},
+            },
+            "norm2": {
+                "scale": _a(sd[pre + "output.LayerNorm.weight"]),
+                "bias": _a(sd[pre + "output.LayerNorm.bias"]),
+            },
+            "drop": {},
+        }
+    return _to_jnp(p)
+
+
+def gpt2_params_from_hf(sd: Mapping[str, np.ndarray], cfg: GPT2Config) -> dict:
+    """Map an HF GPT2Model state dict onto the native `GPT2` param tree."""
+    p: dict = {
+        "wte": {"table": _a(sd["wte.weight"])},
+        "wpe": {"table": _a(sd["wpe.weight"])},
+        "drop": {},
+        "blocks": {},
+        "ln_f": {
+            "scale": _a(sd["ln_f.weight"]),
+            "bias": _a(sd["ln_f.bias"]),
+        },
+    }
+    D = cfg.dim
+    for i in range(cfg.num_layers):
+        pre = f"h.{i}."
+        c_attn_w = _a(sd[pre + "attn.c_attn.weight"])  # [in, 3D] (Conv1D)
+        c_attn_b = _a(sd[pre + "attn.c_attn.bias"])
+        qw, kw, vw = c_attn_w[:, :D], c_attn_w[:, D : 2 * D], c_attn_w[:, 2 * D :]
+        qb, kb, vb = c_attn_b[:D], c_attn_b[D : 2 * D], c_attn_b[2 * D :]
+        p["blocks"][str(i)] = {
+            "norm1": {
+                "scale": _a(sd[pre + "ln_1.weight"]),
+                "bias": _a(sd[pre + "ln_1.bias"]),
+            },
+            "norm2": {
+                "scale": _a(sd[pre + "ln_2.weight"]),
+                "bias": _a(sd[pre + "ln_2.bias"]),
+            },
+            "attn": {
+                "q": {"w": qw, "b": qb},
+                "k": {"w": kw, "b": kb},
+                "v": {"w": vw, "b": vb},
+                "o": {
+                    "w": _a(sd[pre + "attn.c_proj.weight"]),
+                    "b": _a(sd[pre + "attn.c_proj.bias"]),
+                },
+            },
+            "mlp": {
+                "up": {
+                    "w": _a(sd[pre + "mlp.c_fc.weight"]),
+                    "b": _a(sd[pre + "mlp.c_fc.bias"]),
+                },
+                "down": {
+                    "w": _a(sd[pre + "mlp.c_proj.weight"]),
+                    "b": _a(sd[pre + "mlp.c_proj.bias"]),
+                },
+                "drop": {},
+            },
+            "drop": {},
+        }
+    return _to_jnp(p)
+
+
+def _to_jnp(tree):
+    import jax
+
+    return jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), tree)
+
+
+def torch_state_dict_to_numpy(model) -> dict[str, np.ndarray]:
+    """torch nn.Module -> {name: numpy} (cpu)."""
+    return {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}

@@ -31,6 +31,7 @@ def dot_product_attention(
     mask: jax.Array | None = None,  # [B, 1|H, Tq, Tk] bool, True=attend
     bias: jax.Array | None = None,
     q_offset: int | jax.Array = 0,
+    **_,
 ) -> jax.Array:
     """Reference attention, f32 softmax. ``q_offset`` shifts query positions
     for causal masking during incremental decode (cache len Tk > Tq)."""

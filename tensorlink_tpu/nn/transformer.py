@@ -16,7 +16,8 @@ from tensorlink_tpu.nn.attention import MultiHeadAttention
 
 
 ACTIVATIONS = {
-    "gelu": jax.nn.gelu,
+    "gelu": jax.nn.gelu,  # tanh approximation (GPT-2's gelu_new)
+    "gelu_exact": lambda x: jax.nn.gelu(x, approximate=False),  # BERT
     "relu": jax.nn.relu,
     "silu": jax.nn.silu,
 }
@@ -71,6 +72,7 @@ class TransformerBlock(Module):
         num_kv_heads: int | None = None,
         norm_style: str = "pre",
         norm: str = "layer",
+        norm_eps: float = 1e-6,
         activation: str = "gelu",
         use_bias: bool = True,
         gated_mlp: bool = False,
@@ -84,8 +86,8 @@ class TransformerBlock(Module):
         self.norm_style = norm_style
         hidden_dim = hidden_dim or 4 * dim
         norm_cls = RMSNorm if norm == "rms" else LayerNorm
-        self.child("norm1", norm_cls(dim))
-        self.child("norm2", norm_cls(dim))
+        self.child("norm1", norm_cls(dim, eps=norm_eps))
+        self.child("norm2", norm_cls(dim, eps=norm_eps))
         self.child(
             "attn",
             MultiHeadAttention(

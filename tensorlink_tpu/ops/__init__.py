@@ -1,0 +1,1 @@
+from tensorlink_tpu.ops.flash import flash_attention  # noqa: F401

@@ -1,0 +1,68 @@
+"""flash_attention: public entry with Pallas TPU kernel + jnp fallback.
+
+Differentiable via custom_vjp: forward runs the Pallas kernel; backward
+recomputes attention blockwise-free with the jnp reference (correct, and
+memory-bounded by remat at the block level above). Layout matches
+nn.attention: [B, T, H, D].
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from tensorlink_tpu.nn.attention import dot_product_attention
+from tensorlink_tpu.ops.pallas.flash_attention import flash_attention_fwd
+
+
+def _use_pallas(q, interpret: bool) -> bool:
+    if interpret:
+        return True
+    return jax.devices()[0].platform == "tpu"
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal: bool = False, interpret: bool = False):
+    """q,k,v: [B, T, H, D] -> [B, T, H, D]."""
+    return _fwd(q, k, v, causal, interpret)[0]
+
+
+def _tile_ok(T: int) -> bool:
+    """Kernel path needs T to divide cleanly into MXU-friendly blocks."""
+    return T % 128 == 0 or T in (8, 16, 32, 64)
+
+
+def _fwd(q, k, v, causal, interpret):
+    Tq, Tk = q.shape[1], k.shape[1]
+    if _use_pallas(q, interpret) and _tile_ok(Tq) and _tile_ok(Tk):
+        qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))  # [B,H,T,D]
+        out = flash_attention_fwd(
+            qt, kt, vt, causal=causal, interpret=interpret
+        ).swapaxes(1, 2)
+    else:
+        out = dot_product_attention(q, k, v, causal=causal)
+    return out, (q, k, v)
+
+
+def _bwd(causal, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: dot_product_attention(q_, k_, v_, causal=causal), q, k, v
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def flash_attention_impl(q, k, v, *, causal=False, mask=None, q_offset=0, **_):
+    """Drop-in ``attn_impl`` for MultiHeadAttention: Pallas kernel on the
+    plain (no-mask, no-cache, non-GQA) path, jnp reference otherwise."""
+    offset_is_zero = isinstance(q_offset, int) and q_offset == 0
+    if mask is None and offset_is_zero and k.shape[2] == q.shape[2]:
+        return flash_attention(q, k, v, causal, False)
+    return dot_product_attention(
+        q, k, v, causal=causal, mask=mask, q_offset=q_offset
+    )

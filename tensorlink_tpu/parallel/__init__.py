@@ -1,0 +1,7 @@
+from tensorlink_tpu.parallel.dp import dp_shard_batch, dp_train_step  # noqa: F401
+from tensorlink_tpu.parallel.tp import shard_params, tp_jit  # noqa: F401
+from tensorlink_tpu.parallel.pp import (  # noqa: F401
+    Pipeline,
+    stack_stage_params,
+    unstack_stage_params,
+)

@@ -1,0 +1,42 @@
+"""Data parallelism.
+
+The reference *planned* DP ("dp_factor", gradient averaging among workers
+holding the same submodule — src/roles/user.py:161, Whitepaper §21) but
+never implemented an allreduce. Here DP is the degenerate-easy case of the
+mesh design: shard the batch over the ``data`` axis, replicate params, and
+XLA's SPMD partitioner inserts the gradient psum over ICI automatically
+when jit consumes sharded inputs and produces replicated params.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def dp_shard_batch(batch, mesh: Mesh):
+    """Put batch leaves with leading dim sharded over 'data'."""
+    sh = NamedSharding(mesh, P("data"))
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
+
+def dp_train_step(train_step: Callable, mesh: Mesh) -> Callable:
+    """Wrap a Trainer-style step so state stays replicated and batches are
+    consumed data-sharded. The grad allreduce is implicit in the sharding
+    propagation (state out-sharding = replicated)."""
+    repl = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, P("data"))
+
+    step = jax.jit(
+        train_step,
+        in_shardings=(repl, batch_sh, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+
+    def wrapped(state, batch, rng):
+        return step(state, batch, rng)
+
+    return wrapped

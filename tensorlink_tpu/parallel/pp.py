@@ -1,0 +1,150 @@
+"""Pipeline parallelism: SPMD GPipe schedule via shard_map + ppermute.
+
+The reference implements PP as one Python thread per micro-batch pushing
+pickled activations over TCP sockets with no schedule at all (ordering
+emerges from thread timing + a 0.5s stagger — src/ml/distributed.py:88-112,
+survey §2.3). Here the schedule is an explicit lax.scan over
+M + S - 1 ticks inside one jit-compiled SPMD program:
+
+- stage parameters are stacked on a leading [S, ...] axis and sharded over
+  the mesh's ``pipe`` axis — each device holds exactly its stage;
+- each tick every stage computes its block(s) and hands its activation to
+  the next stage with a single `lax.ppermute` hop over ICI (the TPU-native
+  replacement for the FORWARD socket send, src/p2p/torch_node.py:138);
+- the backward pass needs no hand-written BACKWARD messages at all:
+  jax autodiff transposes ppermute into the reverse hop, so one jax.grad
+  of the pipelined loss runs the reverse schedule (replacing
+  src/ml/distributed.py:114-197 + worker.py:295-350);
+- the bubble is the closed-form (S-1)/(M+S-1) — reported, not emergent.
+
+Composes with DP/TP: shard_map binds only the ``pipe`` axis; ``data`` and
+``model`` axes stay in XLA's automatic partitioning, so batch-sharded
+inputs and TP-sharded stage weights pass straight through.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensorlink_tpu.runtime.metrics import pipeline_bubble_fraction
+
+
+def stack_stage_params(layer_params: dict, num_stages: int):
+    """{"0": p0, ..., "L-1": pL-1} -> leaves [S, L/S, ...].
+
+    Leading axis 0 is the stage (shard over ``pipe``); axis 1 indexes the
+    layers within a stage (looped locally).
+    """
+    L = len(layer_params)
+    if L % num_stages:
+        raise ValueError(f"{L} layers not divisible by {num_stages} stages")
+    per = L // num_stages
+    layers = [layer_params[str(i)] for i in range(L)]
+    stages = [
+        jax.tree.map(lambda *xs: jnp.stack(xs), *layers[s * per : (s + 1) * per])
+        for s in range(num_stages)
+    ]
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *stages)
+
+
+def unstack_stage_params(stacked, num_stages: int, layers_per_stage: int) -> dict:
+    """Inverse of stack_stage_params."""
+    out = {}
+    for s in range(num_stages):
+        for l in range(layers_per_stage):
+            out[str(s * layers_per_stage + l)] = jax.tree.map(
+                lambda x: x[s, l], stacked
+            )
+    return out
+
+
+@dataclasses.dataclass(eq=False)  # identity hash: instances are jit-stable
+class Pipeline:
+    """GPipe pipeline over the mesh's ``pipe`` axis.
+
+    block_fn(layer_params, x) applies ONE layer; layers_per_stage of them
+    are applied per stage from the stacked params.
+    """
+
+    mesh: Mesh
+    block_fn: Callable[[Any, jax.Array], jax.Array]
+    num_stages: int
+    layers_per_stage: int
+    axis: str = "pipe"
+
+    @property
+    def bubble_fraction(self) -> Callable[[int], float]:
+        return lambda m: pipeline_bubble_fraction(self.num_stages, m)
+
+    # -- per-device program --------------------------------------------
+    def _stage_apply(self, stage_params, x):
+        """Apply this stage's layers_per_stage blocks (static loop)."""
+        for l in range(self.layers_per_stage):
+            lp = jax.tree.map(lambda a: a[l], stage_params)
+            x = self.block_fn(lp, x)
+        return x
+
+    def _shmap_fn(self, stacked_params, xs):
+        """Runs per pipe-shard. stacked_params leaves [1, Lps, ...];
+        xs [M, mb, ...] (replicated over pipe)."""
+        S = self.num_stages
+        axis = self.axis
+        idx = jax.lax.axis_index(axis)
+        sp = jax.tree.map(lambda a: a[0], stacked_params)
+        M = xs.shape[0]
+        state = jnp.zeros_like(xs[0])
+        outputs = jnp.zeros_like(xs)
+        perm = [(i, i + 1) for i in range(S - 1)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            recv = jax.lax.ppermute(state, axis, perm) if S > 1 else state
+            feed = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), 0, keepdims=False
+            )
+            inp = jnp.where(idx == 0, feed, recv)
+            out = self._stage_apply(sp, inp)
+            out_idx = jnp.clip(t - (S - 1), 0, M - 1)
+            upd = jax.lax.dynamic_update_index_in_dim(outputs, out, out_idx, 0)
+            write = jnp.logical_and(t >= S - 1, idx == S - 1)
+            outputs = jnp.where(write, upd, outputs)
+            return (out, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(
+            tick, (state, outputs), jnp.arange(M + S - 1)
+        )
+        # Only the last stage holds real outputs; broadcast over the pipe
+        # axis so every shard returns the same (replicated) value.
+        outputs = jax.lax.psum(
+            jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs)), axis
+        )
+        return outputs
+
+    # -- public ----------------------------------------------------------
+    def __call__(self, stacked_params, xs):
+        """xs: [M, micro_batch, ...] -> outputs [M, micro_batch, ...].
+
+        Differentiable; wrap in jax.jit (+ value_and_grad) at the call
+        site. Not jitted here so it can be traced inside larger programs.
+        """
+        param_specs = jax.tree.map(lambda _: P(self.axis), stacked_params)
+        fn = jax.shard_map(
+            self._shmap_fn,
+            mesh=self.mesh,
+            in_specs=(param_specs, P()),
+            out_specs=P(),
+            axis_names=frozenset({self.axis}),
+            check_vma=False,
+        )
+        return fn(stacked_params, xs)
+
+
+def pipeline_sharding(mesh: Mesh, axis: str = "pipe") -> NamedSharding:
+    """Sharding for stacked stage params (leading stage axis)."""
+    return NamedSharding(mesh, P(axis))

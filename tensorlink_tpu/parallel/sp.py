@@ -1,0 +1,127 @@
+"""Sequence/context parallelism: ring attention over the ``seq`` mesh axis.
+
+The reference has no sequence-dimension handling at all (survey §5.7: the
+only split anywhere is torch.chunk on the batch dim). For long-context
+training the sequence is sharded over the ``seq`` axis; each device holds a
+[B, T/S, H, D] slice of q,k,v. Attention over the full sequence is computed
+by rotating the K/V block around the ring with `lax.ppermute` S times while
+accumulating online-softmax statistics — ICI traffic overlaps with the
+block attention compute, and peak memory is one K/V block instead of the
+full sequence.
+
+Causal masking uses each block's global offset: a k-block strictly ahead of
+the local q-block contributes nothing (masked), the diagonal block gets the
+triangular mask, and blocks behind are unmasked. Differentiable end-to-end
+(ppermute transposes to the reverse rotation).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, q_off, k_off, causal, scale):
+    """One blockwise attention accumulation step.
+
+    q: [B, Tq, H, D]; k,v: [B, Tk, H, D]. Returns (m, l, acc) contributions:
+    s_max [B, H, Tq, 1], exp-sums, and unnormalized weighted values.
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        qpos = q_off + jnp.arange(Tq)[:, None]
+        kpos = k_off + jnp.arange(Tk)[None, :]
+        keep = qpos >= kpos
+        s = jnp.where(keep[None, None], s, NEG_INF)
+    return s
+
+
+def ring_attention_local(
+    q: jax.Array,  # [B, Tq_local, H, D]
+    k: jax.Array,  # [B, Tk_local, H, D]
+    v: jax.Array,
+    *,
+    axis: str = "seq",
+    causal: bool = False,
+) -> jax.Array:
+    """Call INSIDE shard_map over ``axis``. Full-sequence attention for the
+    local q shard, K/V rotating around the ring."""
+    S = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    B, Tq, H, D = q.shape
+    Tk = k.shape[1]
+    scale = D ** -0.5
+    q_off = idx * Tq
+
+    m = jnp.full((B, H, Tq, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((B, H, Tq, 1), jnp.float32)
+    acc = jnp.zeros((B, Tq, H, D), jnp.float32)
+    # ring: receive from the next rank, so after r rotations we hold shard
+    # (idx + r) % S
+    perm = [(i, (i - 1) % S) for i in range(S)]
+
+    def accumulate(carry, k_blk, v_blk, r):
+        m, l, acc = carry
+        k_off = ((idx + r) % S) * Tk
+        s = _block_attn(q, k_blk, v_blk, q_off, k_off, causal, scale)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk).astype(
+            jnp.float32
+        )
+        acc = acc * alpha.transpose(0, 2, 1, 3) + pv
+        return (m_new, l, acc)
+
+    # local block first (no collective), then S-1 rotate-and-accumulate
+    # steps — exactly S-1 ppermute pairs, none wasted.
+    carry = accumulate((m, l, acc), k, v, 0)
+
+    def step(carry_kv, r):
+        carry, k_blk, v_blk = carry_kv
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        carry = accumulate(carry, k_blk, v_blk, r)
+        return (carry, k_blk, v_blk), None
+
+    if S > 1:
+        (carry, _, _), _ = jax.lax.scan(
+            step, (carry, k, v), jnp.arange(1, S)
+        )
+    m, l, acc = carry
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l_safe.transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,  # [B, T, H, D] global
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "seq",
+    causal: bool = False,
+):
+    """Global entry: shards the T dim over ``axis`` and runs the ring.
+    Differentiable; jit at the call site."""
+    fn = jax.shard_map(
+        partial(ring_attention_local, axis=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis),
+        axis_names=frozenset({axis}),
+        check_vma=False,
+    )
+    return fn(q, k, v)

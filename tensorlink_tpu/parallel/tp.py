@@ -1,0 +1,53 @@
+"""Tensor parallelism (capability the reference lacks entirely — SURVEY §2.3).
+
+Megatron-style: each module already declares its weight PartitionSpecs
+(`Module.param_spec`), so TP is just (1) placing params by those specs and
+(2) jitting with activation shardings; XLA emits the one
+reduce-scatter/all-gather (or psum) pair per block over the ``model`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensorlink_tpu.nn.module import Module
+
+
+def shard_params(params, module: Module, mesh: Mesh, model_axis: str = "model"):
+    """device_put the param pytree according to the module's spec tree."""
+    specs = module.param_spec(model_axis)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+        params,
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def tp_jit(
+    fn: Callable,
+    module: Module,
+    mesh: Mesh,
+    model_axis: str = "model",
+    batch_spec: P = P("data"),
+    out_spec: P = P("data"),
+):
+    """jit `fn(params, x, ...)` with TP param shardings + DP batch sharding.
+
+    Activations stay batch-sharded; intra-op model-axis collectives are
+    inserted by the partitioner from the weight shardings alone.
+    """
+    specs = module.param_spec(model_axis)
+    param_sh = jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    return jax.jit(
+        fn,
+        in_shardings=(param_sh, NamedSharding(mesh, batch_spec)),
+        out_shardings=NamedSharding(mesh, out_spec),
+    )

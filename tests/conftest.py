@@ -40,3 +40,22 @@ def devices():
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+# Shared toy-problem helpers (used by test_train.py and test_parallel.py).
+
+
+def toy_batch(n=64, d=16, classes=4, seed=0):
+    import jax.numpy as jnp
+
+    r = np.random.default_rng(seed)
+    x = r.normal(size=(n, d)).astype(np.float32)
+    w = r.normal(size=(d, classes))
+    y = np.argmax(x @ w, axis=-1)
+    return {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+
+
+def mlp_loss(module, params, batch, rng):
+    from tensorlink_tpu.train.trainer import softmax_cross_entropy
+
+    return softmax_cross_entropy(module.apply(params, batch["x"]), batch["y"])

@@ -69,17 +69,7 @@ def test_schedules():
     assert float(c(50)) == pytest.approx(0.5, abs=1e-2)
 
 
-def _mlp_loss(module, params, batch, rng):
-    logits = module.apply(params, batch["x"])
-    return softmax_cross_entropy(logits, batch["y"])
-
-
-def _toy_batch(n=64, d=16, classes=4, seed=0):
-    rng = np.random.default_rng(seed)
-    x = rng.normal(size=(n, d)).astype(np.float32)
-    w = rng.normal(size=(d, classes))
-    y = np.argmax(x @ w, axis=-1)
-    return {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+from conftest import mlp_loss as _mlp_loss, toy_batch as _toy_batch
 
 
 def test_mlp_loss_decreases():
