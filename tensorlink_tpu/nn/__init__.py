@@ -8,7 +8,31 @@ from tensorlink_tpu.nn.layers import (  # noqa: F401
 )
 from tensorlink_tpu.nn.attention import MultiHeadAttention, dot_product_attention  # noqa: F401
 from tensorlink_tpu.nn.transformer import (  # noqa: F401
+    ACTIVATIONS,
     FeedForward,
     TransformerBlock,
     TransformerStack,
 )
+from tensorlink_tpu.nn.module import (  # noqa: F401
+    module_from_config,
+    register_activation,
+    register_module_type,
+)
+
+# Spec-shipping registry: every type here can be rebuilt from config().
+for _cls in (
+    Dense,
+    Embedding,
+    LayerNorm,
+    RMSNorm,
+    Dropout,
+    MultiHeadAttention,
+    FeedForward,
+    TransformerBlock,
+):
+    register_module_type(_cls)
+
+import jax as _jax  # noqa: E402
+
+for _name, _fn in {**ACTIVATIONS, "tanh": _jax.numpy.tanh}.items():
+    register_activation(_name, _fn)

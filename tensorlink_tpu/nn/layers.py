@@ -40,14 +40,14 @@ class Dense(Module):
         out_dim: int,
         use_bias: bool = True,
         shard: str | None = None,
-        init: str = "lecun",
+        init_scheme: str = "lecun",
     ):
         super().__init__()
         self.in_dim = in_dim
         self.out_dim = out_dim
         self.use_bias = use_bias
         self.shard = shard
-        self.init_scheme = init
+        self.init_scheme = init_scheme
 
     def init(self, key):
         wkey, _ = jax.random.split(key)

@@ -130,6 +130,53 @@ class Lambda(Module):
         return self._fn(x)
 
 
+# ----------------------------------------------------------------- specs
+# Module reconstruction from config() dicts — the receiving end of
+# spec-shipping. The sender transmits `module.config()` (plain data) +
+# weights; the receiver rebuilds the module tree locally and jit-compiles.
+# Code never crosses the wire (contrast: reference pickles whole
+# nn.Modules, src/p2p/torch_node.py:159-162).
+
+MODULE_TYPES: dict[str, type] = {}
+
+_ACTIVATION_FNS: dict[str, Callable] = {}
+
+
+def register_module_type(cls: type) -> type:
+    MODULE_TYPES[cls.__name__] = cls
+    return cls
+
+
+def register_activation(name: str, fn: Callable) -> None:
+    _ACTIVATION_FNS[name] = fn
+
+
+def module_from_config(cfg: Mapping[str, Any]) -> Module:
+    """Rebuild a module from its config() dict. Composite modules that
+    construct their own children in __init__ are rebuilt by constructor
+    args; Sequential rebuilds children recursively; Lambda maps back to a
+    registered activation by name."""
+    import inspect
+
+    t = cfg.get("__type__")
+    if t == "Sequential":
+        children = cfg.get("__children__", {})
+        order = sorted(children, key=int)
+        return Sequential([module_from_config(children[i]) for i in order])
+    if t == "Lambda":
+        name = cfg.get("name", "")
+        if name not in _ACTIVATION_FNS:
+            raise ValueError(f"unknown activation {name!r}")
+        return Lambda(_ACTIVATION_FNS[name], name=name)
+    cls = MODULE_TYPES.get(t)
+    if cls is None:
+        raise ValueError(f"unknown module type {t!r}")
+    sig = inspect.signature(cls.__init__)
+    kwargs = {k: cfg[k] for k in sig.parameters if k != "self" and k in cfg}
+    # json round-trips tuples to lists; coerce back where needed
+    return cls(**kwargs)
+
+
 def init_module(module: Module, key: jax.Array, dtype=jnp.float32):
     """Init + optional cast of floating leaves."""
     params = module.init(key)

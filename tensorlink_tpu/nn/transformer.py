@@ -40,6 +40,8 @@ class FeedForward(Module):
         self.hidden_dim = hidden_dim
         self.activation = activation
         self.gated = gated
+        self.use_bias = use_bias
+        self.dropout = dropout
         self.child("up", Dense(dim, hidden_dim, use_bias=use_bias, shard="col"))
         if gated:
             self.child("gate", Dense(dim, hidden_dim, use_bias=use_bias, shard="col"))
@@ -85,6 +87,19 @@ class TransformerBlock(Module):
         self.dim = dim
         self.norm_style = norm_style
         hidden_dim = hidden_dim or 4 * dim
+        # constructor args stored for config()/spec-shipping reconstruction
+        self.num_heads = num_heads
+        self.hidden_dim = hidden_dim
+        self.num_kv_heads = num_kv_heads
+        self.norm = norm
+        self.norm_eps = norm_eps
+        self.activation = activation
+        self.use_bias = use_bias
+        self.gated_mlp = gated_mlp
+        self.causal = causal
+        self.rope = rope
+        self.rope_theta = rope_theta
+        self.dropout = dropout
         norm_cls = RMSNorm if norm == "rms" else LayerNorm
         self.child("norm1", norm_cls(dim, eps=norm_eps))
         self.child("norm2", norm_cls(dim, eps=norm_eps))

@@ -1,0 +1,9 @@
+from tensorlink_tpu.p2p.serialization import (  # noqa: F401
+    encode_message,
+    decode_message,
+    pack_arrays,
+    unpack_arrays,
+)
+from tensorlink_tpu.p2p.crypto import Identity  # noqa: F401
+from tensorlink_tpu.p2p.node import Node, Peer  # noqa: F401
+from tensorlink_tpu.p2p.dht import DHT  # noqa: F401

@@ -1,0 +1,91 @@
+"""Node identity: RSA keypair + signature challenge.
+
+Same trust model as the reference (per-role RSA-2048 keys on disk, random
+challenge during handshake — src/cryptography/rsa.py:18-160,
+src/p2p/smart_node.py:395-435) but with two fixes: identities may be
+ephemeral in-memory (tests), and the challenge is an RSA-PSS *signature*
+over both parties' nonces instead of decrypt-and-echo, so a node never acts
+as a decryption oracle.
+
+node_id = sha256(DER(pubkey)) hex — also the DHT key (reference hashes
+role+pubkey similarly, smart_node.py:44-51).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from pathlib import Path
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+
+class Identity:
+    def __init__(self, private_key: rsa.RSAPrivateKey):
+        self._key = private_key
+        self.public_der = self._key.public_key().public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+        self.node_id = hashlib.sha256(self.public_der).hexdigest()
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def generate(cls) -> "Identity":
+        return cls(rsa.generate_private_key(public_exponent=65537, key_size=2048))
+
+    @classmethod
+    def load_or_generate(cls, key_dir: str | os.PathLike, role: str) -> "Identity":
+        """Persistent per-role identity (reference: keys/<role>/*.pem)."""
+        path = Path(key_dir) / role / "private.pem"
+        if path.exists():
+            key = serialization.load_pem_private_key(path.read_bytes(), None)
+            return cls(key)
+        ident = cls.generate()
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_bytes(
+            ident._key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.PKCS8,
+                serialization.NoEncryption(),
+            )
+        )
+        os.chmod(path, 0o600)
+        return ident
+
+    # -- challenge ------------------------------------------------------
+    def sign(self, data: bytes) -> bytes:
+        return self._key.sign(
+            data,
+            padding.PSS(
+                mgf=padding.MGF1(hashes.SHA256()),
+                salt_length=padding.PSS.MAX_LENGTH,
+            ),
+            hashes.SHA256(),
+        )
+
+    @staticmethod
+    def verify(public_der: bytes, signature: bytes, data: bytes) -> bool:
+        try:
+            pub = serialization.load_der_public_key(public_der)
+            pub.verify(
+                signature,
+                data,
+                padding.PSS(
+                    mgf=padding.MGF1(hashes.SHA256()),
+                    salt_length=padding.PSS.MAX_LENGTH,
+                ),
+                hashes.SHA256(),
+            )
+            return True
+        except Exception:
+            return False
+
+    @staticmethod
+    def node_id_for(public_der: bytes) -> str:
+        return hashlib.sha256(public_der).hexdigest()
+
+
+def new_nonce() -> bytes:
+    return os.urandom(32)

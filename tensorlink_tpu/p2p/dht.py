@@ -1,0 +1,121 @@
+"""Kademlia-style DHT data structures.
+
+Same keyspace design as the reference (SHA-256 keys, XOR metric, 256
+buckets — src/p2p/smart_node.py:44-95) with the two structural bugs fixed:
+buckets actually participate in lookup, and the value store is separate
+from the peer routing table (the reference mixed both in one dict,
+smart_node.py:145, which is why delete() could evict validators,
+§2.9.8). Network recursion lives in Node.dht_query/dht_store.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterable
+
+
+def key_hash(key: str) -> int:
+    return int.from_bytes(hashlib.sha256(key.encode()).digest(), "big")
+
+
+def xor_distance(a: str, b: str) -> int:
+    return key_hash(a) ^ key_hash(b)
+
+
+@dataclass
+class PeerInfo:
+    node_id: str
+    role: str
+    host: str
+    port: int
+    last_seen: float = field(default_factory=time.time)
+
+    def to_wire(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "role": self.role,
+            "host": self.host,
+            "port": self.port,
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "PeerInfo":
+        return cls(
+            node_id=str(d["node_id"]),
+            role=str(d["role"]),
+            host=str(d["host"]),
+            port=int(d["port"]),
+        )
+
+
+class RoutingTable:
+    """256 XOR-prefix buckets of PeerInfo, bounded size each."""
+
+    def __init__(self, self_id: str, bucket_size: int = 16):
+        self.self_id = self_id
+        self.bucket_size = bucket_size
+        self.buckets: list[dict[str, PeerInfo]] = [{} for _ in range(256)]
+
+    def _bucket_index(self, node_id: str) -> int:
+        d = xor_distance(self.self_id, node_id)
+        return max(d.bit_length() - 1, 0) if d else 0
+
+    def add(self, info: PeerInfo) -> None:
+        if info.node_id == self.self_id:
+            return
+        b = self.buckets[self._bucket_index(info.node_id)]
+        if info.node_id in b or len(b) < self.bucket_size:
+            b[info.node_id] = info
+
+    def remove(self, node_id: str) -> None:
+        self.buckets[self._bucket_index(node_id)].pop(node_id, None)
+
+    def get(self, node_id: str) -> PeerInfo | None:
+        return self.buckets[self._bucket_index(node_id)].get(node_id)
+
+    def all_peers(self) -> list[PeerInfo]:
+        return [p for b in self.buckets for p in b.values()]
+
+    def closest(self, key: str, k: int = 3, exclude: Iterable[str] = ()) -> list[PeerInfo]:
+        ex = set(exclude)
+        peers = [p for p in self.all_peers() if p.node_id not in ex]
+        target = key_hash(key)
+        peers.sort(key=lambda p: key_hash(p.node_id) ^ target)
+        return peers[:k]
+
+    def __len__(self) -> int:
+        return sum(len(b) for b in self.buckets)
+
+
+class DHT:
+    """Local value store + routing table. Values are plain msgpack-able
+    data (job records, worker adverts) — never code."""
+
+    def __init__(self, self_id: str, replication: int = 3, bucket_size: int = 16):
+        self.table = RoutingTable(self_id, bucket_size)
+        self.store: dict[str, Any] = {}
+        self.replication = replication
+
+    def put_local(self, key: str, value: Any) -> None:
+        self.store[key] = value
+
+    def get_local(self, key: str) -> Any | None:
+        return self.store.get(key)
+
+    def delete_local(self, key: str) -> bool:
+        return self.store.pop(key, None) is not None
+
+    def snapshot(self) -> dict:
+        """Persistable state (reference: save_dht_state,
+        smart_node.py:701-728)."""
+        return {
+            "store": self.store,
+            "peers": [p.to_wire() for p in self.table.all_peers()],
+        }
+
+    def restore(self, snap: dict) -> None:
+        self.store.update(snap.get("store", {}))
+        for d in snap.get("peers", []):
+            self.table.add(PeerInfo.from_wire(d))

@@ -1,0 +1,430 @@
+"""Base overlay node: listener, mutual-auth handshake, typed dispatch, DHT RPC.
+
+The asyncio re-design of the reference's SmartNode thread
+(src/p2p/smart_node.py:103-967): same protocol concepts — handshake,
+tag-dispatched messages, recursive DHT lookup with timeout + exclusion,
+ping latency, per-peer stats/reputation, ghost accounting — but structured
+concurrency instead of thread-per-peer, typed msgpack instead of byte-tag
+prefixes, and request/response correlation by message id instead of
+busy-wait polling shared dicts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable
+
+from tensorlink_tpu.config import NodeConfig
+from tensorlink_tpu.p2p.connection import FramedStream
+from tensorlink_tpu.p2p.crypto import Identity, new_nonce
+from tensorlink_tpu.p2p.dht import DHT, PeerInfo
+from tensorlink_tpu.p2p.serialization import decode_message, encode_message
+from tensorlink_tpu.utils.logging import get_logger
+
+Handler = Callable[["Node", "Peer", dict], Awaitable[Any]]
+
+
+@dataclass
+class Peer:
+    info: PeerInfo
+    stream: FramedStream
+    reputation: float = 1.0
+    ping_ms: float | None = None
+    ghosts: int = 0  # unsolicited/malformed messages (reference ghost stat)
+    msgs_in: int = 0
+    msgs_out: int = 0
+    connected_at: float = field(default_factory=time.time)
+
+    @property
+    def node_id(self) -> str:
+        return self.info.node_id
+
+    @property
+    def role(self) -> str:
+        return self.info.role
+
+
+class Node:
+    """Run with `await node.start()`; subclass roles register handlers in
+    `register_handlers` via `self.on("TYPE", coro)`."""
+
+    def __init__(self, cfg: NodeConfig, identity: Identity | None = None):
+        self.cfg = cfg
+        self.identity = identity or (
+            Identity.load_or_generate(cfg.key_dir, cfg.role)
+            if cfg.key_dir
+            else Identity.generate()
+        )
+        self.node_id = self.identity.node_id
+        self.role = cfg.role
+        self.dht = DHT(self.node_id, replication=cfg.dht_replication)
+        self.peers: dict[str, Peer] = {}
+        self.log = get_logger(f"{cfg.role}.{self.node_id[:8]}")
+        self._handlers: dict[str, Handler] = {}
+        self._pending: dict[str, asyncio.Future] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self._tasks: set[asyncio.Task] = set()
+        self.port: int | None = None
+        self.started = asyncio.Event()
+        self._stopping = False
+        self.register_handlers()
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._accept, self.cfg.host, self.cfg.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        self.started.set()
+        self.log.info("listening on %s:%s", self.cfg.host, self.port)
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for t in list(self._tasks):
+            t.cancel()
+        # Close peer transports BEFORE wait_closed: on 3.12+ wait_closed
+        # blocks until every accepted connection's handler is done.
+        for p in list(self.peers.values()):
+            p.stream.close()
+        self.peers.clear()
+        if self._server:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=2.0)
+            except asyncio.TimeoutError:
+                pass
+        await asyncio.sleep(0)  # let cancelled tasks unwind
+
+    def _spawn(self, coro) -> asyncio.Task:
+        t = asyncio.create_task(coro)
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+        return t
+
+    @property
+    def info(self) -> PeerInfo:
+        return PeerInfo(
+            node_id=self.node_id,
+            role=self.role,
+            host=self.cfg.host,
+            port=self.port or 0,
+        )
+
+    # ------------------------------------------------------------ handshake
+    async def connect(self, host: str, port: int) -> Peer:
+        """Dial + mutual signature handshake (initiator)."""
+        reader, writer = await asyncio.open_connection(host, port)
+        stream = FramedStream(
+            reader, writer, self.cfg.compression, self.cfg.compression_min_bytes
+        )
+        nonce_a = new_nonce()
+        await stream.send(
+            encode_message(
+                {
+                    "type": "HELLO",
+                    "role": self.role,
+                    "pubkey": self.identity.public_der,
+                    "nonce": nonce_a,
+                    "listen_port": self.port or 0,
+                }
+            )
+        )
+        ack = decode_message(
+            await asyncio.wait_for(stream.recv(), self.cfg.handshake_timeout_s)
+        )
+        if ack.get("type") != "HELLO_ACK":
+            stream.close()
+            raise ConnectionError(f"handshake rejected: {ack.get('type')}")
+        their_pub = ack["pubkey"]
+        if not Identity.verify(their_pub, ack["sig"], nonce_a + ack["nonce"]):
+            stream.close()
+            raise ConnectionError("peer failed signature challenge")
+        await stream.send(
+            encode_message(
+                {"type": "HELLO_FIN", "sig": self.identity.sign(ack["nonce"] + nonce_a)}
+            )
+        )
+        info = PeerInfo(
+            node_id=Identity.node_id_for(their_pub),
+            role=str(ack["role"]),
+            host=host,
+            port=int(ack["listen_port"]) or port,
+        )
+        return self._register_peer(info, stream)
+
+    async def _accept(self, reader, writer) -> None:
+        stream = FramedStream(
+            reader, writer, self.cfg.compression, self.cfg.compression_min_bytes
+        )
+        try:
+            hello = decode_message(
+                await asyncio.wait_for(stream.recv(), self.cfg.handshake_timeout_s)
+            )
+            if hello.get("type") != "HELLO":
+                raise ConnectionError("expected HELLO")
+            their_pub = hello["pubkey"]
+            their_id = Identity.node_id_for(their_pub)
+            if not self.authorize_peer(their_id, str(hello["role"])):
+                await stream.send(encode_message({"type": "REJECT", "reason": "unauthorized"}))
+                stream.close()
+                return
+            if len(self.peers) >= self.cfg.max_connections:
+                await stream.send(encode_message({"type": "REJECT", "reason": "full"}))
+                stream.close()
+                return
+            nonce_b = new_nonce()
+            await stream.send(
+                encode_message(
+                    {
+                        "type": "HELLO_ACK",
+                        "role": self.role,
+                        "pubkey": self.identity.public_der,
+                        "nonce": nonce_b,
+                        "sig": self.identity.sign(hello["nonce"] + nonce_b),
+                        "listen_port": self.port or 0,
+                    }
+                )
+            )
+            fin = decode_message(
+                await asyncio.wait_for(stream.recv(), self.cfg.handshake_timeout_s)
+            )
+            if fin.get("type") != "HELLO_FIN" or not Identity.verify(
+                their_pub, fin["sig"], nonce_b + hello["nonce"]
+            ):
+                raise ConnectionError("initiator failed signature challenge")
+            host = stream.peername[0] if stream.peername else "?"
+            info = PeerInfo(
+                node_id=their_id,
+                role=str(hello["role"]),
+                host=host,
+                port=int(hello["listen_port"]),
+            )
+            self._register_peer(info, stream)
+        except Exception as e:  # noqa: BLE001
+            self.log.debug("inbound handshake failed: %s", e)
+            stream.close()
+
+    def authorize_peer(self, node_id: str, role: str) -> bool:
+        """Hook: reputation gate (reference refuses rep==0 peers,
+        smart_node.py:329-337). Roles override."""
+        return True
+
+    def _register_peer(self, info: PeerInfo, stream: FramedStream) -> Peer:
+        old = self.peers.get(info.node_id)
+        if old is not None:
+            old.stream.close()
+        peer = Peer(info=info, stream=stream)
+        self.peers[info.node_id] = peer
+        self.dht.table.add(info)
+        self._spawn(self._recv_loop(peer))
+        self.log.info("peer %s (%s) connected", info.node_id[:8], info.role)
+        return peer
+
+    # ------------------------------------------------------------ dispatch
+    def on(self, msg_type: str, handler: Handler) -> None:
+        self._handlers[msg_type] = handler
+
+    def register_handlers(self) -> None:
+        self.on("PING", self._h_ping)
+        self.on("DHT_STORE", self._h_dht_store)
+        self.on("DHT_QUERY", self._h_dht_query)
+        self.on("PEERS", self._h_peers)
+
+    async def _recv_loop(self, peer: Peer) -> None:
+        try:
+            while True:
+                raw = await peer.stream.recv()
+                try:
+                    msg = decode_message(raw)
+                except ValueError:
+                    peer.ghosts += 1
+                    self._penalize(peer)
+                    continue
+                peer.msgs_in += 1
+                self._spawn(self._dispatch(peer, msg))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self._drop_peer(peer)
+
+    async def _dispatch(self, peer: Peer, msg: dict) -> None:
+        # response correlation
+        re_id = msg.get("re")
+        if re_id is not None:
+            fut = self._pending.pop(re_id, None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+            else:
+                peer.ghosts += 1  # unsolicited response
+                self._penalize(peer)
+            return
+        handler = self._handlers.get(msg["type"])
+        if handler is None:
+            peer.ghosts += 1
+            self._penalize(peer)
+            return
+        try:
+            reply = await handler(self, peer, msg)
+        except Exception as e:  # noqa: BLE001
+            self.log.warning("handler %s failed: %s", msg["type"], e)
+            reply = {"type": "ERROR", "error": str(e)}
+        if reply is not None and "id" in msg:
+            reply.setdefault("type", "RESPONSE")
+            reply["re"] = msg["id"]
+            await self.send(peer, reply)
+
+    def _penalize(self, peer: Peer) -> None:
+        peer.reputation = max(0.0, peer.reputation - 0.1)
+        if peer.reputation == 0.0:
+            self.log.warning("peer %s reputation zero, dropping", peer.node_id[:8])
+            peer.stream.close()
+
+    def _drop_peer(self, peer: Peer) -> None:
+        if self.peers.get(peer.node_id) is peer:
+            del self.peers[peer.node_id]
+            self.on_peer_lost(peer)
+
+    def on_peer_lost(self, peer: Peer) -> None:
+        """Hook for roles (fault detection)."""
+
+    # ------------------------------------------------------------ messaging
+    async def send(self, peer: Peer, msg: dict) -> None:
+        peer.msgs_out += 1
+        await peer.stream.send(encode_message(msg))
+
+    async def request(
+        self, peer: Peer, msg: dict, timeout: float | None = None
+    ) -> dict:
+        """Send and await the correlated response."""
+        msg = dict(msg)
+        msg["id"] = uuid.uuid4().hex
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[msg["id"]] = fut
+        try:
+            await self.send(peer, msg)
+            return await asyncio.wait_for(
+                fut, timeout or self.cfg.request_timeout_s
+            )
+        finally:
+            self._pending.pop(msg["id"], None)
+
+    async def ping(self, peer: Peer) -> float:
+        t0 = time.perf_counter()
+        await self.request(peer, {"type": "PING"})
+        peer.ping_ms = (time.perf_counter() - t0) * 1e3
+        return peer.ping_ms
+
+    # ------------------------------------------------------------ DHT RPC
+    async def dht_store(self, key: str, value: Any) -> int:
+        """Store locally + replicate to the closest peers. Returns number
+        of replicas written."""
+        self.dht.put_local(key, value)
+        n = 1
+        for info in self.dht.table.closest(key, self.dht.replication):
+            peer = self.peers.get(info.node_id)
+            if peer is None:
+                continue
+            try:
+                await self.request(
+                    peer, {"type": "DHT_STORE", "key": key, "value": value}
+                )
+                n += 1
+            except (asyncio.TimeoutError, ConnectionError):
+                continue
+        return n
+
+    async def dht_query(
+        self, key: str, max_hops: int = 8, _exclude: set[str] | None = None
+    ) -> Any | None:
+        """Local hit, else recursive query of XOR-closest peers with
+        timeout + exclusion (reference: query_dht, smart_node.py:587-680)."""
+        local = self.dht.get_local(key)
+        if local is not None:
+            return local
+        exclude = _exclude or {self.node_id}
+        for info in self.dht.table.closest(key, k=8, exclude=exclude):
+            if max_hops <= 0:
+                break
+            peer = self.peers.get(info.node_id)
+            if peer is None:
+                continue
+            exclude.add(info.node_id)
+            max_hops -= 1
+            try:
+                resp = await self.request(
+                    peer,
+                    {"type": "DHT_QUERY", "key": key, "exclude": sorted(exclude)},
+                )
+                if resp.get("value") is not None:
+                    return resp["value"]
+            except (asyncio.TimeoutError, ConnectionError):
+                continue
+        return None
+
+    async def discover_peers(self, peer: Peer) -> list[PeerInfo]:
+        """Ask a peer for its peer list; merge into routing table."""
+        resp = await self.request(peer, {"type": "PEERS"})
+        infos = [PeerInfo.from_wire(d) for d in resp.get("peers", [])]
+        for i in infos:
+            self.dht.table.add(i)
+        return infos
+
+    # ------------------------------------------------------------ handlers
+    async def _h_ping(self, node, peer, msg) -> dict:
+        return {"type": "PONG", "t": time.time()}
+
+    def dht_store_allowed(self, peer: Peer, key: str) -> bool:
+        """Remote-write policy. 'rep:' (reputation) keys are local-only —
+        an unauthenticated peer must never set another node's reputation;
+        roles may restrict further (validators: job records only from
+        validators)."""
+        return not key.startswith("rep:")
+
+    async def _h_dht_store(self, node, peer, msg) -> dict:
+        key = str(msg["key"])
+        if not self.dht_store_allowed(peer, key):
+            peer.ghosts += 1
+            self._penalize(peer)
+            return {"type": "DHT_DENIED", "key": key}
+        self.dht.put_local(key, msg["value"])
+        return {"type": "DHT_STORED"}
+
+    async def _h_dht_query(self, node, peer, msg) -> dict:
+        key = str(msg["key"])
+        val = self.dht.get_local(key)
+        if val is None:
+            exclude = set(msg.get("exclude", [])) | {self.node_id}
+            val = await self.dht_query(key, max_hops=2, _exclude=exclude)
+        return {"type": "DHT_VALUE", "key": key, "value": val}
+
+    async def _h_peers(self, node, peer, msg) -> dict:
+        return {
+            "type": "PEER_LIST",
+            "peers": [p.info.to_wire() for p in self.peers.values()],
+        }
+
+    # ------------------------------------------------------------ status
+    def status(self) -> dict:
+        """Self-report (reference: get_self_info + node_stats,
+        smart_node.py:855-947)."""
+        return {
+            "node_id": self.node_id,
+            "role": self.role,
+            "port": self.port,
+            "peers": {
+                p.node_id[:16]: {
+                    "role": p.role,
+                    "reputation": p.reputation,
+                    "ping_ms": p.ping_ms,
+                    "msgs_in": p.msgs_in,
+                    "msgs_out": p.msgs_out,
+                    "ghosts": p.ghosts,
+                }
+                for p in self.peers.values()
+            },
+            "dht_keys": len(self.dht.store),
+            "routing_peers": len(self.dht.table),
+        }

@@ -1,0 +1,159 @@
+"""Wire serialization: typed msgpack messages + raw-buffer array shipping.
+
+The reference pickles live tensors and whole nn.Modules onto the socket
+(src/p2p/torch_node.py:140-162) — arbitrary-code-execution-grade
+deserialization on every node (survey §2.4). Here nothing on the wire is
+ever executable:
+
+- control messages are msgpack maps with a string ``type`` and plain-data
+  payload;
+- arrays travel as a safetensors-style manifest (dtype/shape/offset) plus
+  one contiguous raw-bytes blob, optionally zstd-compressed;
+- model code never travels at all — module *specs* (the `Module.config()`
+  dict) travel, and the receiving host reconstructs + jit-compiles locally.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Mapping, Sequence
+
+import msgpack
+import numpy as np
+
+try:
+    import zstandard as _zstd
+
+    _ZC = _zstd.ZstdCompressor(level=3)
+    _ZD = _zstd.ZstdDecompressor()
+except ImportError:  # pragma: no cover
+    _ZC = _ZD = None
+
+import zlib
+
+MAGIC = b"TLT1"
+
+
+# ---------------------------------------------------------------- messages
+
+
+def encode_message(msg: Mapping[str, Any]) -> bytes:
+    """Typed message -> bytes. Must contain a string 'type'."""
+    if "type" not in msg or not isinstance(msg["type"], str):
+        raise ValueError("message must carry a string 'type'")
+    return msgpack.packb(dict(msg), use_bin_type=True)
+
+
+def decode_message(data: bytes) -> dict[str, Any]:
+    msg = msgpack.unpackb(data, raw=False, strict_map_key=False)
+    if not isinstance(msg, dict) or not isinstance(msg.get("type"), str):
+        raise ValueError("malformed message (no string 'type')")
+    return msg
+
+
+# ---------------------------------------------------------------- arrays
+
+
+def _compress(data: bytes, codec: str) -> bytes:
+    if codec == "zstd" and _ZC is not None:
+        return _ZC.compress(data)
+    if codec == "zlib":
+        return zlib.compress(data, 6)
+    return data
+
+
+def _decompress(data: bytes, codec: str) -> bytes:
+    if codec == "zstd" and _ZD is not None:
+        return _ZD.decompress(data)
+    if codec == "zlib":
+        return zlib.decompress(data)
+    return data
+
+
+def pack_arrays(
+    arrays: Mapping[str, np.ndarray], codec: str = "zstd"
+) -> bytes:
+    """{name: array} -> MAGIC + msgpack(manifest) + blob.
+
+    Flat names; pytrees are flattened by the caller (see tree_flatten_arrays).
+    """
+    if codec == "zstd" and _ZC is None:
+        codec = "zlib"
+    manifest: dict[str, Any] = {"codec": codec, "tensors": {}}
+    blob = io.BytesIO()
+    offset = 0
+    for name, arr in arrays.items():
+        arr = np.ascontiguousarray(arr)
+        raw = arr.tobytes()
+        manifest["tensors"][name] = {
+            "dtype": arr.dtype.str,
+            "shape": list(arr.shape),
+            "offset": offset,
+            "nbytes": len(raw),
+        }
+        blob.write(raw)
+        offset += len(raw)
+    body = _compress(blob.getvalue(), codec)
+    head = msgpack.packb(manifest, use_bin_type=True)
+    return MAGIC + len(head).to_bytes(4, "big") + head + body
+
+
+def unpack_arrays(data: bytes) -> dict[str, np.ndarray]:
+    if data[:4] != MAGIC:
+        raise ValueError("bad array blob magic")
+    hlen = int.from_bytes(data[4:8], "big")
+    manifest = msgpack.unpackb(data[8 : 8 + hlen], raw=False)
+    body = _decompress(bytes(data[8 + hlen :]), manifest["codec"])
+    out = {}
+    for name, meta in manifest["tensors"].items():
+        raw = body[meta["offset"] : meta["offset"] + meta["nbytes"]]
+        out[name] = np.frombuffer(raw, dtype=np.dtype(meta["dtype"])).reshape(
+            meta["shape"]
+        )
+    return out
+
+
+# ---------------------------------------------------------------- pytrees
+
+
+def tree_flatten_arrays(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
+    """Nested dict pytree of arrays -> flat {dotted.path: np.ndarray}."""
+    flat: dict[str, np.ndarray] = {}
+
+    def walk(node, path):
+        if isinstance(node, Mapping):
+            if not node:
+                flat[path + "//empty"] = np.zeros((0,), np.uint8)
+                return
+            for k in sorted(node):
+                walk(node[k], f"{path}.{k}" if path else str(k))
+        else:
+            flat[path] = np.asarray(node)
+
+    walk(tree, prefix)
+    return flat
+
+
+def tree_unflatten_arrays(flat: Mapping[str, np.ndarray]) -> Any:
+    tree: dict[str, Any] = {}
+    saw_empty_root = False
+    for name, arr in flat.items():
+        if name.endswith("//empty"):
+            path = name[: -len("//empty")]
+            if not path:
+                saw_empty_root = True
+                continue
+            parts = path.split(".")
+            node = tree
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = {}
+            continue
+        parts = name.split(".")
+        node = tree
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    if saw_empty_root and not tree:
+        return {}
+    return tree

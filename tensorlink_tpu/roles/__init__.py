@@ -1,0 +1,5 @@
+from tensorlink_tpu.roles.registry import InMemoryRegistry, Registry  # noqa: F401
+from tensorlink_tpu.roles.jobs import JobRecord, StageSpec, validate_job_request  # noqa: F401
+from tensorlink_tpu.roles.worker import WorkerNode  # noqa: F401
+from tensorlink_tpu.roles.validator import ValidatorNode  # noqa: F401
+from tensorlink_tpu.roles.user import UserNode  # noqa: F401

@@ -1,0 +1,69 @@
+"""Validator registry: the chain-integration seam.
+
+The reference binds to an EVM contract for validator enumeration and
+role verification, bypassed entirely by off_chain_test=True
+(src/p2p/smart_node.py:165-179,522-537). Here the same seam is an abstract
+Registry: InMemoryRegistry for hermetic tests/off-chain deployments; a
+web3-backed implementation can slot in behind the same interface without
+touching any node code.
+"""
+
+from __future__ import annotations
+
+import abc
+import random
+import time
+from dataclasses import dataclass, field
+
+from tensorlink_tpu.p2p.dht import PeerInfo
+
+
+@dataclass
+class ValidatorEntry:
+    info: PeerInfo
+    reputation: float = 1.0
+    registered_at: float = field(default_factory=time.time)
+
+
+class Registry(abc.ABC):
+    @abc.abstractmethod
+    def register_validator(self, info: PeerInfo) -> None: ...
+
+    @abc.abstractmethod
+    def validator_count(self) -> int: ...
+
+    @abc.abstractmethod
+    def list_validators(self) -> list[ValidatorEntry]: ...
+
+    @abc.abstractmethod
+    def is_validator(self, node_id: str) -> bool: ...
+
+    def sample_validators(self, k: int = 6) -> list[ValidatorEntry]:
+        """Bootstrap sampling (reference: <=6 random contract validators,
+        smart_node.py:539-585)."""
+        entries = self.list_validators()
+        return random.sample(entries, min(k, len(entries)))
+
+
+class InMemoryRegistry(Registry):
+    def __init__(self):
+        self._validators: dict[str, ValidatorEntry] = {}
+
+    def register_validator(self, info: PeerInfo) -> None:
+        self._validators[info.node_id] = ValidatorEntry(info=info)
+
+    def deregister_validator(self, node_id: str) -> None:
+        self._validators.pop(node_id, None)
+
+    def validator_count(self) -> int:
+        return len(self._validators)
+
+    def list_validators(self) -> list[ValidatorEntry]:
+        return list(self._validators.values())
+
+    def is_validator(self, node_id: str) -> bool:
+        return node_id in self._validators
+
+    def set_reputation(self, node_id: str, rep: float) -> None:
+        if node_id in self._validators:
+            self._validators[node_id].reputation = rep

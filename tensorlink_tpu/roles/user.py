@@ -1,0 +1,261 @@
+"""User: job requester / training master.
+
+Re-design of src/roles/user.py + the master half of src/ml/distributed.py:
+`request_job` partitions a Sequential model into stages by a memory budget
+(reference: parse_model, user.py:316-425), negotiates placement through a
+validator, ships stage specs + weights to the recruited workers, and then
+drives pipelined micro-batch training over typed FORWARD/BACKWARD messages
+— async gather instead of thread-per-micro-batch + busy-wait
+(distributed.py:88-197).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorlink_tpu.config import NodeConfig
+from tensorlink_tpu.nn.module import Module, Sequential
+from tensorlink_tpu.p2p.node import Node, Peer
+from tensorlink_tpu.p2p.serialization import (
+    pack_arrays,
+    tree_flatten_arrays,
+    unpack_arrays,
+)
+from tensorlink_tpu.roles.jobs import JobRecord, StageSpec
+from tensorlink_tpu.utils.trees import tree_bytes
+
+
+def partition_sequential(
+    seq: Sequential, params: dict, max_stage_bytes: float
+) -> list[tuple[Sequential, dict]]:
+    """Greedy size-capped partition of a Sequential into stages
+    (reference: parse_model's recursive size cap, user.py:316-425).
+    Returns [(sub_module, sub_params), ...]."""
+    stages: list[tuple[Sequential, dict]] = []
+    cur: list[Module] = []
+    cur_params: dict = {}
+    cur_bytes = 0
+    for i, layer in enumerate(seq.layers):
+        p = params[str(i)]
+        b = tree_bytes(p)
+        if cur and cur_bytes + b > max_stage_bytes:
+            stages.append((Sequential(cur), cur_params))
+            cur, cur_params, cur_bytes = [], {}, 0
+        cur_params[str(len(cur))] = p
+        cur.append(layer)
+        cur_bytes += b
+    if cur:
+        stages.append((Sequential(cur), cur_params))
+    return stages
+
+
+@dataclass
+class RemoteStage:
+    index: int
+    peer: Peer
+    info: dict
+
+
+class DistributedJob:
+    """Master-side handle to a placed job — the TPU-era DistributedModel.
+
+    forward/backward run all micro-batches concurrently through the worker
+    chain (pipelining across stages emerges from per-micro ordering, but
+    explicitly scheduled by asyncio rather than thread timing)."""
+
+    def __init__(self, user: "UserNode", job: JobRecord, stages: list[RemoteStage]):
+        self.user = user
+        self.job = job
+        self.stages = stages
+        self.step = 0
+
+    async def _micro_forward(self, step: int, micro: int, x: np.ndarray) -> np.ndarray:
+        for st in self.stages:
+            resp = await self.user.request(
+                st.peer,
+                {
+                    "type": "FORWARD",
+                    "job_id": self.job.job_id,
+                    "stage": st.index,
+                    "step": step,
+                    "micro": micro,
+                    "data": pack_arrays({"x": np.asarray(x)}),
+                },
+                timeout=60.0,
+            )
+            if resp.get("type") != "ACTIVATION":
+                raise RuntimeError(f"stage {st.index} forward failed: {resp}")
+            x = unpack_arrays(resp["data"])["x"]
+        return x
+
+    async def _micro_backward(self, step: int, micro: int, g: np.ndarray) -> np.ndarray:
+        for st in reversed(self.stages):
+            resp = await self.user.request(
+                st.peer,
+                {
+                    "type": "BACKWARD",
+                    "job_id": self.job.job_id,
+                    "stage": st.index,
+                    "step": step,
+                    "micro": micro,
+                    "data": pack_arrays({"g": np.asarray(g)}),
+                },
+                timeout=60.0,
+            )
+            if resp.get("type") != "INPUT_GRAD":
+                raise RuntimeError(f"stage {st.index} backward failed: {resp}")
+            g = unpack_arrays(resp["data"])["g"]
+        return g
+
+    async def train_step(
+        self,
+        batch_x: np.ndarray,
+        loss_grad_fn: Callable[[np.ndarray, int], tuple[float, np.ndarray]],
+    ) -> float:
+        """One pipelined step: split into micro-batches, forward all,
+        loss+grad at the master, backward all, then optimizer step on
+        every stage."""
+        m = self.job.micro_batches
+        micros = np.array_split(np.asarray(batch_x), m)
+        step = self.step
+
+        async def one(mi: int, x):
+            out = await self._micro_forward(step, mi, x)
+            loss, g = loss_grad_fn(out, mi)
+            await self._micro_backward(step, mi, g)
+            return loss
+
+        losses = await asyncio.gather(*(one(i, x) for i, x in enumerate(micros)))
+        await asyncio.gather(
+            *(
+                self.user.request(
+                    st.peer,
+                    {
+                        "type": "STEP_END",
+                        "job_id": self.job.job_id,
+                        "stage": st.index,
+                    },
+                    timeout=30.0,
+                )
+                for st in self.stages
+            )
+        )
+        self.step += 1
+        return float(np.mean(losses))
+
+    async def fetch_params(self) -> list[dict]:
+        """Gather current params from every stage (reference:
+        parameters(distributed=True), distributed.py:236-276)."""
+        out = []
+        for st in self.stages:
+            resp = await self.user.request(
+                st.peer,
+                {
+                    "type": "PARAMS_REQUEST",
+                    "job_id": self.job.job_id,
+                    "stage": st.index,
+                },
+                timeout=60.0,
+            )
+            from tensorlink_tpu.p2p.serialization import tree_unflatten_arrays
+
+            out.append(tree_unflatten_arrays(unpack_arrays(resp["weights"])))
+        return out
+
+    async def report(self, validator: Peer, loss: float) -> None:
+        await self.user.request(
+            validator,
+            {
+                "type": "JOB_UPDATE",
+                "job_id": self.job.job_id,
+                "loss": loss,
+                "step": self.step,
+            },
+        )
+
+
+class UserNode(Node):
+    def __init__(self, cfg: NodeConfig | None = None, **kw):
+        cfg = cfg or NodeConfig(role="user")
+        super().__init__(cfg, **kw)
+
+    async def request_job(
+        self,
+        model: Sequential,
+        params: dict,
+        validator: Peer,
+        *,
+        max_stage_bytes: float = 4e9,  # reference default max_module_size
+        micro_batches: int = 1,
+        dp_factor: int = 1,
+        train: dict | None = None,
+    ) -> DistributedJob:
+        """Partition -> JOB_REQ -> connect workers -> ship specs+weights ->
+        LOADED acks -> DistributedJob (reference call stack §3.1)."""
+        stage_parts = partition_sequential(model, params, max_stage_bytes)
+        specs = [
+            StageSpec(
+                index=i,
+                module_config=mod.config(),
+                param_bytes=tree_bytes(p),
+            )
+            for i, (mod, p) in enumerate(stage_parts)
+        ]
+        job = JobRecord(
+            author=self.node_id,
+            stages=specs,
+            dp_factor=dp_factor,
+            micro_batches=micro_batches,
+            train=train or {},
+            capacity_bytes=sum(s.param_bytes for s in specs),
+            seed_validators=[validator.node_id],
+        )
+        resp = await self.request(
+            validator, {"type": "JOB_REQ", "job": job.to_wire()}, timeout=30.0
+        )
+        if resp.get("type") != "ACCEPT_JOB":
+            raise RuntimeError(f"job declined: {resp.get('reason')}")
+
+        remote: list[RemoteStage] = []
+        for placement in resp["workers"]:
+            nid = placement["node_id"]
+            peer = self.peers.get(nid)
+            if peer is None:
+                peer = await self.connect(placement["host"], int(placement["port"]))
+            remote.append(
+                RemoteStage(index=int(placement["stage"]), peer=peer, info=placement)
+            )
+        remote.sort(key=lambda s: s.index)
+
+        # ship specs + weights to all stages concurrently; await LOADED
+        # (reference: spawn_worker + broken ack path,
+        # distributed.py:434-461/§2.9.3 — here the ack is the typed
+        # response, and setup latency is the max transfer, not the sum)
+        async def ship(st: RemoteStage, p) -> None:
+            flat = tree_flatten_arrays(jax.tree.map(np.asarray, p))
+            ack = await self.request(
+                st.peer,
+                {
+                    "type": "MODULE_SPEC",
+                    "job_id": job.job_id,
+                    "stage": st.index,
+                    "module_config": job.stages[st.index].module_config,
+                    "weights": pack_arrays(flat),
+                    "train": job.train,
+                },
+                timeout=60.0,
+            )
+            if ack.get("type") != "LOADED":
+                raise RuntimeError(f"stage {st.index} failed to load: {ack}")
+
+        await asyncio.gather(
+            *(ship(st, p) for st, (_, p) in zip(remote, stage_parts))
+        )
+        return DistributedJob(self, job, remote)

@@ -1,0 +1,176 @@
+"""Validator: network backbone — job validation, worker recruitment, PoL.
+
+Re-design of src/roles/validator.py: JOB_REQ is schema-checked
+(assert_job_req, validator.py:12-25) and reputation-gated
+(validator.py:115-120), the job record is stored in the DHT
+(validator.py:186), workers are polled for stats and best-fit recruited
+one per stage (validator.py:181-296) — but async with request/response
+instead of sleep-polling shared state, and recruitment runs per-stage
+concurrently.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any
+
+from tensorlink_tpu.config import NodeConfig
+from tensorlink_tpu.p2p.node import Node, Peer
+from tensorlink_tpu.roles.jobs import JobRecord, validate_job_request
+from tensorlink_tpu.roles.registry import Registry
+
+
+class ValidatorNode(Node):
+    def __init__(
+        self,
+        cfg: NodeConfig | None = None,
+        registry: Registry | None = None,
+        **kw,
+    ):
+        cfg = cfg or NodeConfig(role="validator")
+        super().__init__(cfg, **kw)
+        self.registry = registry
+        self.jobs: dict[str, JobRecord] = {}
+        self.job_state: dict[str, dict] = {}  # job_id -> {loss, accuracy,...}
+
+    async def start(self) -> None:
+        await super().start()
+        if self.registry is not None:
+            self.registry.register_validator(self.info)
+
+    # ---------------------------------------------------------- handlers
+    def register_handlers(self) -> None:
+        super().register_handlers()
+        self.on("JOB_REQ", self._h_job_req)
+        self.on("JOB_UPDATE", self._h_job_update)
+        self.on("JOB_INFO", self._h_job_info)
+
+    def authorize_peer(self, node_id: str, role: str) -> bool:
+        """Reputation gate (reference: smart_node.py:329-337)."""
+        known = self.dht.get_local(f"rep:{node_id}")
+        return known is None or float(known) > 0.0
+
+    def dht_store_allowed(self, peer, key: str) -> bool:
+        """Job records are written by validators (replication) only; a
+        user's job enters the DHT through the validated JOB_REQ path."""
+        if not super().dht_store_allowed(peer, key):
+            return False
+        if key.startswith("job:"):
+            return peer.role == "validator"
+        return True
+
+    def _workers(self) -> list[Peer]:
+        return [p for p in self.peers.values() if p.role == "worker"]
+
+    async def _poll_worker_stats(self) -> dict[str, dict]:
+        """STATS_REQUEST fanout (reference: request_worker_stats,
+        validator.py:315-321)."""
+        stats: dict[str, dict] = {}
+
+        async def one(p: Peer):
+            try:
+                s = await self.request(p, {"type": "STATS_REQUEST"})
+                stats[p.node_id] = s
+            except (asyncio.TimeoutError, ConnectionError):
+                pass
+
+        await asyncio.gather(*(one(p) for p in self._workers()))
+        return stats
+
+    async def _recruit_stage(
+        self,
+        job: JobRecord,
+        stage_index: int,
+        stats: dict[str, dict],
+        taken: set[str],
+    ) -> dict | None:
+        """Best-fit recruitment with decline fallback (reference:
+        recruit_worker, validator.py:244-296)."""
+        spec = job.stages[stage_index]
+        candidates = sorted(
+            (
+                (nid, s)
+                for nid, s in stats.items()
+                if nid not in taken and s.get("memory", 0) >= spec.param_bytes * 4
+            ),
+            key=lambda kv: kv[1].get("memory", 0),
+        )
+        for nid, s in candidates:
+            peer = self.peers.get(nid)
+            if peer is None:
+                continue
+            try:
+                resp = await self.request(
+                    peer,
+                    {
+                        "type": "JOB_OFFER",
+                        "job_id": job.job_id,
+                        "stage": stage_index,
+                        "param_bytes": spec.param_bytes,
+                        "author": job.author,
+                    },
+                    timeout=3.0,
+                )
+            except (asyncio.TimeoutError, ConnectionError):
+                continue
+            if resp.get("type") == "ACCEPT_JOB":
+                taken.add(nid)
+                return dict(resp["info"], stage=stage_index)
+        return None
+
+    async def _h_job_req(self, node, peer, msg) -> dict:
+        """Validate -> store in DHT -> recruit one worker per stage ->
+        reply ACCEPT_JOB with placements (reference: create_job,
+        validator.py:181-296)."""
+        try:
+            job = validate_job_request(msg["job"])
+        except ValueError as e:
+            return {"type": "DECLINE_JOB", "reason": str(e)}
+        if job.author != peer.node_id:
+            return {"type": "DECLINE_JOB", "reason": "author mismatch"}
+        if peer.reputation <= 0.0:
+            return {"type": "DECLINE_JOB", "reason": "reputation"}
+
+        stats = await self._poll_worker_stats()
+        taken: set[str] = set()
+        placements: list[dict | None] = []
+        for i in range(job.n_stages):  # sequential: taken-set must grow
+            placements.append(await self._recruit_stage(job, i, stats, taken))
+        if any(p is None for p in placements):
+            return {
+                "type": "DECLINE_JOB",
+                "reason": f"could not place stages "
+                f"{[i for i, p in enumerate(placements) if p is None]}",
+            }
+        job.workers = placements
+        self.jobs[job.job_id] = job
+        self.job_state[job.job_id] = {"created": time.time(), "updates": 0}
+        await self.dht_store(f"job:{job.job_id}", job.to_wire())
+        return {"type": "ACCEPT_JOB", "job_id": job.job_id, "workers": placements}
+
+    async def _h_job_update(self, node, peer, msg) -> dict:
+        """Loss/accuracy aggregation (reference stubs this:
+        validator.py:329-331)."""
+        jid = str(msg["job_id"])
+        st = self.job_state.setdefault(jid, {"updates": 0})
+        for k in ("loss", "accuracy", "step"):
+            if k in msg:
+                st[k] = msg[k]
+        st["updates"] += 1
+        st["last_update"] = time.time()
+        return {"type": "JOB_UPDATED"}
+
+    async def _h_job_info(self, node, peer, msg) -> dict:
+        jid = str(msg["job_id"])
+        job = self.jobs.get(jid)
+        if job is None:
+            wire = await self.dht_query(f"job:{jid}")
+            if wire is None:
+                return {"type": "ERROR", "error": "unknown job"}
+            return {"type": "JOB", "job": wire, "state": self.job_state.get(jid, {})}
+        return {
+            "type": "JOB",
+            "job": job.to_wire(),
+            "state": self.job_state.get(jid, {}),
+        }

@@ -1,0 +1,325 @@
+"""Worker: compute provider binding local TPU capacity.
+
+Re-design of src/roles/worker.py. Differences that matter on TPU:
+
+- MODULE arrives as a *spec + weights blob* (worker.py:210-231 unpickles a
+  live nn.Module); the worker rebuilds the module locally and jit-compiles
+  forward and a rematerializing backward once per stage.
+- The train loop is not a polling thread (worker.py:295-350); FORWARD /
+  BACKWARD are async handlers that run the jitted programs and relay to
+  the next hop.
+- Capacity self-report uses device memory stats + host RAM instead of the
+  1.37 GB CPU constant (model_analyzer.py:24-27).
+- The optimizer steps AFTER gradients apply (the reference zeroed grads
+  before stepping, worker.py:320-321, losing every update).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorlink_tpu.config import NodeConfig
+from tensorlink_tpu.nn.module import Module, module_from_config
+from tensorlink_tpu.p2p.dht import PeerInfo
+from tensorlink_tpu.p2p.node import Node, Peer
+from tensorlink_tpu.p2p.serialization import (
+    pack_arrays,
+    tree_flatten_arrays,
+    tree_unflatten_arrays,
+    unpack_arrays,
+)
+from tensorlink_tpu.runtime.mesh import local_device_info
+from tensorlink_tpu.train.optim import apply_updates, make_optimizer
+from tensorlink_tpu.utils.trees import tree_bytes
+
+
+def host_free_memory_bytes() -> int:
+    try:
+        import psutil
+
+        return psutil.virtual_memory().available
+    except ImportError:  # pragma: no cover
+        return 1 << 30
+
+
+@dataclass
+class StageRunner:
+    """One loaded pipeline stage: jitted forward + rematerializing
+    backward + local optimizer state. Gradient accumulation is guarded by
+    a lock — concurrent BACKWARD handlers run in worker threads."""
+
+    job_id: str
+    stage_index: int
+    module: Module
+    params: Any
+    opt: Any
+    opt_state: Any
+    step: int = 0
+    inputs: dict = field(default_factory=dict)  # (step, micro) -> activation
+    grad_accum: Any = None
+    micro_seen: int = 0
+
+    def __post_init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        mod = self.module
+        self._fwd = jax.jit(lambda p, x: mod.apply(p, x))
+
+        def bwd(p, x, g):
+            out, vjp = jax.vjp(lambda pp, xx: mod.apply(pp, xx), p, x)
+            gp, gx = vjp(g)
+            return gp, gx
+
+        self._bwd = jax.jit(bwd)
+
+    def forward(self, step: int, micro: int, x: np.ndarray) -> np.ndarray:
+        xj = jnp.asarray(x)
+        with self._lock:
+            self.inputs[(step, micro)] = xj
+        return np.asarray(self._fwd(self.params, xj))
+
+    def backward(self, step: int, micro: int, g: np.ndarray) -> np.ndarray:
+        with self._lock:
+            xj = self.inputs.pop((step, micro))
+        gp, gx = self._bwd(self.params, xj, jnp.asarray(g))
+        with self._lock:
+            if self.grad_accum is None:
+                self.grad_accum = gp
+            else:
+                self.grad_accum = jax.tree.map(jnp.add, self.grad_accum, gp)
+            self.micro_seen += 1
+        return np.asarray(gx)
+
+    def apply_step(self) -> None:
+        with self._lock:
+            if self.grad_accum is None:
+                return
+            grads, n = self.grad_accum, max(self.micro_seen, 1)
+            self.grad_accum = None
+            self.micro_seen = 0
+        grads = jax.tree.map(lambda g: g / n, grads)
+        updates, self.opt_state = self.opt.update(
+            grads, self.opt_state, self.params, self.step
+        )
+        self.params = apply_updates(self.params, updates)
+        self.step += 1
+
+
+class WorkerNode(Node):
+    """Handles: STATS_REQUEST, JOB_OFFER, MODULE_SPEC, FORWARD, BACKWARD,
+    STEP_END, PARAMS_REQUEST, POL_CHALLENGE (see pol.py)."""
+
+    RESERVATION_TTL_S = 120.0
+
+    def __init__(self, cfg: NodeConfig | None = None, **kw):
+        cfg = cfg or NodeConfig(role="worker")
+        super().__init__(cfg, **kw)
+        self.stages: dict[tuple[str, int], StageRunner] = {}
+        # (job_id, stage) -> (bytes, expires_at); converted to a live stage
+        # by MODULE_SPEC, or expired — never leaked (review finding).
+        self._reservations: dict[tuple[str, int], tuple[int, float]] = {}
+        self.training = False
+
+    @property
+    def reserved_bytes(self) -> int:
+        now = time.time()
+        self._reservations = {
+            k: v for k, v in self._reservations.items() if v[1] > now
+        }
+        return sum(b for b, _ in self._reservations.values())
+
+    @reserved_bytes.setter
+    def reserved_bytes(self, value: int) -> None:
+        # test/diagnostic hook: a blanket reservation that never expires
+        self._reservations[("__manual__", -1)] = (value, float("inf"))
+
+    # ---------------------------------------------------------- handlers
+    def register_handlers(self) -> None:
+        super().register_handlers()
+        self.on("STATS_REQUEST", self._h_stats)
+        self.on("JOB_OFFER", self._h_job_offer)
+        self.on("MODULE_SPEC", self._h_module_spec)
+        self.on("FORWARD", self._h_forward)
+        self.on("BACKWARD", self._h_backward)
+        self.on("STEP_END", self._h_step_end)
+        self.on("PARAMS_REQUEST", self._h_params_request)
+        self.on("POL_CHALLENGE", self._h_pol_challenge)
+        self.on("UNLOAD", self._h_unload)
+
+    def capacity_bytes(self) -> int:
+        dev_free = 0
+        for d in local_device_info():
+            if d["bytes_limit"]:
+                dev_free += d["bytes_limit"] - (d["bytes_in_use"] or 0)
+        cap = dev_free or host_free_memory_bytes() // 2
+        return max(cap - self.reserved_bytes, 0)
+
+    async def _h_stats(self, node, peer, msg) -> dict:
+        """Self-report (reference: worker.py:363-381)."""
+        return {
+            "type": "STATS",
+            "node_id": self.node_id,
+            "role": self.role,
+            "memory": self.capacity_bytes(),
+            "devices": local_device_info(),
+            "training": self.training,
+            "stages_loaded": len(self.stages),
+        }
+
+    async def _h_job_offer(self, node, peer, msg) -> dict:
+        """Accept/decline by free memory (reference: worker.py:164-188).
+        Memory bound = params + grads + 2x Adam state + activation slack."""
+        need = int(msg["param_bytes"]) * 4 + (64 << 20)
+        if need <= self.capacity_bytes():
+            self._reservations[(str(msg["job_id"]), int(msg["stage"]))] = (
+                need,
+                time.time() + self.RESERVATION_TTL_S,
+            )
+            return {
+                "type": "ACCEPT_JOB",
+                "job_id": msg["job_id"],
+                "stage": msg["stage"],
+                "info": self.info.to_wire(),
+            }
+        return {"type": "DECLINE_JOB", "job_id": msg["job_id"], "stage": msg["stage"]}
+
+    async def _h_module_spec(self, node, peer, msg) -> dict:
+        """Build the stage from spec + weights; jit; ack LOADED."""
+        # reservation becomes a live stage (its memory is now real)
+        self._reservations.pop((str(msg["job_id"]), int(msg["stage"])), None)
+        module = module_from_config(msg["module_config"])
+        flat = unpack_arrays(msg["weights"])
+        params = jax.tree.map(jnp.asarray, tree_unflatten_arrays(flat))
+        train = msg.get("train", {})
+        opt = make_optimizer(
+            train.get("optimizer", "adam"),
+            float(train.get("learning_rate", 1e-3)),
+            float(train.get("weight_decay", 0.0)),
+        )
+        runner = StageRunner(
+            job_id=str(msg["job_id"]),
+            stage_index=int(msg["stage"]),
+            module=module,
+            params=params,
+            opt=opt,
+            opt_state=opt.init(params),
+        )
+        self.stages[(runner.job_id, runner.stage_index)] = runner
+        self.training = True
+        return {
+            "type": "LOADED",
+            "job_id": runner.job_id,
+            "stage": runner.stage_index,
+            "param_bytes": tree_bytes(params),
+        }
+
+    async def _h_forward(self, node, peer, msg) -> dict | None:
+        """Run the stage and return the activation to the requester
+        (hub-and-spoke: the master drives the chain, reference §3.2).
+        Tensor payloads ride the typed-array codec — this is the DCN hop
+        between hosts; intra-host stage chains stay on the XLA mesh.
+        """
+        key = (str(msg["job_id"]), int(msg["stage"]))
+        runner = self.stages.get(key)
+        if runner is None:
+            return {"type": "ERROR", "error": f"no stage {key}"}
+        x = unpack_arrays(msg["data"])["x"]
+        out = await asyncio.to_thread(
+            runner.forward, int(msg["step"]), int(msg["micro"]), x
+        )
+        reply = {
+            "type": "ACTIVATION",
+            "job_id": msg["job_id"],
+            "stage": msg["stage"],
+            "step": msg["step"],
+            "micro": msg["micro"],
+            "data": pack_arrays({"x": out}),
+        }
+        return reply
+
+    async def _h_backward(self, node, peer, msg) -> dict | None:
+        key = (str(msg["job_id"]), int(msg["stage"]))
+        runner = self.stages.get(key)
+        if runner is None:
+            return {"type": "ERROR", "error": f"no stage {key}"}
+        g = unpack_arrays(msg["data"])["g"]
+        gx = await asyncio.to_thread(
+            runner.backward, int(msg["step"]), int(msg["micro"]), g
+        )
+        return {
+            "type": "INPUT_GRAD",
+            "job_id": msg["job_id"],
+            "stage": msg["stage"],
+            "step": msg["step"],
+            "micro": msg["micro"],
+            "data": pack_arrays({"g": gx}),
+        }
+
+    async def _h_step_end(self, node, peer, msg) -> dict:
+        """All micro-grads in: optimizer step (correctly: step, no
+        pre-zeroing — contrast worker.py:320-321)."""
+        key = (str(msg["job_id"]), int(msg["stage"]))
+        runner = self.stages.get(key)
+        if runner is None:
+            return {"type": "ERROR", "error": f"no stage {key}"}
+        await asyncio.to_thread(runner.apply_step)
+        return {"type": "STEPPED", "step": runner.step}
+
+    async def _h_params_request(self, node, peer, msg) -> dict:
+        """Return current stage params (reference: send_parameters,
+        torch_node.py:148-157)."""
+        key = (str(msg["job_id"]), int(msg["stage"]))
+        runner = self.stages.get(key)
+        if runner is None:
+            return {"type": "ERROR", "error": f"no stage {key}"}
+        flat = tree_flatten_arrays(jax.tree.map(np.asarray, runner.params))
+        return {
+            "type": "PARAMETERS",
+            "job_id": msg["job_id"],
+            "stage": msg["stage"],
+            "step": runner.step,
+            "weights": pack_arrays(flat),
+        }
+
+    async def _h_unload(self, node, peer, msg) -> dict:
+        """Free a finished job's stages + any reservation (job teardown;
+        the reference had no teardown at all)."""
+        jid = str(msg["job_id"])
+        removed = [k for k in self.stages if k[0] == jid]
+        for k in removed:
+            del self.stages[k]
+        self._reservations = {
+            k: v for k, v in self._reservations.items() if k[0] != jid
+        }
+        self.training = bool(self.stages)
+        return {"type": "UNLOADED", "job_id": jid, "stages": len(removed)}
+
+    async def _h_pol_challenge(self, node, peer, msg) -> dict:
+        """Deterministic re-execution: run our stage on the challenger's
+        input and return the output digest (whitepaper PoL made real —
+        XLA programs are deterministic for a fixed compiled binary)."""
+        import hashlib
+
+        key = (str(msg["job_id"]), int(msg["stage"]))
+        runner = self.stages.get(key)
+        if runner is None:
+            return {"type": "ERROR", "error": f"no stage {key}"}
+        x = unpack_arrays(msg["data"])["x"]
+        out = await asyncio.to_thread(
+            lambda: np.asarray(runner._fwd(runner.params, jnp.asarray(x)))
+        )
+        return {
+            "type": "POL_PROOF",
+            "job_id": msg["job_id"],
+            "stage": msg["stage"],
+            "digest": hashlib.sha256(np.ascontiguousarray(out).tobytes()).hexdigest(),
+            "output_sum": float(out.sum()),
+        }

@@ -42,6 +42,28 @@ def rng():
     return np.random.default_rng(0)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "asyncio: async test executed via asyncio.run"
+    )
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run `async def` tests with asyncio.run (no pytest-asyncio in env)."""
+    import asyncio
+    import inspect
+
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            n: pyfuncitem.funcargs[n]
+            for n in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
 # Shared toy-problem helpers (used by test_train.py and test_parallel.py).
 
 

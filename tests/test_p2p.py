@@ -1,0 +1,205 @@
+"""Control plane: serialization, crypto, framed transport, node, DHT."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from tensorlink_tpu.config import NodeConfig
+from tensorlink_tpu.p2p.crypto import Identity
+from tensorlink_tpu.p2p.dht import DHT, PeerInfo, RoutingTable, xor_distance
+from tensorlink_tpu.p2p.node import Node
+from tensorlink_tpu.p2p.serialization import (
+    decode_message,
+    encode_message,
+    pack_arrays,
+    tree_flatten_arrays,
+    tree_unflatten_arrays,
+    unpack_arrays,
+)
+
+
+# ------------------------------------------------------------ serialization
+
+
+def test_message_roundtrip():
+    msg = {"type": "JOB_REQ", "n": 3, "blob": b"\x00\x01", "nested": {"a": [1, 2]}}
+    assert decode_message(encode_message(msg)) == msg
+
+
+def test_message_requires_type():
+    with pytest.raises(ValueError):
+        encode_message({"payload": 1})
+    with pytest.raises(ValueError):
+        decode_message(encode_message({"type": "X"})[:-1] + b"\xff")
+
+
+@pytest.mark.parametrize("codec", ["none", "zlib", "zstd"])
+def test_array_pack_roundtrip(codec):
+    arrays = {
+        "w": np.random.default_rng(0).normal(size=(16, 8)).astype(np.float32),
+        "b": np.arange(5, dtype=np.int32),
+        "bf16": np.ones((4, 4), np.float16),
+    }
+    out = unpack_arrays(pack_arrays(arrays, codec=codec))
+    assert set(out) == set(arrays)
+    for k in arrays:
+        np.testing.assert_array_equal(out[k], arrays[k])
+
+
+def test_tree_flatten_roundtrip():
+    tree = {
+        "seq": {"0": {"w": np.ones((2, 2)), "b": np.zeros(2)}, "1": {}},
+        "head": {"w": np.full((3,), 7.0)},
+    }
+    flat = tree_flatten_arrays(tree)
+    back = tree_unflatten_arrays(flat)
+    assert back["seq"]["1"] == {}
+    np.testing.assert_array_equal(back["seq"]["0"]["w"], tree["seq"]["0"]["w"])
+    np.testing.assert_array_equal(back["head"]["w"], tree["head"]["w"])
+
+
+def test_no_pickle_on_wire():
+    """Arbitrary objects must NOT serialize (the reference pickled
+    nn.Modules onto the socket; we refuse by construction)."""
+
+    class Evil:
+        pass
+
+    with pytest.raises(TypeError):
+        encode_message({"type": "X", "obj": Evil()})
+
+
+# ------------------------------------------------------------ crypto
+
+
+def test_identity_sign_verify():
+    a, b = Identity.generate(), Identity.generate()
+    data = b"challenge"
+    sig = a.sign(data)
+    assert Identity.verify(a.public_der, sig, data)
+    assert not Identity.verify(b.public_der, sig, data)
+    assert not Identity.verify(a.public_der, sig, b"other")
+    assert a.node_id != b.node_id and len(a.node_id) == 64
+
+
+def test_identity_persistence(tmp_path):
+    a = Identity.load_or_generate(tmp_path, "worker")
+    b = Identity.load_or_generate(tmp_path, "worker")
+    assert a.node_id == b.node_id
+    c = Identity.load_or_generate(tmp_path, "validator")
+    assert c.node_id != a.node_id
+
+
+# ------------------------------------------------------------ DHT structures
+
+
+def test_routing_table_closest():
+    rt = RoutingTable("a" * 64)
+    ids = [f"{i:064x}" for i in range(1, 30)]
+    for i in ids:
+        rt.add(PeerInfo(node_id=i, role="worker", host="h", port=1))
+    close = rt.closest(ids[5], k=3)
+    assert close[0].node_id == ids[5]
+    assert len(close) == 3
+    close_ex = rt.closest(ids[5], k=3, exclude={ids[5]})
+    assert close_ex[0].node_id != ids[5]
+
+
+def test_dht_store_separate_from_peers():
+    dht = DHT("a" * 64)
+    dht.table.add(PeerInfo(node_id="b" * 64, role="validator", host="h", port=1))
+    dht.put_local("job1", {"x": 1})
+    assert dht.delete_local("job1")
+    assert len(dht.table) == 1  # deleting values never evicts peers
+    snap = dht.snapshot()
+    dht2 = DHT("c" * 64)
+    dht2.restore(snap)
+    assert len(dht2.table) == 1
+
+
+# ------------------------------------------------------------ live nodes
+
+
+def _cfg(role="worker"):
+    return NodeConfig(role=role, host="127.0.0.1", port=0)
+
+
+async def _start_nodes(*roles):
+    nodes = [Node(_cfg(r)) for r in roles]
+    for n in nodes:
+        await n.start()
+    return nodes
+
+
+@pytest.mark.asyncio
+async def test_handshake_and_ping():
+    a, b = await _start_nodes("user", "validator")
+    peer_b = await a.connect("127.0.0.1", b.port)
+    assert peer_b.role == "validator"
+    ms = await a.ping(peer_b)
+    assert ms >= 0
+    await asyncio.sleep(0.05)
+    assert a.node_id in b.peers  # mutual registration
+    await a.stop(); await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_dht_store_query_across_nodes():
+    a, b, c = await _start_nodes("validator", "validator", "user")
+    pb = await a.connect("127.0.0.1", b.port)
+    await a.dht_store("job:42", {"author": "me", "size": 3})
+    # c connects only to a and queries through it
+    pa = await c.connect("127.0.0.1", a.port)
+    val = await c.dht_query("job:42")
+    assert val == {"author": "me", "size": 3}
+    missing = await c.dht_query("job:nope")
+    assert missing is None
+    for n in (a, b, c):
+        await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_ghost_accounting_and_reputation():
+    a, b = await _start_nodes("worker", "worker")
+    peer = await a.connect("127.0.0.1", b.port)
+    await asyncio.sleep(0.05)
+    # send garbage type: b should count a ghost against a
+    await a.send(peer, {"type": "NO_SUCH_TYPE"})
+    await asyncio.sleep(0.1)
+    bp = b.peers[a.node_id]
+    assert bp.ghosts == 1 and bp.reputation < 1.0
+    await a.stop(); await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_peer_discovery():
+    a, b, c = await _start_nodes("validator", "worker", "worker")
+    await b.connect("127.0.0.1", a.port)
+    pc_a = await c.connect("127.0.0.1", a.port)
+    await asyncio.sleep(0.05)
+    infos = await c.discover_peers(pc_a)
+    ids = {i.node_id for i in infos}
+    assert b.node_id in ids
+    await a.stop(); await b.stop(); await c.stop()
+
+
+@pytest.mark.asyncio
+async def test_request_timeout():
+    a, b = await _start_nodes("worker", "worker")
+    peer = await a.connect("127.0.0.1", b.port)
+
+    async def slow(node, p, msg):
+        await asyncio.sleep(1.0)
+        return {"type": "LATE"}
+
+    b.on("SLOW", slow)
+    with pytest.raises(asyncio.TimeoutError):
+        await a.request(peer, {"type": "SLOW"}, timeout=0.1)
+    await a.stop(); await b.stop()
+
+
+def test_status_snapshot():
+    n = Node(_cfg("validator"))
+    s = n.status()
+    assert s["role"] == "validator" and s["dht_keys"] == 0
