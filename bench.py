@@ -1,0 +1,133 @@
+"""Headline benchmark: BERT-base fine-tune throughput (samples/sec/chip).
+
+The reference's implied e2e workload is a BERT-base sequence-classification
+fine-tune (tests/ml/test_full_train.py:56-179 — batch 1, seq 100, Adam) for
+which it publishes no numbers (BASELINE.md). We run the same workload shape
+TPU-natively: bf16 compute, jit train step, K steps chained inside one
+device program (lax.scan) so host/tunnel dispatch overhead is amortized.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline compares against the round-1 recorded value in BASELINE.md
+(1.0 when no prior recording exists).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorlink_tpu.config import TrainConfig
+from tensorlink_tpu.models.bert import BertClassifier, BertConfig
+from tensorlink_tpu.train.optim import apply_updates, make_optimizer
+from tensorlink_tpu.train.trainer import TrainState, softmax_cross_entropy
+
+BATCH = 32
+SEQ = 128
+CLASSES = 3
+STEPS_PER_CALL = 10
+MEASURE_CALLS = 3
+
+
+def build():
+    cfg = BertConfig.base()
+    model = BertClassifier(cfg, num_classes=CLASSES)
+    params = model.init(jax.random.key(0))
+    opt = make_optimizer("adam", 2e-5)
+    state = TrainState.create(params, opt)
+
+    r = np.random.default_rng(0)
+    batch = {
+        "input_ids": jnp.asarray(r.integers(0, cfg.vocab_size, (BATCH, SEQ))),
+        "attention_mask": jnp.ones((BATCH, SEQ), jnp.int32),
+        "labels": jnp.asarray(r.integers(0, CLASSES, (BATCH,))),
+    }
+
+    def cast(p):
+        return jax.tree.map(
+            lambda x: x.astype(jnp.bfloat16)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            p,
+        )
+
+    def loss_fn(params, batch):
+        logits = model.apply(
+            cast(params), batch["input_ids"], attention_mask=batch["attention_mask"]
+        )
+        return softmax_cross_entropy(logits, batch["labels"])
+
+    def one_step(state: TrainState, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        updates, opt_state = opt.update(grads, state.opt_state, state.params, state.step)
+        return (
+            TrainState(
+                params=apply_updates(state.params, updates),
+                opt_state=opt_state,
+                step=state.step + 1,
+            ),
+            loss,
+        )
+
+    @jax.jit
+    def multi_step(state, batch):
+        def body(s, _):
+            s, loss = one_step(s, batch)
+            return s, loss
+
+        state, losses = jax.lax.scan(body, state, None, length=STEPS_PER_CALL)
+        return state, losses
+
+    return state, batch, multi_step
+
+
+def read_recorded_baseline() -> float | None:
+    """First recorded samples/sec/chip in BASELINE.md, if any."""
+    p = Path(__file__).parent / "BASELINE.md"
+    if not p.exists():
+        return None
+    m = re.search(r"recorded_samples_per_sec_per_chip:\s*([0-9.]+)", p.read_text())
+    return float(m.group(1)) if m else None
+
+
+def main() -> None:
+    state, batch, multi_step = build()
+    # compile + warmup; the trailing float() is a device->host read that
+    # REALLY synchronizes (block_until_ready alone does not drain the
+    # async dispatch queue on tunneled TPU runtimes)
+    state, losses = multi_step(state, batch)
+    float(losses[-1])
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_CALLS):
+        state, losses = multi_step(state, batch)
+    float(losses[-1])
+    dt = time.perf_counter() - t0
+
+    n_steps = MEASURE_CALLS * STEPS_PER_CALL
+    # the un-sharded jit step runs on exactly one chip regardless of how
+    # many the host exposes
+    chips = 1
+    samples_per_sec_per_chip = BATCH * n_steps / dt / chips
+    base = read_recorded_baseline()
+    vs = samples_per_sec_per_chip / base if base else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "samples/sec/chip (BERT-base fine-tune, batch 32, seq 128, bf16)",
+                "value": round(samples_per_sec_per_chip, 2),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

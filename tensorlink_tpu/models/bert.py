@@ -103,6 +103,61 @@ class Bert(Module):
         return {"last_hidden_state": h, "pooled": pooled}
 
 
+def bert_pipeline_parts(model: "Bert", params: dict, num_classes_head=None):
+    """Split a Bert (or BertClassifier param tree) into pipeline parts.
+    If ``num_classes_head`` is given, params must be a BertClassifier tree
+    and the head produces classification logits from [CLS]."""
+    from tensorlink_tpu.parallel.engine import PipelineParts
+
+    bert = model
+    bp = params if num_classes_head is None else params["bert"]
+    stack = bert.children["encoder"]
+    block = stack.blocks()[0]
+
+    def embed_fn(emb_params, batch):
+        ids = batch["input_ids"]
+        T = ids.shape[1]
+        pos = jnp.arange(T)[None, :]
+        tt = batch.get("token_type_ids")
+        tt = jnp.zeros_like(ids) if tt is None else tt
+        x = (
+            bert.children["tok_emb"].apply(emb_params["tok_emb"], ids)
+            + bert.children["pos_emb"].apply(emb_params["pos_emb"], pos)
+            + bert.children["type_emb"].apply(emb_params["type_emb"], tt)
+        )
+        return bert.children["emb_norm"].apply(emb_params["emb_norm"], x)
+
+    if num_classes_head is not None:
+        def head_fn(all_params, x, batch):
+            pooled = jnp.tanh(
+                bert.children["pooler"].apply(all_params["head"]["pooler"], x[:, 0])
+            )
+            hw = all_params["head"]["cls"]
+            return pooled @ hw["w"].astype(pooled.dtype) + hw["b"].astype(pooled.dtype)
+
+        head_params = {"pooler": bp["pooler"], "cls": params["head"]}
+    else:
+        def head_fn(all_params, x, batch):
+            return x  # last_hidden_state
+
+        head_params = {"pooler": bp["pooler"]}
+
+    return PipelineParts(
+        embed_fn=embed_fn,
+        block=block,
+        block_params=bp["encoder"],
+        block_fn=lambda blk_p, x: block.apply(blk_p, x),
+        head_fn=head_fn,
+        embed_params={
+            "tok_emb": bp["tok_emb"],
+            "pos_emb": bp["pos_emb"],
+            "type_emb": bp["type_emb"],
+            "emb_norm": bp["emb_norm"],
+        },
+        head_params=head_params,
+    )
+
+
 class BertClassifier(Module):
     """BertForSequenceClassification equivalent — the reference's e2e
     fine-tune workload (tests/ml/test_full_train.py:75)."""

@@ -101,6 +101,38 @@ class GPT2(Module):
             return out, new_caches
         return out
 
+    def as_pipeline_parts(self, params):
+        """Split into (embed, blocks, head) for the ShardedTrainer.
+        The LM head stays tied to wte (head_fn sees all params)."""
+        from tensorlink_tpu.parallel.engine import PipelineParts
+
+        stack = self.children["blocks"]
+        block = stack.blocks()[0]
+        wte, wpe = self.children["wte"], self.children["wpe"]
+        ln_f = self.children["ln_f"]
+
+        def embed_fn(emb_params, batch):
+            ids = batch["input_ids"]
+            T = ids.shape[1]
+            pos = jnp.arange(T)[None, :]
+            return wte.apply(emb_params["wte"], ids) + wpe.apply(
+                emb_params["wpe"], pos
+            ).astype(wte.apply(emb_params["wte"], ids).dtype)
+
+        def head_fn(all_params, x, batch):
+            h = ln_f.apply(all_params["head"]["ln_f"], x)
+            return wte.attend(all_params["embed"]["wte"], h)
+
+        return PipelineParts(
+            embed_fn=embed_fn,
+            block=block,
+            block_params=params["blocks"],
+            block_fn=lambda bp, x: block.apply(bp, x),
+            head_fn=head_fn,
+            embed_params={"wte": params["wte"], "wpe": params["wpe"]},
+            head_params={"ln_f": params["ln_f"]},
+        )
+
     def init_caches(self, batch: int, max_len: int, dtype=jnp.bfloat16):
         stack = self.children["blocks"]
         return [

@@ -236,7 +236,23 @@ class Node:
     async def _recv_loop(self, peer: Peer) -> None:
         try:
             while True:
-                raw = await peer.stream.recv()
+                try:
+                    raw = await peer.stream.recv()
+                except (
+                    asyncio.IncompleteReadError,
+                    ConnectionError,
+                    asyncio.CancelledError,
+                ):
+                    break
+                except Exception as e:  # corrupt frame: bad flag byte,
+                    # zstd/zlib decompress failure — framing is lost, the
+                    # stream cannot resync; penalize and drop.
+                    peer.ghosts += 1
+                    self._penalize(peer)
+                    self.log.warning(
+                        "corrupt frame from %s: %s", peer.node_id[:8], e
+                    )
+                    break
                 try:
                     msg = decode_message(raw)
                 except ValueError:
@@ -245,8 +261,6 @@ class Node:
                     continue
                 peer.msgs_in += 1
                 self._spawn(self._dispatch(peer, msg))
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
-            pass
         finally:
             self._drop_peer(peer)
 

@@ -86,7 +86,9 @@ def pack_arrays(
         arr = np.ascontiguousarray(arr)
         raw = arr.tobytes()
         manifest["tensors"][name] = {
-            "dtype": arr.dtype.str,
+            # dtype by NAME: ml_dtypes types (bfloat16, float8_*) have
+            # dtype.str '<V2' which does not survive a round-trip
+            "dtype": arr.dtype.name,
             "shape": list(arr.shape),
             "offset": offset,
             "nbytes": len(raw),
@@ -98,6 +100,15 @@ def pack_arrays(
     return MAGIC + len(head).to_bytes(4, "big") + head + body
 
 
+def _dtype_by_name(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # bfloat16 / float8 family
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def unpack_arrays(data: bytes) -> dict[str, np.ndarray]:
     if data[:4] != MAGIC:
         raise ValueError("bad array blob magic")
@@ -107,7 +118,7 @@ def unpack_arrays(data: bytes) -> dict[str, np.ndarray]:
     out = {}
     for name, meta in manifest["tensors"].items():
         raw = body[meta["offset"] : meta["offset"] + meta["nbytes"]]
-        out[name] = np.frombuffer(raw, dtype=np.dtype(meta["dtype"])).reshape(
+        out[name] = np.frombuffer(raw, dtype=_dtype_by_name(meta["dtype"])).reshape(
             meta["shape"]
         )
     return out

@@ -1,0 +1,225 @@
+"""ShardedTrainer: one jit-compiled train step over the whole mesh.
+
+This is the bridge between a job's stage placement and the data plane —
+the TPU answer to DistributedModel's thread-and-socket forward/backward
+(src/ml/distributed.py:79-197). A model is split into
+(embed, N homogeneous blocks, head); blocks are stacked on a [S, L/S, ...]
+leading axis and sharded over ``pipe``; embed/head params live on the mesh
+replicated (or TP-sharded by their own specs); the whole
+fwd+loss+bwd+optimizer step is ONE XLA program:
+
+- micro-batches stream through the Pipeline's ppermute schedule,
+- the ``data`` axis shards the micro-batch dimension (DP),
+- the ``model`` axis shards weight matrices by each layer's PartitionSpec
+  (TP) inside every stage,
+- gradient allreduce over ``data`` and TP collectives over ``model`` are
+  inserted by the SPMD partitioner.
+
+So the reference's entire L3+L4 hot path (FORWARD/BACKWARD messages,
+per-micro threads, busy-waits) compiles down to ICI collectives inside a
+single program launch per step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensorlink_tpu.config import TrainConfig
+from tensorlink_tpu.nn.module import Module
+from tensorlink_tpu.parallel.pp import Pipeline, stack_stage_params
+from tensorlink_tpu.runtime.metrics import pipeline_bubble_fraction
+from tensorlink_tpu.train.optim import (
+    apply_updates,
+    clip_by_global_norm,
+    make_optimizer,
+    make_schedule,
+)
+from tensorlink_tpu.train.trainer import TrainState
+
+
+@dataclasses.dataclass
+class PipelineParts:
+    """Model split for the engine. ``head_fn(params, x, batch)`` returns
+    the final output (sees ALL params so weight tying works)."""
+
+    embed_fn: Callable[[Any, Any], jax.Array]  # (params, batch) -> [B, ...]
+    block: Module  # homogeneous block (for specs)
+    block_params: dict  # {"0": ..., "L-1": ...}
+    block_fn: Callable[[Any, jax.Array], jax.Array]
+    head_fn: Callable[[Any, jax.Array, Any], jax.Array]
+    embed_params: Any
+    head_params: Any
+
+
+def _stacked_spec(block: Module, num_stages: int, model_axis="model"):
+    """Per-block PartitionSpec tree -> stacked [pipe, layer, ...] specs."""
+    spec = block.param_spec(model_axis)
+    return jax.tree.map(
+        lambda s: P("pipe", None, *s),
+        spec,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+class ShardedTrainer:
+    """Builds the fully sharded train/eval steps for one mesh + model."""
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        cfg: TrainConfig,
+        parts: PipelineParts,
+        loss_fn: Callable[[jax.Array, Any], jax.Array],
+        embed_module: Module | None = None,
+        head_module: Module | None = None,
+    ):
+        self.mesh = mesh
+        self.cfg = cfg
+        self.parts = parts
+        self.loss_fn = loss_fn
+        self.num_stages = mesh.shape["pipe"]
+        L = len(parts.block_params)
+        if L % self.num_stages:
+            raise ValueError(f"{L} blocks not divisible by pipe={self.num_stages}")
+        self.layers_per_stage = L // self.num_stages
+        block_fn = parts.block_fn
+        if cfg.remat:
+            block_fn = jax.checkpoint(block_fn)
+        self.pipeline = Pipeline(
+            mesh, block_fn, self.num_stages, self.layers_per_stage
+        )
+        sched = make_schedule(
+            cfg.schedule, cfg.learning_rate, cfg.warmup_steps, cfg.total_steps
+        )
+        self.optimizer = make_optimizer(cfg.optimizer, sched, cfg.weight_decay)
+        self.compute_dtype = jnp.dtype(cfg.dtype)
+
+        # shardings ----------------------------------------------------
+        stacked_specs = _stacked_spec(parts.block, self.num_stages)
+        embed_specs = (
+            embed_module.param_spec() if embed_module is not None
+            else jax.tree.map(lambda _: P(), parts.embed_params)
+        )
+        head_specs = (
+            head_module.param_spec() if head_module is not None
+            else jax.tree.map(lambda _: P(), parts.head_params)
+        )
+        self.param_specs = {
+            "embed": embed_specs,
+            "stages": stacked_specs,
+            "head": head_specs,
+        }
+        self._param_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            self.param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self._repl = NamedSharding(mesh, P())
+        self._batch_sh = NamedSharding(mesh, P(("data",)))
+        self._state_shardings = None  # set in init_state
+        self._step_fn = None
+        self._eval_fn = None
+        # Dropout needs rng threading through the pipeline schedule, which
+        # the engine does not do yet — fail loudly rather than silently
+        # training without regularization.
+        if getattr(parts.block, "dropout", 0.0):
+            raise ValueError(
+                "ShardedTrainer does not support dropout>0 yet; build the "
+                "model with dropout=0.0 (pretraining default) or use the "
+                "single-host Trainer"
+            )
+
+    # -- state -----------------------------------------------------------
+    def init_state(self) -> TrainState:
+        params = {
+            "embed": self.parts.embed_params,
+            "stages": stack_stage_params(self.parts.block_params, self.num_stages),
+            "head": self.parts.head_params,
+        }
+        params = jax.tree.map(
+            lambda p, s: jax.device_put(p, s), params, self._param_shardings
+        )
+        opt_state = self.optimizer.init(params)
+        opt_state = jax.device_put(opt_state, self._opt_shardings(opt_state))
+        return TrainState(
+            params=params, opt_state=opt_state, step=jnp.zeros((), jnp.int32)
+        )
+
+    def _opt_shardings(self, opt_state):
+        """Optimizer moments shard exactly like their params (free
+        ZeRO-style sharding over pipe/model)."""
+        return {
+            k: self._param_shardings if isinstance(v, dict) else self._repl
+            for k, v in opt_state.items()
+        }
+
+    # -- step ------------------------------------------------------------
+    def _cast(self, params):
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            params,
+        )
+
+    def _loss(self, params, batch, rng):
+        cfg = self.cfg
+        cast = self._cast(params)
+        x = self.parts.embed_fn(cast["embed"], batch)  # [B, ...]
+        B = x.shape[0]
+        m = cfg.micro_batches
+        if B % m:
+            raise ValueError(f"batch {B} not divisible by micro_batches {m}")
+        xs = x.reshape(m, B // m, *x.shape[1:])
+        ys = self.pipeline(cast["stages"], xs)
+        y = ys.reshape(B, *ys.shape[2:])
+        out = self.parts.head_fn(cast, y, batch)
+        return self.loss_fn(out, batch)
+
+    def _step(self, state: TrainState, batch, rng):
+        loss, grads = jax.value_and_grad(self._loss)(state.params, batch, rng)
+        if self.cfg.grad_clip_norm:
+            grads, gnorm = clip_by_global_norm(grads, self.cfg.grad_clip_norm)
+        else:
+            gnorm = jnp.zeros(())
+        updates, opt_state = self.optimizer.update(
+            grads, state.opt_state, state.params, state.step
+        )
+        params = apply_updates(state.params, updates)
+        return (
+            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            {"loss": loss, "grad_norm": gnorm},
+        )
+
+    def train_step(self, state: TrainState, batch, rng=None):
+        if self._step_fn is None:
+            self._step_fn = jax.jit(self._step, donate_argnums=(0,))
+        if rng is None:
+            rng = jax.random.key(0)
+        batch = jax.device_put(batch, self._batch_sh)
+        return self._step_fn(state, batch, rng)
+
+    def eval_fn(self, state: TrainState, batch):
+        if self._eval_fn is None:
+            self._eval_fn = jax.jit(self._loss)
+        return self._eval_fn(state.params, batch, None)
+
+    # -- reporting ------------------------------------------------------
+    @property
+    def bubble_fraction(self) -> float:
+        return pipeline_bubble_fraction(self.num_stages, self.cfg.micro_batches)
+
+    def describe(self) -> dict:
+        return {
+            "mesh": dict(self.mesh.shape),
+            "num_stages": self.num_stages,
+            "layers_per_stage": self.layers_per_stage,
+            "micro_batches": self.cfg.micro_batches,
+            "bubble_fraction": self.bubble_fraction,
+            "dtype": str(self.compute_dtype),
+        }

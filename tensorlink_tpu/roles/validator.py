@@ -53,11 +53,16 @@ class ValidatorNode(Node):
 
     def dht_store_allowed(self, peer, key: str) -> bool:
         """Job records are written by validators (replication) only; a
-        user's job enters the DHT through the validated JOB_REQ path."""
+        user's job enters the DHT through the validated JOB_REQ path.
+        Validator status is checked against the Registry (the chain-anchored
+        identity, reference: smart_node.py:357-379) — peer.role alone is a
+        self-declared HELLO field and is NOT trusted."""
         if not super().dht_store_allowed(peer, key):
             return False
         if key.startswith("job:"):
-            return peer.role == "validator"
+            if self.registry is not None:
+                return self.registry.is_validator(peer.node_id)
+            return peer.role == "validator"  # off-chain dev mode only
         return True
 
     def _workers(self) -> list[Peer]:

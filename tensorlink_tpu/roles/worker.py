@@ -61,6 +61,7 @@ class StageRunner:
     params: Any
     opt: Any
     opt_state: Any
+    owner: str = ""  # node_id that shipped the spec; authorizes data-plane ops
     step: int = 0
     inputs: dict = field(default_factory=dict)  # (step, micro) -> activation
     grad_accum: Any = None
@@ -119,9 +120,10 @@ class WorkerNode(Node):
 
     RESERVATION_TTL_S = 120.0
 
-    def __init__(self, cfg: NodeConfig | None = None, **kw):
+    def __init__(self, cfg: NodeConfig | None = None, registry=None, **kw):
         cfg = cfg or NodeConfig(role="worker")
         super().__init__(cfg, **kw)
+        self.registry = registry  # optional: verifies validator identity
         self.stages: dict[tuple[str, int], StageRunner] = {}
         # (job_id, stage) -> (bytes, expires_at); converted to a live stage
         # by MODULE_SPEC, or expired — never leaked (review finding).
@@ -211,6 +213,7 @@ class WorkerNode(Node):
             params=params,
             opt=opt,
             opt_state=opt.init(params),
+            owner=peer.node_id,
         )
         self.stages[(runner.job_id, runner.stage_index)] = runner
         self.training = True
@@ -221,16 +224,37 @@ class WorkerNode(Node):
             "param_bytes": tree_bytes(params),
         }
 
+    def _authorized_runner(
+        self, peer: Peer, msg, allow_validator: bool = False
+    ) -> "StageRunner | dict":
+        """Only the job owner (the node that shipped the spec) may drive a
+        stage; PoL challenges may additionally come from registry-verified
+        validators. Review finding: without this, any handshaked peer
+        could steal weights (PARAMS_REQUEST) or tear the job down."""
+        key = (str(msg["job_id"]), int(msg["stage"]))
+        runner = self.stages.get(key)
+        if runner is None:
+            return {"type": "ERROR", "error": f"no stage {key}"}
+        if peer.node_id == runner.owner:
+            return runner
+        if allow_validator:
+            if self.registry is not None and self.registry.is_validator(peer.node_id):
+                return runner
+            if self.registry is None and peer.role == "validator":
+                return runner  # off-chain dev mode
+        peer.ghosts += 1
+        self._penalize(peer)
+        return {"type": "ERROR", "error": "unauthorized"}
+
     async def _h_forward(self, node, peer, msg) -> dict | None:
         """Run the stage and return the activation to the requester
         (hub-and-spoke: the master drives the chain, reference §3.2).
         Tensor payloads ride the typed-array codec — this is the DCN hop
         between hosts; intra-host stage chains stay on the XLA mesh.
         """
-        key = (str(msg["job_id"]), int(msg["stage"]))
-        runner = self.stages.get(key)
-        if runner is None:
-            return {"type": "ERROR", "error": f"no stage {key}"}
+        runner = self._authorized_runner(peer, msg)
+        if isinstance(runner, dict):
+            return runner
         x = unpack_arrays(msg["data"])["x"]
         out = await asyncio.to_thread(
             runner.forward, int(msg["step"]), int(msg["micro"]), x
@@ -246,10 +270,9 @@ class WorkerNode(Node):
         return reply
 
     async def _h_backward(self, node, peer, msg) -> dict | None:
-        key = (str(msg["job_id"]), int(msg["stage"]))
-        runner = self.stages.get(key)
-        if runner is None:
-            return {"type": "ERROR", "error": f"no stage {key}"}
+        runner = self._authorized_runner(peer, msg)
+        if isinstance(runner, dict):
+            return runner
         g = unpack_arrays(msg["data"])["g"]
         gx = await asyncio.to_thread(
             runner.backward, int(msg["step"]), int(msg["micro"]), g
@@ -266,20 +289,18 @@ class WorkerNode(Node):
     async def _h_step_end(self, node, peer, msg) -> dict:
         """All micro-grads in: optimizer step (correctly: step, no
         pre-zeroing — contrast worker.py:320-321)."""
-        key = (str(msg["job_id"]), int(msg["stage"]))
-        runner = self.stages.get(key)
-        if runner is None:
-            return {"type": "ERROR", "error": f"no stage {key}"}
+        runner = self._authorized_runner(peer, msg)
+        if isinstance(runner, dict):
+            return runner
         await asyncio.to_thread(runner.apply_step)
         return {"type": "STEPPED", "step": runner.step}
 
     async def _h_params_request(self, node, peer, msg) -> dict:
         """Return current stage params (reference: send_parameters,
         torch_node.py:148-157)."""
-        key = (str(msg["job_id"]), int(msg["stage"]))
-        runner = self.stages.get(key)
-        if runner is None:
-            return {"type": "ERROR", "error": f"no stage {key}"}
+        runner = self._authorized_runner(peer, msg, allow_validator=True)
+        if isinstance(runner, dict):
+            return runner
         flat = tree_flatten_arrays(jax.tree.map(np.asarray, runner.params))
         return {
             "type": "PARAMETERS",
@@ -291,9 +312,17 @@ class WorkerNode(Node):
 
     async def _h_unload(self, node, peer, msg) -> dict:
         """Free a finished job's stages + any reservation (job teardown;
-        the reference had no teardown at all)."""
+        the reference had no teardown at all). Owner-only."""
         jid = str(msg["job_id"])
-        removed = [k for k in self.stages if k[0] == jid]
+        removed = [
+            k
+            for k, r in self.stages.items()
+            if k[0] == jid and r.owner == peer.node_id
+        ]
+        if not removed and any(k[0] == jid for k in self.stages):
+            peer.ghosts += 1
+            self._penalize(peer)
+            return {"type": "ERROR", "error": "unauthorized"}
         for k in removed:
             del self.stages[k]
         self._reservations = {
@@ -308,10 +337,9 @@ class WorkerNode(Node):
         XLA programs are deterministic for a fixed compiled binary)."""
         import hashlib
 
-        key = (str(msg["job_id"]), int(msg["stage"]))
-        runner = self.stages.get(key)
-        if runner is None:
-            return {"type": "ERROR", "error": f"no stage {key}"}
+        runner = self._authorized_runner(peer, msg, allow_validator=True)
+        if isinstance(runner, dict):
+            return runner
         x = unpack_arrays(msg["data"])["x"]
         out = await asyncio.to_thread(
             lambda: np.asarray(runner._fwd(runner.params, jnp.asarray(x)))

@@ -36,14 +36,18 @@ def test_message_requires_type():
 
 @pytest.mark.parametrize("codec", ["none", "zlib", "zstd"])
 def test_array_pack_roundtrip(codec):
+    import ml_dtypes
+
     arrays = {
         "w": np.random.default_rng(0).normal(size=(16, 8)).astype(np.float32),
         "b": np.arange(5, dtype=np.int32),
-        "bf16": np.ones((4, 4), np.float16),
+        "f16": np.ones((4, 4), np.float16),
+        "bf16": np.full((4, 4), 1.5, dtype=ml_dtypes.bfloat16),
     }
     out = unpack_arrays(pack_arrays(arrays, codec=codec))
     assert set(out) == set(arrays)
     for k in arrays:
+        assert out[k].dtype == arrays[k].dtype, k
         np.testing.assert_array_equal(out[k], arrays[k])
 
 
