@@ -140,7 +140,10 @@ def bert_pipeline_parts(model: "Bert", params: dict, num_classes_head=None):
         def head_fn(all_params, x, batch):
             return x  # last_hidden_state
 
-        head_params = {"pooler": bp["pooler"]}
+        # no pooler in the optimized tree: head_fn never uses it, and
+        # decoupled weight decay would silently shrink unused params
+        # (review finding)
+        head_params = {}
 
     return PipelineParts(
         embed_fn=embed_fn,

@@ -84,6 +84,8 @@ def pack_arrays(
     offset = 0
     for name, arr in arrays.items():
         arr = np.ascontiguousarray(arr)
+        if arr.dtype.byteorder == ">":  # dtype travels by NAME: wire is
+            arr = arr.astype(arr.dtype.newbyteorder("="))  # native-endian
         raw = arr.tobytes()
         manifest["tensors"][name] = {
             # dtype by NAME: ml_dtypes types (bfloat16, float8_*) have

@@ -125,9 +125,10 @@ class WorkerNode(Node):
         super().__init__(cfg, **kw)
         self.registry = registry  # optional: verifies validator identity
         self.stages: dict[tuple[str, int], StageRunner] = {}
-        # (job_id, stage) -> (bytes, expires_at); converted to a live stage
-        # by MODULE_SPEC, or expired — never leaked (review finding).
-        self._reservations: dict[tuple[str, int], tuple[int, float]] = {}
+        # (job_id, stage) -> (bytes, expires_at, author); converted to a
+        # live stage by MODULE_SPEC (author-only), or expired — never
+        # leaked (review finding).
+        self._reservations: dict[tuple[str, int], tuple[int, float, str]] = {}
         self.training = False
 
     @property
@@ -136,12 +137,12 @@ class WorkerNode(Node):
         self._reservations = {
             k: v for k, v in self._reservations.items() if v[1] > now
         }
-        return sum(b for b, _ in self._reservations.values())
+        return sum(b for b, _, _ in self._reservations.values())
 
     @reserved_bytes.setter
     def reserved_bytes(self, value: int) -> None:
         # test/diagnostic hook: a blanket reservation that never expires
-        self._reservations[("__manual__", -1)] = (value, float("inf"))
+        self._reservations[("__manual__", -1)] = (value, float("inf"), "")
 
     # ---------------------------------------------------------- handlers
     def register_handlers(self) -> None:
@@ -184,6 +185,7 @@ class WorkerNode(Node):
             self._reservations[(str(msg["job_id"]), int(msg["stage"]))] = (
                 need,
                 time.time() + self.RESERVATION_TTL_S,
+                str(msg.get("author", "")),
             )
             return {
                 "type": "ACCEPT_JOB",
@@ -194,9 +196,31 @@ class WorkerNode(Node):
         return {"type": "DECLINE_JOB", "job_id": msg["job_id"], "stage": msg["stage"]}
 
     async def _h_module_spec(self, node, peer, msg) -> dict:
-        """Build the stage from spec + weights; jit; ack LOADED."""
+        """Build the stage from spec + weights; jit; ack LOADED.
+
+        Authorization (review findings): a live stage may only be replaced
+        by its owner; a reservation made on behalf of a job author may only
+        be claimed by that author; unreserved shipping is capacity-checked
+        so a peer cannot blow past the memory bound reservations protect.
+        """
+        key = (str(msg["job_id"]), int(msg["stage"]))
+        existing = self.stages.get(key)
+        if existing is not None and existing.owner != peer.node_id:
+            peer.ghosts += 1
+            self._penalize(peer)
+            return {"type": "ERROR", "error": "unauthorized"}
+        res = self._reservations.get(key)
+        if res is not None and res[2] and res[2] != peer.node_id:
+            peer.ghosts += 1
+            self._penalize(peer)
+            return {"type": "ERROR", "error": "unauthorized"}
+        if res is None and existing is None:
+            # params + grads + 2x Adam moments for an unreserved ship
+            need = len(msg["weights"]) * 4
+            if need > self.capacity_bytes():
+                return {"type": "ERROR", "error": "insufficient memory"}
         # reservation becomes a live stage (its memory is now real)
-        self._reservations.pop((str(msg["job_id"]), int(msg["stage"])), None)
+        self._reservations.pop(key, None)
         module = module_from_config(msg["module_config"])
         flat = unpack_arrays(msg["weights"])
         params = jax.tree.map(jnp.asarray, tree_unflatten_arrays(flat))
@@ -319,15 +343,26 @@ class WorkerNode(Node):
             for k, r in self.stages.items()
             if k[0] == jid and r.owner == peer.node_id
         ]
-        if not removed and any(k[0] == jid for k in self.stages):
+        # reservations are author-owned too: a peer may only clear its own
+        # (review finding: otherwise any peer could free a pending job's
+        # reservation between ACCEPT_JOB and MODULE_SPEC)
+        res_removed = [
+            k
+            for k, v in self._reservations.items()
+            if k[0] == jid and (not v[2] or v[2] == peer.node_id)
+        ]
+        touched_foreign = (
+            any(k[0] == jid for k in self.stages)
+            or any(k[0] == jid for k in self._reservations)
+        ) and not (removed or res_removed)
+        if touched_foreign:
             peer.ghosts += 1
             self._penalize(peer)
             return {"type": "ERROR", "error": "unauthorized"}
         for k in removed:
             del self.stages[k]
-        self._reservations = {
-            k: v for k, v in self._reservations.items() if k[0] != jid
-        }
+        for k in res_removed:
+            del self._reservations[k]
         self.training = bool(self.stages)
         return {"type": "UNLOADED", "job_id": jid, "stages": len(removed)}
 

@@ -8,6 +8,7 @@ loss must decrease; parity vs local training is checked.
 """
 
 import asyncio
+import time
 
 import jax
 import jax.numpy as jnp
@@ -266,3 +267,53 @@ async def test_pol_challenge_detects_honest_worker():
         assert r1["digest"] == r2["digest"]
     finally:
         await _teardown(user, validator, *workers)
+
+
+@pytest.mark.asyncio
+async def test_stage_hijack_and_reservation_theft_rejected():
+    """MODULE_SPEC from a non-owner must not replace a live stage, and
+    UNLOAD from a stranger must not clear another job's reservation
+    (review findings)."""
+    reg, validator, workers, user, v_peer = await _setup_network(1)
+    attacker = UserNode(_cfg("user"))
+    await attacker.start()
+    try:
+        m, p = _model()
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer, train={"optimizer": "sgd", "learning_rate": 0.1}
+        )
+        w = workers[0]
+        st = job.stages[0]
+        trained = jax.tree.map(np.asarray, w.stages[(job.job.job_id, 0)].params)
+
+        a_peer = await attacker.connect("127.0.0.1", w.port)
+        from tensorlink_tpu.p2p.serialization import pack_arrays, tree_flatten_arrays
+
+        spec = job.job.stages[0]
+        zeros = jax.tree.map(np.zeros_like, trained)
+        r = await attacker.request(
+            a_peer,
+            {"type": "MODULE_SPEC", "job_id": job.job.job_id, "stage": 0,
+             "module_config": spec.module_config,
+             "weights": pack_arrays(tree_flatten_arrays(zeros))},
+        )
+        assert r["type"] == "ERROR" and "unauthorized" in r["error"]
+        still = jax.tree.map(np.asarray, w.stages[(job.job.job_id, 0)].params)
+        jax.tree.map(np.testing.assert_array_equal, trained, still)
+
+        # stranger UNLOAD against a job with live stages: rejected
+        r = await attacker.request(
+            a_peer, {"type": "UNLOAD", "job_id": job.job.job_id}
+        )
+        assert r["type"] == "ERROR"
+        assert (job.job.job_id, 0) in w.stages
+
+        # reservation (no stage yet) owned by user: stranger can't clear it
+        w._reservations[("pending-job", 0)] = (1 << 20, time.time() + 60, user.node_id)
+        r = await attacker.request(
+            a_peer, {"type": "UNLOAD", "job_id": "pending-job"}
+        )
+        assert r["type"] == "ERROR"
+        assert ("pending-job", 0) in w._reservations
+    finally:
+        await _teardown(user, attacker, validator, *workers)
