@@ -65,6 +65,7 @@ class Node:
         self.log = get_logger(f"{cfg.role}.{self.node_id[:8]}")
         self._handlers: dict[str, Handler] = {}
         self._pending: dict[str, asyncio.Future] = {}
+        self._pending_peer: dict[str, str] = {}  # msg id -> peer node_id
         self._server: asyncio.AbstractServer | None = None
         self._tasks: set[asyncio.Task] = set()
         self.port: int | None = None
@@ -299,6 +300,16 @@ class Node:
     def _drop_peer(self, peer: Peer) -> None:
         if self.peers.get(peer.node_id) is peer:
             del self.peers[peer.node_id]
+            # fail in-flight requests to the dead peer immediately instead
+            # of letting them ride out the full request timeout
+            for mid, target in list(self._pending_peer.items()):
+                if target == peer.node_id:
+                    fut = self._pending.get(mid)
+                    if fut is not None and not fut.done():
+                        fut.set_exception(
+                            ConnectionError(f"peer {peer.node_id[:8]} lost")
+                        )
+                    self._pending_peer.pop(mid, None)
             self.on_peer_lost(peer)
 
     def on_peer_lost(self, peer: Peer) -> None:
@@ -317,6 +328,7 @@ class Node:
         msg["id"] = uuid.uuid4().hex
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[msg["id"]] = fut
+        self._pending_peer[msg["id"]] = peer.node_id
         try:
             await self.send(peer, msg)
             return await asyncio.wait_for(
@@ -324,12 +336,45 @@ class Node:
             )
         finally:
             self._pending.pop(msg["id"], None)
+            self._pending_peer.pop(msg["id"], None)
 
     async def ping(self, peer: Peer) -> float:
         t0 = time.perf_counter()
         await self.request(peer, {"type": "PING"})
         peer.ping_ms = (time.perf_counter() - t0) * 1e3
         return peer.ping_ms
+
+    # ------------------------------------------------------- failure detection
+    def start_heartbeat(
+        self, interval_s: float = 10.0, timeout_s: float = 5.0, max_misses: int = 3
+    ) -> None:
+        """Lease-style liveness: periodic PING to every peer; a peer that
+        misses `max_misses` consecutive beats is dropped via on_peer_lost.
+        The reference's only liveness signal was a manual ping and socket
+        errors (survey §5.3); this catches silent hangs too."""
+        self._spawn(self._heartbeat_loop(interval_s, timeout_s, max_misses))
+
+    async def _heartbeat_loop(
+        self, interval_s: float, timeout_s: float, max_misses: int
+    ) -> None:
+        misses: dict[str, int] = {}
+        while not self._stopping:
+            await asyncio.sleep(interval_s)
+            for peer in list(self.peers.values()):
+                try:
+                    await asyncio.wait_for(self.ping(peer), timeout=timeout_s)
+                    misses.pop(peer.node_id, None)
+                except (asyncio.TimeoutError, ConnectionError, OSError):
+                    n = misses.get(peer.node_id, 0) + 1
+                    misses[peer.node_id] = n
+                    if n >= max_misses:
+                        self.log.warning(
+                            "peer %s missed %d heartbeats, dropping",
+                            peer.node_id[:8], n,
+                        )
+                        peer.stream.close()
+                        self._drop_peer(peer)
+                        misses.pop(peer.node_id, None)
 
     # ------------------------------------------------------------ DHT RPC
     async def dht_store(self, key: str, value: Any) -> int:

@@ -111,6 +111,17 @@ def _dtype_by_name(name: str) -> np.dtype:
         return np.dtype(getattr(ml_dtypes, name))
 
 
+def packed_nbytes(data: bytes) -> int:
+    """Total UNCOMPRESSED tensor bytes in a packed blob, from the manifest
+    alone (no decompression). Admission control must use this, not
+    len(blob): zstd can shrink low-entropy weights 100x (review finding)."""
+    if data[:4] != MAGIC:
+        raise ValueError("bad array blob magic")
+    hlen = int.from_bytes(data[4:8], "big")
+    manifest = msgpack.unpackb(data[8 : 8 + hlen], raw=False)
+    return sum(int(m["nbytes"]) for m in manifest["tensors"].values())
+
+
 def unpack_arrays(data: bytes) -> dict[str, np.ndarray]:
     if data[:4] != MAGIC:
         raise ValueError("bad array blob magic")

@@ -70,11 +70,26 @@ class DistributedJob:
     chain (pipelining across stages emerges from per-micro ordering, but
     explicitly scheduled by asyncio rather than thread timing)."""
 
-    def __init__(self, user: "UserNode", job: JobRecord, stages: list[RemoteStage]):
+    def __init__(
+        self,
+        user: "UserNode",
+        job: JobRecord,
+        stages: list[RemoteStage],
+        validator: Peer | None = None,
+    ):
         self.user = user
         self.job = job
         self.stages = stages
+        self.validator = validator  # for elastic re-recruitment
         self.step = 0
+        # last-known params per stage, used to re-ship on stage recovery
+        # (seeded with the initial shipment; refreshed by checkpoint_stages)
+        self._stage_params: dict[int, Any] = {}
+        self.max_step_retries = 2
+        # fencing epoch: bumped on every abort; stages reject data-plane
+        # messages from older epochs, so a straggler from an aborted
+        # attempt can never double-count into a retried step
+        self._fence = 0
 
     async def _micro_forward(self, step: int, micro: int, x: np.ndarray) -> np.ndarray:
         for st in self.stages:
@@ -86,6 +101,7 @@ class DistributedJob:
                     "stage": st.index,
                     "step": step,
                     "micro": micro,
+                    "fence": self._fence,
                     "data": pack_arrays({"x": np.asarray(x)}),
                 },
                 timeout=60.0,
@@ -105,6 +121,7 @@ class DistributedJob:
                     "stage": st.index,
                     "step": step,
                     "micro": micro,
+                    "fence": self._fence,
                     "data": pack_arrays({"g": np.asarray(g)}),
                 },
                 timeout=60.0,
@@ -121,7 +138,24 @@ class DistributedJob:
     ) -> float:
         """One pipelined step: split into micro-batches, forward all,
         loss+grad at the master, backward all, then optimizer step on
-        every stage."""
+        every stage.
+
+        Elastic: a stage failure mid-step aborts the partial step on the
+        surviving stages, recovers the dead stage (validator re-recruits,
+        last-known params re-shipped), and retries — the recovery the
+        reference stubs out with empty timeout bodies (survey §5.3).
+        """
+        for attempt in range(self.max_step_retries + 1):
+            try:
+                return await self._try_train_step(batch_x, loss_grad_fn)
+            except (ConnectionError, asyncio.TimeoutError, RuntimeError):
+                if attempt == self.max_step_retries or self.validator is None:
+                    raise
+                await self._abort_step()
+                await self.recover_dead_stages()
+        raise AssertionError("unreachable")
+
+    async def _try_train_step(self, batch_x, loss_grad_fn) -> float:
         m = self.job.micro_batches
         micros = np.array_split(np.asarray(batch_x), m)
         step = self.step
@@ -132,7 +166,18 @@ class DistributedJob:
             await self._micro_backward(step, mi, g)
             return loss
 
-        losses = await asyncio.gather(*(one(i, x) for i, x in enumerate(micros)))
+        tasks = [asyncio.ensure_future(one(i, x)) for i, x in enumerate(micros)]
+        try:
+            losses = await asyncio.gather(*tasks)
+        except BaseException:
+            # cancel + drain siblings so no straggler FORWARD/BACKWARD from
+            # this aborted attempt lands after the stages reset for a retry
+            # (review finding: a late landing would double-count a micro's
+            # gradient in the retried step)
+            for t in tasks:
+                t.cancel()
+            await asyncio.gather(*tasks, return_exceptions=True)
+            raise
         await asyncio.gather(
             *(
                 self.user.request(
@@ -149,6 +194,119 @@ class DistributedJob:
         )
         self.step += 1
         return float(np.mean(losses))
+
+    # ------------------------------------------------------- fault recovery
+    async def _abort_step(self) -> None:
+        """Clear partial grads/activations on every still-reachable stage."""
+
+        self._fence += 1
+
+        async def abort(st: RemoteStage):
+            try:
+                await self.user.request(
+                    st.peer,
+                    {
+                        "type": "ABORT_STEP",
+                        "job_id": self.job.job_id,
+                        "stage": st.index,
+                        "fence": self._fence,
+                    },
+                    timeout=5.0,
+                )
+            except (ConnectionError, asyncio.TimeoutError):
+                pass  # dead stage: recovered separately
+
+        await asyncio.gather(*(abort(st) for st in self.stages))
+
+    async def _live_stage(self, st: RemoteStage) -> bool:
+        if st.peer.node_id not in self.user.peers:
+            return False
+        try:
+            await asyncio.wait_for(self.user.ping(st.peer), timeout=2.0)
+            return True
+        except (ConnectionError, asyncio.TimeoutError, OSError):
+            return False
+
+    async def recover_dead_stages(self) -> list[int]:
+        """Probe all stages; re-place every dead one via the validator and
+        re-ship its module spec + last-known params. Surviving stages are
+        rolled back to the SAME cached snapshot — otherwise the pipeline
+        would compose params from different training steps (review
+        finding: a dead stage restarts from the last checkpoint while
+        survivors are N steps ahead, silently training a mixed-version
+        model). Returns recovered stage indices."""
+        alive = await asyncio.gather(*(self._live_stage(s) for s in self.stages))
+        recovered = []
+        for st, ok in zip(list(self.stages), alive):
+            if not ok:
+                await self.recover_stage(st.index, dead_id=st.peer.node_id)
+                recovered.append(st.index)
+        if recovered:
+            await asyncio.gather(
+                *(
+                    self._ship_stage(st.peer, st.index)
+                    for st, ok in zip(self.stages, alive)
+                    if ok and st.index not in recovered
+                )
+            )
+        return recovered
+
+    async def recover_stage(self, index: int, dead_id: str = "") -> RemoteStage:
+        if self.validator is None:
+            raise RuntimeError("no validator attached; cannot re-recruit")
+        resp = await self.user.request(
+            self.validator,
+            {
+                "type": "REPLACE_WORKER",
+                "job_id": self.job.job_id,
+                "stage": index,
+                "exclude": [dead_id] if dead_id else [],
+            },
+            timeout=30.0,
+        )
+        if resp.get("type") != "WORKER_REPLACED":
+            raise RuntimeError(f"stage {index} recovery failed: {resp.get('error')}")
+        placement = resp["worker"]
+        peer = self.user.peers.get(placement["node_id"])
+        if peer is None:
+            peer = await self.user.connect(placement["host"], int(placement["port"]))
+        st = RemoteStage(index=index, peer=peer, info=placement)
+        await self._ship_stage(peer, index)
+        self.stages = [st if s.index == index else s for s in self.stages]
+        self.stages.sort(key=lambda s: s.index)
+        return st
+
+    async def _ship_stage(self, peer: Peer, index: int) -> None:
+        """Ship spec + cached params for one stage (fresh placement or
+        same-snapshot rollback of a survivor)."""
+        params = self._stage_params.get(index)
+        if params is None:
+            raise RuntimeError(f"no cached params for stage {index}")
+        flat = await asyncio.to_thread(
+            lambda: pack_arrays(tree_flatten_arrays(jax.tree.map(np.asarray, params)))
+        )
+        ack = await self.user.request(
+            peer,
+            {
+                "type": "MODULE_SPEC",
+                "job_id": self.job.job_id,
+                "stage": index,
+                "module_config": self.job.stages[index].module_config,
+                "weights": flat,
+                "train": self.job.train,
+            },
+            timeout=60.0,
+        )
+        if ack.get("type") != "LOADED":
+            raise RuntimeError(f"stage {index} reload failed: {ack}")
+
+    async def checkpoint_stages(self) -> dict[int, Any]:
+        """Refresh the last-known params cache from every stage (the state
+        a recovery re-ships; pair with runtime.checkpoint for durability)."""
+        parts = await self.fetch_params()
+        for st, p in zip(self.stages, parts):
+            self._stage_params[st.index] = p
+        return self._stage_params
 
     async def fetch_params(self) -> list[dict]:
         """Gather current params from every stage (reference:
@@ -258,4 +416,6 @@ class UserNode(Node):
         await asyncio.gather(
             *(ship(st, p) for st, (_, p) in zip(remote, stage_parts))
         )
-        return DistributedJob(self, job, remote)
+        dj = DistributedJob(self, job, remote, validator=validator)
+        dj._stage_params = {i: p for i, (_, p) in enumerate(stage_parts)}
+        return dj

@@ -31,6 +31,7 @@ from tensorlink_tpu.p2p.dht import PeerInfo
 from tensorlink_tpu.p2p.node import Node, Peer
 from tensorlink_tpu.p2p.serialization import (
     pack_arrays,
+    packed_nbytes,
     tree_flatten_arrays,
     tree_unflatten_arrays,
     unpack_arrays,
@@ -63,6 +64,7 @@ class StageRunner:
     opt_state: Any
     owner: str = ""  # node_id that shipped the spec; authorizes data-plane ops
     step: int = 0
+    fence: int = 0  # abort epoch; data-plane msgs from older epochs rejected
     inputs: dict = field(default_factory=dict)  # (step, micro) -> activation
     grad_accum: Any = None
     micro_seen: int = 0
@@ -98,6 +100,15 @@ class StageRunner:
                 self.grad_accum = jax.tree.map(jnp.add, self.grad_accum, gp)
             self.micro_seen += 1
         return np.asarray(gx)
+
+    def reset_step(self) -> None:
+        """Discard partial micro-batch state (grad accum + stashed
+        activations) so an aborted pipeline step can be cleanly retried
+        after an elastic stage re-assignment."""
+        with self._lock:
+            self.grad_accum = None
+            self.micro_seen = 0
+            self.inputs.clear()
 
     def apply_step(self) -> None:
         with self._lock:
@@ -153,6 +164,7 @@ class WorkerNode(Node):
         self.on("FORWARD", self._h_forward)
         self.on("BACKWARD", self._h_backward)
         self.on("STEP_END", self._h_step_end)
+        self.on("ABORT_STEP", self._h_abort_step)
         self.on("PARAMS_REQUEST", self._h_params_request)
         self.on("POL_CHALLENGE", self._h_pol_challenge)
         self.on("UNLOAD", self._h_unload)
@@ -215,15 +227,25 @@ class WorkerNode(Node):
             self._penalize(peer)
             return {"type": "ERROR", "error": "unauthorized"}
         if res is None and existing is None:
-            # params + grads + 2x Adam moments for an unreserved ship
-            need = len(msg["weights"]) * 4
+            # params + grads + 2x Adam moments + activation slack, measured
+            # on the UNCOMPRESSED manifest bytes — len(blob) is zstd-sized
+            # and can undercount low-entropy weights 100x (review finding)
+            need = packed_nbytes(msg["weights"]) * 4 + (64 << 20)
             if need > self.capacity_bytes():
                 return {"type": "ERROR", "error": "insufficient memory"}
         # reservation becomes a live stage (its memory is now real)
         self._reservations.pop(key, None)
-        module = module_from_config(msg["module_config"])
-        flat = unpack_arrays(msg["weights"])
-        params = jax.tree.map(jnp.asarray, tree_unflatten_arrays(flat))
+
+        def build():
+            # heavy: decompress + device transfer + opt init — off the
+            # event loop so PINGs keep answering (review finding: a blocked
+            # loop looks dead to heartbeats)
+            module = module_from_config(msg["module_config"])
+            flat = unpack_arrays(msg["weights"])
+            params = jax.tree.map(jnp.asarray, tree_unflatten_arrays(flat))
+            return module, params
+
+        module, params = await asyncio.to_thread(build)
         train = msg.get("train", {})
         opt = make_optimizer(
             train.get("optimizer", "adam"),
@@ -279,6 +301,8 @@ class WorkerNode(Node):
         runner = self._authorized_runner(peer, msg)
         if isinstance(runner, dict):
             return runner
+        if int(msg.get("fence", 0)) < runner.fence:
+            return {"type": "ERROR", "error": "stale fence (aborted step)"}
         x = unpack_arrays(msg["data"])["x"]
         out = await asyncio.to_thread(
             runner.forward, int(msg["step"]), int(msg["micro"]), x
@@ -297,6 +321,8 @@ class WorkerNode(Node):
         runner = self._authorized_runner(peer, msg)
         if isinstance(runner, dict):
             return runner
+        if int(msg.get("fence", 0)) < runner.fence:
+            return {"type": "ERROR", "error": "stale fence (aborted step)"}
         g = unpack_arrays(msg["data"])["g"]
         gx = await asyncio.to_thread(
             runner.backward, int(msg["step"]), int(msg["micro"]), g
@@ -318,6 +344,18 @@ class WorkerNode(Node):
             return runner
         await asyncio.to_thread(runner.apply_step)
         return {"type": "STEPPED", "step": runner.step}
+
+    async def _h_abort_step(self, node, peer, msg) -> dict:
+        """Discard partial grads/activations after a mid-step stage
+        failure so the master can retry the step against a recovered
+        pipeline (the reference's timeout bodies were empty — survey
+        §5.3)."""
+        runner = self._authorized_runner(peer, msg)
+        if isinstance(runner, dict):
+            return runner
+        runner.fence = max(runner.fence, int(msg.get("fence", runner.fence + 1)))
+        runner.reset_step()
+        return {"type": "STEP_ABORTED", "step": runner.step, "fence": runner.fence}
 
     async def _h_params_request(self, node, peer, msg) -> dict:
         """Return current stage params (reference: send_parameters,
@@ -367,22 +405,43 @@ class WorkerNode(Node):
         return {"type": "UNLOADED", "job_id": jid, "stages": len(removed)}
 
     async def _h_pol_challenge(self, node, peer, msg) -> dict:
-        """Deterministic re-execution: run our stage on the challenger's
-        input and return the output digest (whitepaper PoL made real —
-        XLA programs are deterministic for a fixed compiled binary)."""
-        import hashlib
+        """Deterministic re-execution (whitepaper PoL made real — XLA
+        programs are deterministic for a fixed compiled binary).
+
+        Two challenge forms:
+        - {"seed": s, "shape": [...]}: derive the input from a
+          platform-invariant threefry stream (cheap wire);
+        - {"data": blob}: explicit input array.
+        The proof commits to the forward output AND the input-cotangent of
+        sum(out) (gradient validation, Whitepaper:41-47) plus the current
+        params digest so successive audits evidence training progress.
+        """
+        from tensorlink_tpu.roles import pol
 
         runner = self._authorized_runner(peer, msg, allow_validator=True)
         if isinstance(runner, dict):
             return runner
-        x = unpack_arrays(msg["data"])["x"]
-        out = await asyncio.to_thread(
-            lambda: np.asarray(runner._fwd(runner.params, jnp.asarray(x)))
-        )
+        if "data" in msg:
+            x = jnp.asarray(unpack_arrays(msg["data"])["x"])
+        else:
+            shape = tuple(int(s) for s in msg["shape"])
+            x = pol.challenge_input(int(msg["seed"]), shape, msg.get("dtype", "float32"))
+
+        def compute():
+            out, gx = pol.replay_stage(runner.module.config(), runner.params, x)
+            return np.asarray(out), np.asarray(gx)
+
+        out, gx = await asyncio.to_thread(compute)
+        out_c = pol.commitment(out)
         return {
             "type": "POL_PROOF",
             "job_id": msg["job_id"],
             "stage": msg["stage"],
-            "digest": hashlib.sha256(np.ascontiguousarray(out).tobytes()).hexdigest(),
+            "step": runner.step,
+            "output": out_c,
+            "input_grad": pol.commitment(gx),
+            "params_digest": pol.params_digest(runner.params),
+            # back-compat fields
+            "digest": out_c["digest"],
             "output_sum": float(out.sum()),
         }

@@ -317,3 +317,134 @@ async def test_stage_hijack_and_reservation_theft_rejected():
         assert ("pending-job", 0) in w._reservations
     finally:
         await _teardown(user, attacker, validator, *workers)
+
+
+@pytest.mark.asyncio
+async def test_validator_audit_honest_and_cheating():
+    """PoL end-to-end: validator replays the stage from the approved spec
+    and compares commitments; a cheating worker is slashed."""
+    reg, validator, workers, user, v_peer = await _setup_network(1)
+    try:
+        m, p = _model()
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer, micro_batches=1,
+            train={"optimizer": "sgd", "learning_rate": 0.0},
+        )
+        rec = await validator.audit_stage(job.job.job_id, 0, in_shape=(4, 16), seed=7)
+        assert rec["passed"] is True and rec["forward_ok"] and rec["grad_ok"]
+
+        # cheating worker: returns a corrupted output commitment
+        w = workers[0]
+        honest = w._handlers["POL_CHALLENGE"]
+
+        async def cheat(node, peer, msg):
+            proof = await honest(node, peer, msg)
+            if proof.get("type") == "POL_PROOF":
+                proof["output"] = dict(proof["output"], digest="0" * 64)
+            return proof
+
+        w._handlers["POL_CHALLENGE"] = cheat
+        rec = await validator.audit_stage(job.job.job_id, 0, in_shape=(4, 16), seed=8)
+        assert rec["passed"] is False
+        assert validator.dht.get_local(f"rep:{w.node_id}") == 0.0
+        # audit trail recorded on the job
+        audits = validator.job_state[job.job.job_id]["audits"]
+        assert [a["passed"] for a in audits] == [True, False]
+    finally:
+        await _teardown(user, validator, *workers)
+
+
+def test_pol_commitment_cross_platform_tolerance():
+    from tensorlink_tpu.roles import pol
+
+    x = np.linspace(-1, 1, 64, dtype=np.float32).reshape(8, 8)
+    proof = pol.commitment(x)
+    # same platform: exact
+    assert pol.verify_commitment(x, proof)
+    # cross-platform: tolerance path
+    foreign = dict(proof, platform="tpu-elsewhere")
+    assert pol.verify_commitment(x + 1e-7, foreign)
+    assert not pol.verify_commitment(x + 1.0, foreign)
+    # determinism of the challenge stream
+    a = pol.challenge_input(3, (2, 5))
+    b = pol.challenge_input(3, (2, 5))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.asyncio
+async def test_elastic_recovery_worker_death_mid_training():
+    """Fault injection (survey §5.3 — the reference names this capability
+    but its timeout bodies are empty): kill the stage-1 worker mid-run
+    with a spare available; the next train_step aborts the partial step,
+    re-recruits via the validator, re-ships cached params, retries, and
+    the loss keeps decreasing."""
+    reg, validator, workers, user, v_peer = await _setup_network(3)  # 1 spare
+    try:
+        m, p = _model()
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer,
+            max_stage_bytes=16 * 32 * 4 + 200,  # 2 stages -> 1 spare worker
+            micro_batches=2,
+            train={"optimizer": "sgd", "learning_rate": 0.05},
+        )
+        assert len(job.stages) == 2
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 16)).astype(np.float32)
+        w_true = rng.normal(size=(16, 4))
+        y = np.argmax(x @ w_true, -1)
+
+        def loss_grad(logits, micro):
+            lj = jnp.asarray(logits)
+            yj = jnp.asarray(np.array_split(y, 2)[micro])
+
+            def f(l):
+                logz = jax.nn.logsumexp(l, axis=-1)
+                ll = jnp.take_along_axis(l, yj[:, None], axis=-1)[..., 0]
+                return jnp.mean(logz - ll)
+
+            val, g = jax.value_and_grad(f)(lj)
+            return float(val), np.asarray(g)
+
+        losses = [await job.train_step(x, loss_grad) for _ in range(5)]
+        await job.checkpoint_stages()  # refresh re-ship cache with trained params
+
+        # kill whichever worker holds stage 1
+        victim_id = job.stages[1].peer.node_id
+        victim = next(w for w in workers if w.node_id == victim_id)
+        await victim.stop()
+
+        for _ in range(5):
+            losses.append(await job.train_step(x, loss_grad))
+
+        # recovered onto a different worker, and training continued sanely
+        assert job.stages[1].peer.node_id != victim_id
+        assert losses[-1] < losses[4], losses  # improved past pre-failure loss
+        reps = validator.job_state[job.job.job_id]["replacements"]
+        assert reps and reps[0]["stage"] == 1
+    finally:
+        await _teardown(user, validator, *[w for w in workers if w.node_id != victim_id])
+
+
+@pytest.mark.asyncio
+async def test_heartbeat_drops_silent_peer():
+    """Lease-style liveness: a peer that stops answering PINGs is dropped
+    and on_peer_lost fires."""
+    a = UserNode(_cfg("user"))
+    b = WorkerNode(_cfg("worker"))
+    await a.start()
+    await b.start()
+    peer = await a.connect("127.0.0.1", b.port)
+    lost = []
+    a.on_peer_lost = lambda p: lost.append(p.node_id)
+    a.start_heartbeat(interval_s=0.1, timeout_s=0.2, max_misses=2)
+    # b goes silent (handlers gone, socket open): simulate hang by
+    # suspending b's PING handler
+    async def hang(node, peer, msg):
+        await asyncio.sleep(10)
+    b._handlers["PING"] = hang
+    await asyncio.sleep(1.2)
+    assert lost == [b.node_id]
+    assert peer.node_id not in a.peers
+    await a.stop()
+    await b.stop()
