@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from tensorlink_tpu.models.bert import BertConfig
 from tensorlink_tpu.models.gpt2 import GPT2Config
+from tensorlink_tpu.models.vit import ViTConfig
 
 
 def _t(x) -> np.ndarray:  # torch Linear -> our [in, out]
@@ -146,6 +147,75 @@ def gpt2_params_from_hf(sd: Mapping[str, np.ndarray], cfg: GPT2Config) -> dict:
                 "down": {
                     "w": _a(sd[pre + "mlp.c_proj.weight"]),
                     "b": _a(sd[pre + "mlp.c_proj.bias"]),
+                },
+                "drop": {},
+            },
+            "drop": {},
+        }
+    return _to_jnp(p)
+
+
+def vit_params_from_hf(sd: Mapping[str, np.ndarray], cfg: "ViTConfig") -> dict:
+    """Map an HF ViTModel state dict onto the native `ViT` param tree.
+
+    The HF conv patch projection weight is [D, C, P, P]; our unfold+matmul
+    layout wants [P*P*C, D] with patch pixels varying fastest in
+    (row, col, channel) order — matching PatchEmbed's reshape.
+    """
+    conv_w = _a(sd["embeddings.patch_embeddings.projection.weight"])
+    D, C, P_, _ = conv_w.shape
+    patch_w = conv_w.transpose(2, 3, 1, 0).reshape(P_ * P_ * C, D)
+    p: dict = {
+        "cls_token": _a(sd["embeddings.cls_token"]),
+        "pos_emb": _a(sd["embeddings.position_embeddings"]),
+        "patch": {
+            "w": patch_w,
+            "b": _a(sd["embeddings.patch_embeddings.projection.bias"]),
+        },
+        "emb_drop": {},
+        "encoder": {},
+        "final_norm": {
+            "scale": _a(sd["layernorm.weight"]),
+            "bias": _a(sd["layernorm.bias"]),
+        },
+    }
+    for i in range(cfg.num_layers):
+        pre = f"encoder.layer.{i}."
+        p["encoder"][str(i)] = {
+            "norm1": {
+                "scale": _a(sd[pre + "layernorm_before.weight"]),
+                "bias": _a(sd[pre + "layernorm_before.bias"]),
+            },
+            "norm2": {
+                "scale": _a(sd[pre + "layernorm_after.weight"]),
+                "bias": _a(sd[pre + "layernorm_after.bias"]),
+            },
+            "attn": {
+                "q": {
+                    "w": _t(sd[pre + "attention.attention.query.weight"]),
+                    "b": _a(sd[pre + "attention.attention.query.bias"]),
+                },
+                "k": {
+                    "w": _t(sd[pre + "attention.attention.key.weight"]),
+                    "b": _a(sd[pre + "attention.attention.key.bias"]),
+                },
+                "v": {
+                    "w": _t(sd[pre + "attention.attention.value.weight"]),
+                    "b": _a(sd[pre + "attention.attention.value.bias"]),
+                },
+                "o": {
+                    "w": _t(sd[pre + "attention.output.dense.weight"]),
+                    "b": _a(sd[pre + "attention.output.dense.bias"]),
+                },
+            },
+            "mlp": {
+                "up": {
+                    "w": _t(sd[pre + "intermediate.dense.weight"]),
+                    "b": _a(sd[pre + "intermediate.dense.bias"]),
+                },
+                "down": {
+                    "w": _t(sd[pre + "output.dense.weight"]),
+                    "b": _a(sd[pre + "output.dense.bias"]),
                 },
                 "drop": {},
             },

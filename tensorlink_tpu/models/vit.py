@@ -1,0 +1,208 @@
+"""ViT-B/16, TPU-native (BASELINE.json config[3]).
+
+The reference would ship an opaque HF ``ViTForImageClassification`` as a
+pickled submodule (src/p2p/torch_node.py:159-162); here the model is
+native so pipeline stage slicing, TP specs, and spec-shipping apply.
+Patch embedding is expressed as an unfold + matmul (not a conv) so the
+whole model is Dense/matmul-shaped for the MXU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tensorlink_tpu.nn.module import Module, register_module_type
+from tensorlink_tpu.nn.layers import Dense, Dropout, LayerNorm, _normal
+from tensorlink_tpu.nn.transformer import TransformerBlock, TransformerStack
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    channels: int = 3
+    dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    hidden_dim: int = 3072
+    dropout: float = 0.0
+    layer_norm_eps: float = 1e-12
+
+    @classmethod
+    def base_16(cls) -> "ViTConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "ViTConfig":
+        return cls(
+            image_size=32,
+            patch_size=8,
+            dim=32,
+            num_layers=2,
+            num_heads=2,
+            hidden_dim=64,
+        )
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+@register_module_type
+class PatchEmbed(Module):
+    """[B, H, W, C] images -> [B, N, dim] patch tokens via unfold+matmul."""
+
+    def __init__(self, image_size: int, patch_size: int, channels: int, dim: int):
+        super().__init__()
+        self.image_size = image_size
+        self.patch_size = patch_size
+        self.channels = channels
+        self.dim = dim
+
+    def init(self, key):
+        pdim = self.patch_size * self.patch_size * self.channels
+        kw, _ = jax.random.split(key)
+        return {
+            "w": _normal(kw, (pdim, self.dim)),
+            "b": jnp.zeros((self.dim,)),
+        }
+
+    def param_spec(self, model_axis: str = "model"):
+        return {"w": P(None, None), "b": P(None)}
+
+    def apply(self, params, images, **_):
+        B, H, W, C = images.shape
+        p = self.patch_size
+        x = images.reshape(B, H // p, p, W // p, p, C)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(B, (H // p) * (W // p), p * p * C)
+        w = params["w"].astype(x.dtype)
+        return x @ w + params["b"].astype(x.dtype)
+
+
+class ViT(Module):
+    """Pre-LN encoder with [CLS] token and learned position embeddings."""
+
+    def __init__(self, cfg: ViTConfig = ViTConfig()):
+        super().__init__()
+        self.cfg_obj = cfg
+        self.child(
+            "patch", PatchEmbed(cfg.image_size, cfg.patch_size, cfg.channels, cfg.dim)
+        )
+        self.child("emb_drop", Dropout(cfg.dropout))
+        self.child(
+            "encoder",
+            TransformerStack(
+                cfg.num_layers,
+                TransformerBlock,
+                dim=cfg.dim,
+                num_heads=cfg.num_heads,
+                hidden_dim=cfg.hidden_dim,
+                norm_style="pre",
+                norm="layer",
+                norm_eps=cfg.layer_norm_eps,
+                activation="gelu_exact",
+                use_bias=True,
+                dropout=cfg.dropout,
+            ),
+        )
+        self.child("final_norm", LayerNorm(cfg.dim, eps=cfg.layer_norm_eps))
+
+    def init(self, key):
+        kc, kp, krest = jax.random.split(key, 3)
+        params = super().init(krest)
+        cfg = self.cfg_obj
+        params["cls_token"] = _normal(kc, (1, 1, cfg.dim))
+        params["pos_emb"] = _normal(kp, (1, cfg.num_patches + 1, cfg.dim))
+        return params
+
+    def param_spec(self, model_axis: str = "model"):
+        spec = super().param_spec(model_axis)
+        spec["cls_token"] = P(None, None, None)
+        spec["pos_emb"] = P(None, None, None)
+        return spec
+
+    def apply(self, params, images, *, rng=None, train=False, **_):
+        B = images.shape[0]
+        x = self.children["patch"].apply(params["patch"], images)
+        cls = jnp.broadcast_to(
+            params["cls_token"].astype(x.dtype), (B, 1, x.shape[-1])
+        )
+        x = jnp.concatenate([cls, x], axis=1)
+        x = x + params["pos_emb"].astype(x.dtype)
+        r0, r1 = jax.random.split(rng) if rng is not None else (None, None)
+        x = self.children["emb_drop"].apply(params["emb_drop"], x, rng=r0, train=train)
+        h = self.children["encoder"].apply(params["encoder"], x, rng=r1, train=train)
+        h = self.children["final_norm"].apply(params["final_norm"], h)
+        return {"last_hidden_state": h, "pooled": h[:, 0]}
+
+
+class ViTClassifier(Module):
+    """ViTForImageClassification equivalent (head on the [CLS] token)."""
+
+    def __init__(self, cfg: ViTConfig, num_classes: int):
+        super().__init__()
+        self.num_classes = num_classes
+        self.child("vit", ViT(cfg))
+        self.child("head", Dense(cfg.dim, num_classes))
+
+    def apply(self, params, images, *, rng=None, train=False, **kw):
+        out = self.children["vit"].apply(
+            params["vit"], images, rng=rng, train=train, **kw
+        )
+        return self.children["head"].apply(params["head"], out["pooled"])
+
+
+def vit_pipeline_parts(model: ViT, params: dict, num_classes_head=None):
+    """Split a ViT (or ViTClassifier param tree) into pipeline parts, same
+    contract as bert_pipeline_parts: embed -> stacked blocks -> head."""
+    from tensorlink_tpu.parallel.engine import PipelineParts
+
+    vit = model
+    vp = params if num_classes_head is None else params["vit"]
+    stack = vit.children["encoder"]
+    block = stack.blocks()[0]
+
+    def embed_fn(emb_params, batch):
+        images = batch["images"]
+        B = images.shape[0]
+        x = vit.children["patch"].apply(emb_params["patch"], images)
+        cls = jnp.broadcast_to(
+            emb_params["cls_token"].astype(x.dtype), (B, 1, x.shape[-1])
+        )
+        x = jnp.concatenate([cls, x], axis=1)
+        return x + emb_params["pos_emb"].astype(x.dtype)
+
+    if num_classes_head is not None:
+        def head_fn(all_params, x, batch):
+            h = vit.children["final_norm"].apply(
+                all_params["head"]["final_norm"], x
+            )
+            hw = all_params["head"]["cls"]
+            return h[:, 0] @ hw["w"].astype(h.dtype) + hw["b"].astype(h.dtype)
+
+        head_params = {"final_norm": vp["final_norm"], "cls": params["head"]}
+    else:
+        def head_fn(all_params, x, batch):
+            return vit.children["final_norm"].apply(
+                all_params["head"]["final_norm"], x
+            )
+
+        head_params = {"final_norm": vp["final_norm"]}
+
+    return PipelineParts(
+        embed_fn=embed_fn,
+        block=block,
+        block_params=vp["encoder"],
+        block_fn=lambda blk_p, x: block.apply(blk_p, x),
+        head_fn=head_fn,
+        embed_params={
+            "patch": vp["patch"],
+            "cls_token": vp["cls_token"],
+            "pos_emb": vp["pos_emb"],
+        },
+        head_params=head_params,
+    )

@@ -129,3 +129,35 @@ def test_engine_rejects_indivisible_layers(devices):
     cfg = TrainConfig(batch_size=4, micro_batches=2, dtype="float32")
     with pytest.raises(ValueError, match="divisible"):
         _make_gpt2_trainer(MeshConfig(pipe=3), cfg)
+
+
+def test_engine_vit_classifier(devices):
+    from tensorlink_tpu.models.vit import ViTClassifier, ViTConfig, vit_pipeline_parts
+
+    cfg = TrainConfig(
+        batch_size=8, micro_batches=2, learning_rate=1e-3,
+        optimizer="adam", dtype="float32",
+    )
+    mesh = make_mesh(MeshConfig(pipe=2))
+    vcfg = ViTConfig.tiny()
+    clf = ViTClassifier(vcfg, num_classes=4)
+    params = clf.init(KEY)
+    parts = vit_pipeline_parts(clf.children["vit"], params, num_classes_head=4)
+
+    def loss(logits, batch):
+        return softmax_cross_entropy(logits, batch["labels"])
+
+    tr = ShardedTrainer(mesh, cfg, parts, loss)
+    state = tr.init_state()
+    r = np.random.default_rng(0)
+    batch = {
+        "images": jnp.asarray(
+            r.normal(size=(8, vcfg.image_size, vcfg.image_size, 3)), jnp.float32
+        ),
+        "labels": jnp.asarray(r.integers(0, 4, (8,))),
+    }
+    losses = []
+    for _ in range(10):
+        state, m = tr.train_step(state, batch)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]

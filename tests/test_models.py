@@ -58,6 +58,38 @@ def test_gpt2_shapes_and_decode():
     np.testing.assert_allclose(np.asarray(logits), np.asarray(inc), atol=2e-3)
 
 
+def test_vit_shapes_and_grads():
+    from tensorlink_tpu.models.vit import ViTClassifier, ViTConfig
+
+    cfg = ViTConfig.tiny()
+    m = ViTClassifier(cfg, num_classes=5)
+    p = m.init(KEY)
+    imgs = jax.random.normal(KEY, (2, cfg.image_size, cfg.image_size, 3))
+    logits = jax.jit(m.apply)(p, imgs)
+    assert logits.shape == (2, 5)
+
+    def loss_fn(pp):
+        return jnp.mean(m.apply(pp, imgs) ** 2)
+
+    grads = jax.grad(loss_fn)(p)
+    assert jax.tree.structure(grads) == jax.tree.structure(p)
+    gnorm = jax.tree.reduce(
+        lambda a, x: a + jnp.sum(x.astype(jnp.float32) ** 2), grads, 0.0
+    )
+    assert float(gnorm) > 0
+
+
+def test_vit_param_spec_mirrors_params():
+    from tensorlink_tpu.models.vit import ViT, ViTConfig
+
+    m = ViT(ViTConfig.tiny())
+    p = m.init(KEY)
+    spec = m.param_spec()
+    assert jax.tree.structure(p) == jax.tree.structure(
+        spec, is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec)
+    )
+
+
 @pytest.fixture(scope="module")
 def torch_mods():
     torch = pytest.importorskip("torch")
@@ -135,3 +167,43 @@ def test_gpt2_parity_vs_hf(torch_mods):
         ref = hf(input_ids=torch.tensor(ids)).logits.numpy()
     logits = ours.apply(params, jnp.asarray(ids))
     np.testing.assert_allclose(np.asarray(logits), ref, atol=3e-4)
+
+
+def test_vit_parity_vs_hf(torch_mods):
+    torch, transformers = torch_mods
+    from tensorlink_tpu.models.vit import ViT, ViTConfig
+    from tensorlink_tpu.models.hf_import import vit_params_from_hf
+
+    hf_cfg = transformers.ViTConfig(
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        intermediate_size=64,
+        image_size=32,
+        patch_size=8,
+        num_channels=3,
+        hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    torch.manual_seed(0)
+    hf = transformers.ViTModel(hf_cfg, add_pooling_layer=False).eval()
+    sd = torch_state_dict_to_numpy(hf)
+
+    cfg = ViTConfig(
+        image_size=32, patch_size=8, dim=32, num_layers=2, num_heads=2,
+        hidden_dim=64, dropout=0.0,
+    )
+    ours = ViT(cfg)
+    params = vit_params_from_hf(sd, cfg)
+    assert jax.tree.structure(params) == jax.tree.structure(ours.init(KEY))
+
+    imgs = np.random.default_rng(2).normal(size=(2, 32, 32, 3)).astype(np.float32)
+    with torch.no_grad():
+        # HF wants [B, C, H, W]
+        ref = hf(pixel_values=torch.tensor(imgs).permute(0, 3, 1, 2))
+    out = ours.apply(params, jnp.asarray(imgs))
+    np.testing.assert_allclose(
+        np.asarray(out["last_hidden_state"]),
+        ref.last_hidden_state.numpy(),
+        atol=3e-4,
+    )
