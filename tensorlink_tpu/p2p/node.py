@@ -358,23 +358,28 @@ class Node:
         self, interval_s: float, timeout_s: float, max_misses: int
     ) -> None:
         misses: dict[str, int] = {}
+
+        async def beat(peer: Peer) -> None:
+            try:
+                await asyncio.wait_for(self.ping(peer), timeout=timeout_s)
+                misses.pop(peer.node_id, None)
+            except (asyncio.TimeoutError, ConnectionError, OSError):
+                n = misses.get(peer.node_id, 0) + 1
+                misses[peer.node_id] = n
+                if n >= max_misses:
+                    self.log.warning(
+                        "peer %s missed %d heartbeats, dropping",
+                        peer.node_id[:8], n,
+                    )
+                    peer.stream.close()
+                    self._drop_peer(peer)
+                    misses.pop(peer.node_id, None)
+
         while not self._stopping:
             await asyncio.sleep(interval_s)
-            for peer in list(self.peers.values()):
-                try:
-                    await asyncio.wait_for(self.ping(peer), timeout=timeout_s)
-                    misses.pop(peer.node_id, None)
-                except (asyncio.TimeoutError, ConnectionError, OSError):
-                    n = misses.get(peer.node_id, 0) + 1
-                    misses[peer.node_id] = n
-                    if n >= max_misses:
-                        self.log.warning(
-                            "peer %s missed %d heartbeats, dropping",
-                            peer.node_id[:8], n,
-                        )
-                        peer.stream.close()
-                        self._drop_peer(peer)
-                        misses.pop(peer.node_id, None)
+            # concurrent: one hung peer must not delay liveness checks for
+            # the rest (a round is bounded by one timeout, not k of them)
+            await asyncio.gather(*(beat(p) for p in list(self.peers.values())))
 
     # ------------------------------------------------------------ DHT RPC
     async def dht_store(self, key: str, value: Any) -> int:

@@ -151,8 +151,8 @@ class DistributedJob:
             except (ConnectionError, asyncio.TimeoutError, RuntimeError):
                 if attempt == self.max_step_retries or self.validator is None:
                     raise
-                await self._abort_step()
-                await self.recover_dead_stages()
+                acked = await self._abort_step()
+                await self.recover_dead_stages(aborted=acked)
         raise AssertionError("unreachable")
 
     async def _try_train_step(self, batch_x, loss_grad_fn) -> float:
@@ -178,32 +178,42 @@ class DistributedJob:
                 t.cancel()
             await asyncio.gather(*tasks, return_exceptions=True)
             raise
-        await asyncio.gather(
-            *(
-                self.user.request(
-                    st.peer,
-                    {
-                        "type": "STEP_END",
-                        "job_id": self.job.job_id,
-                        "stage": st.index,
-                    },
-                    timeout=30.0,
-                )
-                for st in self.stages
+        async def end(st: RemoteStage):
+            # carries the logical step so a retried STEP_END (slow worker,
+            # master timeout) is idempotent on the worker side, and the
+            # reply type is checked so an ERROR is not treated as success
+            # (review finding)
+            resp = await self.user.request(
+                st.peer,
+                {
+                    "type": "STEP_END",
+                    "job_id": self.job.job_id,
+                    "stage": st.index,
+                    "step": step,
+                    "fence": self._fence,
+                },
+                timeout=30.0,
             )
-        )
+            if resp.get("type") != "STEPPED":
+                raise RuntimeError(f"stage {st.index} step_end failed: {resp}")
+
+        await asyncio.gather(*(end(st) for st in self.stages))
         self.step += 1
         return float(np.mean(losses))
 
     # ------------------------------------------------------- fault recovery
-    async def _abort_step(self) -> None:
-        """Clear partial grads/activations on every still-reachable stage."""
+    async def _abort_step(self, timeout: float = 5.0) -> set[int]:
+        """Clear partial grads/activations on every still-reachable stage.
+        Returns the stage indices that ACKED the abort — a stage that did
+        not ack still holds the old fence and possibly partial grads, and
+        must be reset or recovered before a retry (review finding)."""
 
         self._fence += 1
+        acked: set[int] = set()
 
         async def abort(st: RemoteStage):
             try:
-                await self.user.request(
+                resp = await self.user.request(
                     st.peer,
                     {
                         "type": "ABORT_STEP",
@@ -211,12 +221,15 @@ class DistributedJob:
                         "stage": st.index,
                         "fence": self._fence,
                     },
-                    timeout=5.0,
+                    timeout=timeout,
                 )
+                if resp.get("type") == "STEP_ABORTED":
+                    acked.add(st.index)
             except (ConnectionError, asyncio.TimeoutError):
-                pass  # dead stage: recovered separately
+                pass  # dead or hung stage: resolved by recover_dead_stages
 
         await asyncio.gather(*(abort(st) for st in self.stages))
+        return acked
 
     async def _live_stage(self, st: RemoteStage) -> bool:
         if st.peer.node_id not in self.user.peers:
@@ -227,26 +240,56 @@ class DistributedJob:
         except (ConnectionError, asyncio.TimeoutError, OSError):
             return False
 
-    async def recover_dead_stages(self) -> list[int]:
+    async def recover_dead_stages(self, aborted: set[int] | None = None) -> list[int]:
         """Probe all stages; re-place every dead one via the validator and
         re-ship its module spec + last-known params. Surviving stages are
         rolled back to the SAME cached snapshot — otherwise the pipeline
         would compose params from different training steps (review
         finding: a dead stage restarts from the last checkpoint while
         survivors are N steps ahead, silently training a mixed-version
-        model). Returns recovered stage indices."""
+        model). A stage that is alive but did NOT ack the abort
+        (slow/hung) still holds a stale fence and partial grads — retry
+        the abort once, and failing that treat it as dead (review
+        finding). Returns recovered stage indices."""
         alive = await asyncio.gather(*(self._live_stage(s) for s in self.stages))
+        dead = {st.index for st, ok in zip(self.stages, alive) if not ok}
+        if aborted is not None:
+
+            async def retry_abort(st: RemoteStage):
+                try:
+                    resp = await self.user.request(
+                        st.peer,
+                        {
+                            "type": "ABORT_STEP",
+                            "job_id": self.job.job_id,
+                            "stage": st.index,
+                            "fence": self._fence,
+                        },
+                        timeout=10.0,
+                    )
+                    if resp.get("type") != "STEP_ABORTED":
+                        dead.add(st.index)
+                except (ConnectionError, asyncio.TimeoutError):
+                    dead.add(st.index)
+
+            await asyncio.gather(
+                *(
+                    retry_abort(st)
+                    for st, ok in zip(list(self.stages), alive)
+                    if ok and st.index not in aborted and st.index not in dead
+                )
+            )
         recovered = []
-        for st, ok in zip(list(self.stages), alive):
-            if not ok:
+        for st in list(self.stages):
+            if st.index in dead:
                 await self.recover_stage(st.index, dead_id=st.peer.node_id)
                 recovered.append(st.index)
         if recovered:
             await asyncio.gather(
                 *(
                     self._ship_stage(st.peer, st.index)
-                    for st, ok in zip(self.stages, alive)
-                    if ok and st.index not in recovered
+                    for st in self.stages
+                    if st.index not in recovered
                 )
             )
         return recovered

@@ -259,64 +259,64 @@ class ValidatorNode(Node):
             peer = await self.connect(placement["host"], int(placement["port"]))
 
         base = {"job_id": job_id, "stage": stage_index}
+        # include_params: the worker snapshots one immutable param tree and
+        # computes proof + digest + returned weights from it, so the audit
+        # can never race a live optimizer step (review finding: the old
+        # two-request flow was inconclusive for every busy honest worker,
+        # and three in a row slashed them to zero)
         proof = await self.request(
             peer,
             {**base, "type": "POL_CHALLENGE", "seed": seed,
-             "shape": list(in_shape)},
-            timeout=30.0,
-        )
-        presp = await self.request(
-            peer, {**base, "type": "PARAMS_REQUEST"}, timeout=30.0
+             "shape": list(in_shape), "include_params": True},
+            timeout=60.0,
         )
         record: dict[str, Any] = {
             "job_id": job_id, "stage": stage_index, "worker": wid,
             "seed": seed, "at": time.time(),
         }
-        if proof.get("type") != "POL_PROOF" or presp.get("type") != "PARAMETERS":
-            record.update(passed=False, reason="no proof/params")
+        atomic = "weights" in proof
+        if proof.get("type") != "POL_PROOF":
+            record.update(passed=False, reason="no proof")
         else:
-            params = tree_unflatten_arrays(unpack_arrays(presp["weights"]))
+            if atomic:
+                params = tree_unflatten_arrays(unpack_arrays(proof["weights"]))
+            else:
+                # older worker: fetch params separately (may race a live
+                # optimizer step — treated as inconclusive, never slashed)
+                presp = await self.request(
+                    peer, {**base, "type": "PARAMS_REQUEST"}, timeout=30.0
+                )
+                if presp.get("type") != "PARAMETERS":
+                    record.update(passed=False, reason="no params")
+                    return self._finish_audit(job_id, wid, peer, record)
+                params = tree_unflatten_arrays(unpack_arrays(presp["weights"]))
+            digest_ok = pol.params_digest(params) == proof.get("params_digest")
             x = pol.challenge_input(seed, tuple(in_shape))
             out, gx = pol.replay_stage(spec.module_config, params, x)
             ok_out = pol.verify_commitment(out, proof["output"], rtol=rtol)
             ok_gx = pol.verify_commitment(gx, proof["input_grad"], rtol=rtol)
-            digest_ok = pol.params_digest(params) == proof.get("params_digest")
-            if ok_out and ok_gx:
-                # replay with the fetched params matches the proof — the
-                # worker computes its stage honestly (even if the digest
-                # raced with a live optimizer step)
+            if ok_out and ok_gx and (digest_ok or not atomic):
                 record.update(passed=True, forward_ok=True, grad_ok=True,
                               step=proof.get("step"))
-            elif not digest_ok:
-                # params moved between challenge and fetch (live training)
-                # — inconclusive ONCE, but persistently "inconclusive"
-                # workers are slashed: otherwise a cheater evades forever
-                # by rotating params or lying in params_digest (review
-                # finding)
-                prior = [
-                    a
-                    for a in self.job_state.get(job_id, {}).get("audits", [])
-                    if a.get("stage") == stage_index and a.get("worker") == wid
-                ]
-                streak = 0
-                for a in reversed(prior):
-                    if a.get("passed") is None:
-                        streak += 1
-                    else:
-                        break
-                if streak >= 2:  # this makes 3 consecutive inconclusives
-                    record.update(
-                        passed=False, reason="persistent inconclusive audits"
-                    )
-                else:
-                    record.update(passed=None, reason="params changed mid-audit")
+            elif not atomic and not digest_ok:
+                # legacy two-request flow raced a live optimizer step —
+                # inconclusive, never slashed (review finding)
+                record.update(passed=None, reason="params changed mid-audit")
             else:
+                # weights and proof arrive in one atomic reply: any
+                # mismatch is the worker's fault, never an audit race
                 record.update(
                     passed=False,
                     forward_ok=bool(ok_out),
                     grad_ok=bool(ok_gx),
+                    digest_ok=bool(digest_ok),
                     step=proof.get("step"),
                 )
+        return self._finish_audit(job_id, wid, peer, record)
+
+    def _finish_audit(
+        self, job_id: str, wid: str, peer: Peer | None, record: dict
+    ) -> dict:
         st = self.job_state.setdefault(job_id, {})
         st.setdefault("audits", []).append(record)
         if record.get("passed") is False:

@@ -41,6 +41,12 @@ from tensorlink_tpu.train.optim import apply_updates, make_optimizer
 from tensorlink_tpu.utils.trees import tree_bytes
 
 
+class StaleFenceError(RuntimeError):
+    """A data-plane op from an aborted step attempt reached the runner
+    after its fence advanced; the result must be discarded, not
+    accumulated."""
+
+
 def host_free_memory_bytes() -> int:
     try:
         import psutil
@@ -68,6 +74,7 @@ class StageRunner:
     inputs: dict = field(default_factory=dict)  # (step, micro) -> activation
     grad_accum: Any = None
     micro_seen: int = 0
+    last_applied_step: int = -1  # master step already applied (idempotency)
 
     def __post_init__(self):
         import threading
@@ -83,17 +90,39 @@ class StageRunner:
 
         self._bwd = jax.jit(bwd)
 
-    def forward(self, step: int, micro: int, x: np.ndarray) -> np.ndarray:
+        # PoL replay: must be the IDENTICAL program structure to the
+        # validator's pol.replay_stage (vjp wrt x only, fused fwd+gx) so
+        # same-platform audits stay bitwise-equal; _fwd/_bwd are different
+        # programs whose fusion may differ by an ulp (review finding). jit
+        # is lazy, so this costs nothing unless the stage is audited.
+        def pol_run(p, xx):
+            out, vjp = jax.vjp(lambda xxx: mod.apply(p, xxx), xx)
+            (gx,) = vjp(jnp.ones_like(out))
+            return out, gx
+
+        self._pol = jax.jit(pol_run)
+
+    def forward(self, step: int, micro: int, x: np.ndarray, fence: int = 0) -> np.ndarray:
         xj = jnp.asarray(x)
         with self._lock:
+            if fence < self.fence:
+                raise StaleFenceError(f"fence {fence} < {self.fence}")
             self.inputs[(step, micro)] = xj
         return np.asarray(self._fwd(self.params, xj))
 
-    def backward(self, step: int, micro: int, g: np.ndarray) -> np.ndarray:
+    def backward(self, step: int, micro: int, g: np.ndarray, fence: int = 0) -> np.ndarray:
         with self._lock:
+            if fence < self.fence:
+                raise StaleFenceError(f"fence {fence} < {self.fence}")
             xj = self.inputs.pop((step, micro))
         gp, gx = self._bwd(self.params, xj, jnp.asarray(g))
         with self._lock:
+            # re-check under the lock: ABORT_STEP may have advanced the
+            # fence and cleared grad_accum while the vjp ran in this
+            # thread — accumulating now would double-count this micro in
+            # the retried step (review finding)
+            if fence < self.fence:
+                raise StaleFenceError(f"fence {fence} < {self.fence}")
             if self.grad_accum is None:
                 self.grad_accum = gp
             else:
@@ -110,19 +139,37 @@ class StageRunner:
             self.micro_seen = 0
             self.inputs.clear()
 
-    def apply_step(self) -> None:
+    def apply_step(self, master_step: int | None = None, fence: int = 0) -> bool:
+        """Apply the accumulated gradient. Idempotent per logical
+        ``master_step``: a retried STEP_END (e.g. the master timed out on a
+        slow-but-successful first attempt) must not double-apply (review
+        finding). Fenced like FORWARD/BACKWARD: a straggling STEP_END from
+        an aborted attempt must not apply a partial gradient or poison the
+        idempotency guard (review finding). Returns True if applied."""
         with self._lock:
+            if fence < self.fence:
+                return False  # stale attempt; leave accum for the retry
+            if master_step is not None and master_step <= self.last_applied_step:
+                # already applied for this logical step (first attempt
+                # landed; the master retried). Discard the retry's
+                # re-accumulated grads or they'd leak into the NEXT step.
+                self.grad_accum = None
+                self.micro_seen = 0
+                return False
             if self.grad_accum is None:
-                return
+                return False
             grads, n = self.grad_accum, max(self.micro_seen, 1)
             self.grad_accum = None
             self.micro_seen = 0
+            if master_step is not None:
+                self.last_applied_step = master_step
         grads = jax.tree.map(lambda g: g / n, grads)
         updates, self.opt_state = self.opt.update(
             grads, self.opt_state, self.params, self.step
         )
         self.params = apply_updates(self.params, updates)
         self.step += 1
+        return True
 
 
 class WorkerNode(Node):
@@ -304,9 +351,13 @@ class WorkerNode(Node):
         if int(msg.get("fence", 0)) < runner.fence:
             return {"type": "ERROR", "error": "stale fence (aborted step)"}
         x = unpack_arrays(msg["data"])["x"]
-        out = await asyncio.to_thread(
-            runner.forward, int(msg["step"]), int(msg["micro"]), x
-        )
+        try:
+            out = await asyncio.to_thread(
+                runner.forward, int(msg["step"]), int(msg["micro"]), x,
+                int(msg.get("fence", 0)),
+            )
+        except StaleFenceError:
+            return {"type": "ERROR", "error": "stale fence (aborted step)"}
         reply = {
             "type": "ACTIVATION",
             "job_id": msg["job_id"],
@@ -324,9 +375,13 @@ class WorkerNode(Node):
         if int(msg.get("fence", 0)) < runner.fence:
             return {"type": "ERROR", "error": "stale fence (aborted step)"}
         g = unpack_arrays(msg["data"])["g"]
-        gx = await asyncio.to_thread(
-            runner.backward, int(msg["step"]), int(msg["micro"]), g
-        )
+        try:
+            gx = await asyncio.to_thread(
+                runner.backward, int(msg["step"]), int(msg["micro"]), g,
+                int(msg.get("fence", 0)),
+            )
+        except StaleFenceError:
+            return {"type": "ERROR", "error": "stale fence (aborted step)"}
         return {
             "type": "INPUT_GRAD",
             "job_id": msg["job_id"],
@@ -342,8 +397,11 @@ class WorkerNode(Node):
         runner = self._authorized_runner(peer, msg)
         if isinstance(runner, dict):
             return runner
-        await asyncio.to_thread(runner.apply_step)
-        return {"type": "STEPPED", "step": runner.step}
+        master_step = int(msg["step"]) if "step" in msg else None
+        applied = await asyncio.to_thread(
+            runner.apply_step, master_step, int(msg.get("fence", 0))
+        )
+        return {"type": "STEPPED", "step": runner.step, "applied": applied}
 
     async def _h_abort_step(self, node, peer, msg) -> dict:
         """Discard partial grads/activations after a mid-step stage
@@ -427,21 +485,38 @@ class WorkerNode(Node):
             shape = tuple(int(s) for s in msg["shape"])
             x = pol.challenge_input(int(msg["seed"]), shape, msg.get("dtype", "float32"))
 
+        # snapshot ONCE: proof, digest, and (optionally) the returned
+        # weights all come from the same immutable param tree, so a live
+        # optimizer step can never make an honest proof inconclusive
+        # (review finding: the separate PARAMS_REQUEST raced with training
+        # and persistently-inconclusive honest workers got slashed)
+        p = runner.params
+        step = runner.step
+
         def compute():
-            out, gx = pol.replay_stage(runner.module.config(), runner.params, x)
+            # reuse the runner's cached _pol jit instead of re-jitting per
+            # audit (review finding: pol.replay_stage builds a fresh
+            # closure and pays a full XLA compile on every challenge)
+            out, gx = runner._pol(p, x)
             return np.asarray(out), np.asarray(gx)
 
         out, gx = await asyncio.to_thread(compute)
         out_c = pol.commitment(out)
-        return {
+        reply = {
             "type": "POL_PROOF",
             "job_id": msg["job_id"],
             "stage": msg["stage"],
-            "step": runner.step,
+            "step": step,
             "output": out_c,
             "input_grad": pol.commitment(gx),
-            "params_digest": pol.params_digest(runner.params),
+            "params_digest": pol.params_digest(p),
             # back-compat fields
             "digest": out_c["digest"],
             "output_sum": float(out.sum()),
         }
+        if msg.get("include_params"):
+            flat = await asyncio.to_thread(
+                lambda: tree_flatten_arrays(jax.tree.map(np.asarray, p))
+            )
+            reply["weights"] = pack_arrays(flat)
+        return reply

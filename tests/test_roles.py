@@ -448,3 +448,56 @@ async def test_heartbeat_drops_silent_peer():
     assert peer.node_id not in a.peers
     await a.stop()
     await b.stop()
+
+
+def test_step_end_idempotent_per_logical_step():
+    """A retried STEP_END for an already-applied logical step must not
+    double-apply the optimizer update, and must discard the retry's
+    re-accumulated grads (review finding)."""
+    from tensorlink_tpu.roles.worker import StageRunner
+    from tensorlink_tpu.train.optim import make_optimizer
+
+    m, p = _model()
+    mod, params = m.seq, p["seq"]
+    opt = make_optimizer("sgd", 0.1, 0.0)
+    r = StageRunner(
+        job_id="j", stage_index=0, module=mod, params=params,
+        opt=opt, opt_state=opt.init(params),
+    )
+    x = np.random.default_rng(0).normal(size=(4, 16)).astype(np.float32)
+    out = r.forward(0, 0, x)
+    r.backward(0, 0, np.ones_like(out))
+    assert r.apply_step(0) is True
+    p_after = jax.tree.map(np.asarray, r.params)
+
+    # retried step 0: re-accumulate, then idempotent STEP_END
+    out = r.forward(0, 0, x, fence=r.fence)
+    r.backward(0, 0, np.ones_like(out), fence=r.fence)
+    assert r.apply_step(0) is False  # skipped
+    jax.tree.map(
+        np.testing.assert_array_equal, jax.tree.map(np.asarray, r.params), p_after
+    )
+    # and the retry's grads were discarded, not leaked into step 1
+    assert r.grad_accum is None and r.micro_seen == 0
+
+
+def test_stale_fence_rejected_at_accumulate_time():
+    """A backward landing after an abort advanced the fence must not
+    accumulate (review finding: fence was only checked at handler entry)."""
+    from tensorlink_tpu.roles.worker import StageRunner, StaleFenceError
+    from tensorlink_tpu.train.optim import make_optimizer
+
+    m, p = _model()
+    mod, params = m.seq, p["seq"]
+    opt = make_optimizer("sgd", 0.1, 0.0)
+    r = StageRunner(
+        job_id="j", stage_index=0, module=mod, params=params,
+        opt=opt, opt_state=opt.init(params),
+    )
+    x = np.random.default_rng(0).normal(size=(4, 16)).astype(np.float32)
+    out = r.forward(0, 0, x, fence=0)
+    r.fence = 1  # abort arrives
+    r.reset_step()
+    with pytest.raises(StaleFenceError):
+        r.backward(0, 0, np.ones_like(out), fence=0)
+    assert r.grad_accum is None and r.micro_seen == 0
