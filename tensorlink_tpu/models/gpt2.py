@@ -70,6 +70,7 @@ class GPT2(Module):
         *,
         caches=None,
         positions=None,
+        mask=None,
         rng=None,
         train=False,
         logits: bool = True,
@@ -89,11 +90,13 @@ class GPT2(Module):
         blocks = self.children["blocks"]
         if caches is not None:
             attn_caches = [c["attn"] for c in caches]
-            x, new_attn = blocks.apply(params["blocks"], x, caches=attn_caches, rng=r1, train=train)
+            x, new_attn = blocks.apply(
+                params["blocks"], x, mask=mask, caches=attn_caches, rng=r1, train=train
+            )
             new_caches = [{"attn": c} for c in new_attn]
         else:
             new_caches = None
-            x = blocks.apply(params["blocks"], x, rng=r1, train=train)
+            x = blocks.apply(params["blocks"], x, mask=mask, rng=r1, train=train)
 
         x = self.children["ln_f"].apply(params["ln_f"], x)
         out = self.children["wte"].attend(params["wte"], x) if logits else x

@@ -224,6 +224,41 @@ def vit_params_from_hf(sd: Mapping[str, np.ndarray], cfg: "ViTConfig") -> dict:
     return _to_jnp(p)
 
 
+def llama_params_from_hf(sd: Mapping[str, np.ndarray], cfg: "LlamaConfig") -> dict:
+    """Map an HF ``LlamaForCausalLM`` state dict onto the native `Llama`
+    param tree. Expects full-model keys (``model.embed_tokens...`` +
+    ``lm_head.weight``). Tied-embedding checkpoints (e.g. llama-3.2-1b)
+    may omit ``lm_head.weight``; the embedding is reused then."""
+    p: dict = {
+        "tok_emb": {"table": _a(sd["model.embed_tokens.weight"])},
+        "blocks": {},
+        "norm_f": {"scale": _a(sd["model.norm.weight"])},
+        "lm_head": {
+            "w": _t(sd.get("lm_head.weight", sd["model.embed_tokens.weight"]))
+        },
+    }
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        p["blocks"][str(i)] = {
+            "norm1": {"scale": _a(sd[pre + "input_layernorm.weight"])},
+            "norm2": {"scale": _a(sd[pre + "post_attention_layernorm.weight"])},
+            "attn": {
+                "q": {"w": _t(sd[pre + "self_attn.q_proj.weight"])},
+                "k": {"w": _t(sd[pre + "self_attn.k_proj.weight"])},
+                "v": {"w": _t(sd[pre + "self_attn.v_proj.weight"])},
+                "o": {"w": _t(sd[pre + "self_attn.o_proj.weight"])},
+            },
+            "mlp": {
+                "up": {"w": _t(sd[pre + "mlp.up_proj.weight"])},
+                "gate": {"w": _t(sd[pre + "mlp.gate_proj.weight"])},
+                "down": {"w": _t(sd[pre + "mlp.down_proj.weight"])},
+                "drop": {},
+            },
+            "drop": {},
+        }
+    return _to_jnp(p)
+
+
 def _to_jnp(tree):
     import jax
 

@@ -1,0 +1,153 @@
+"""Llama 2/3 family, TPU-native (BASELINE.json config[4]: Llama-3-8B
+sharded inference).
+
+RMSNorm + RoPE + grouped-query attention + SwiGLU, no biases, untied LM
+head — built from the framework's own blocks so TP `PartitionSpec`s
+(Megatron col/row splits per block) and pipeline slicing apply unchanged.
+Weights import from HF `LlamaForCausalLM` checkpoints via
+models/hf_import.py; the reference would have shipped the whole module as
+a pickle (src/p2p/torch_node.py:159-162).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from tensorlink_tpu.nn.module import Module
+from tensorlink_tpu.nn.layers import Dense, Embedding, RMSNorm
+from tensorlink_tpu.nn.transformer import TransformerBlock, TransformerStack
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    hidden_dim: int = 14336
+    max_len: int = 8192
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def llama3_70b(cls) -> "LlamaConfig":
+        return cls(dim=8192, num_layers=80, num_heads=64, num_kv_heads=8,
+                   hidden_dim=28672)
+
+    @classmethod
+    def llama2_7b(cls) -> "LlamaConfig":
+        return cls(vocab_size=32000, dim=4096, num_layers=32, num_heads=32,
+                   num_kv_heads=32, hidden_dim=11008, max_len=4096,
+                   rope_theta=10000.0, rms_eps=1e-5)
+
+    @classmethod
+    def tiny(cls) -> "LlamaConfig":
+        return cls(vocab_size=128, dim=32, num_layers=2, num_heads=4,
+                   num_kv_heads=2, hidden_dim=64, max_len=64,
+                   rope_theta=10000.0)
+
+
+class Llama(Module):
+    def __init__(self, cfg: LlamaConfig = LlamaConfig()):
+        super().__init__()
+        self.cfg_obj = cfg
+        self.child("tok_emb", Embedding(cfg.vocab_size, cfg.dim))
+        self.child(
+            "blocks",
+            TransformerStack(
+                cfg.num_layers,
+                TransformerBlock,
+                dim=cfg.dim,
+                num_heads=cfg.num_heads,
+                num_kv_heads=cfg.num_kv_heads,
+                hidden_dim=cfg.hidden_dim,
+                norm_style="pre",
+                norm="rms",
+                norm_eps=cfg.rms_eps,
+                activation="silu",
+                use_bias=False,
+                gated_mlp=True,
+                causal=True,
+                rope=True,
+                rope_theta=cfg.rope_theta,
+                dropout=0.0,
+            ),
+        )
+        self.child("norm_f", RMSNorm(cfg.dim, eps=cfg.rms_eps))
+        self.child("lm_head", Dense(cfg.dim, cfg.vocab_size, use_bias=False, shard="col"))
+
+    def apply(
+        self,
+        params,
+        input_ids,
+        *,
+        caches=None,
+        positions=None,
+        mask=None,
+        rng=None,
+        train=False,
+        logits: bool = True,
+        **_,
+    ):
+        x = self.children["tok_emb"].apply(params["tok_emb"], input_ids)
+        blocks = self.children["blocks"]
+        if caches is not None:
+            attn_caches = [c["attn"] for c in caches]
+            x, new_attn = blocks.apply(
+                params["blocks"], x, mask=mask, caches=attn_caches,
+                positions=positions, rng=rng, train=train,
+            )
+            new_caches = [{"attn": c} for c in new_attn]
+        else:
+            new_caches = None
+            x = blocks.apply(
+                params["blocks"], x, mask=mask, positions=positions,
+                rng=rng, train=train,
+            )
+        x = self.children["norm_f"].apply(params["norm_f"], x)
+        out = (
+            self.children["lm_head"].apply(params["lm_head"], x) if logits else x
+        )
+        if caches is not None:
+            return out, new_caches
+        return out
+
+    def as_pipeline_parts(self, params):
+        from tensorlink_tpu.parallel.engine import PipelineParts
+
+        stack = self.children["blocks"]
+        block = stack.blocks()[0]
+        tok_emb = self.children["tok_emb"]
+        norm_f, lm_head = self.children["norm_f"], self.children["lm_head"]
+
+        def embed_fn(emb_params, batch):
+            return tok_emb.apply(emb_params["tok_emb"], batch["input_ids"])
+
+        def head_fn(all_params, x, batch):
+            h = norm_f.apply(all_params["head"]["norm_f"], x)
+            return lm_head.apply(all_params["head"]["lm_head"], h)
+
+        return PipelineParts(
+            embed_fn=embed_fn,
+            block=block,
+            block_params=params["blocks"],
+            block_fn=lambda bp, x: block.apply(bp, x),
+            head_fn=head_fn,
+            embed_params={"tok_emb": params["tok_emb"]},
+            head_params={"norm_f": params["norm_f"], "lm_head": params["lm_head"]},
+        )
+
+    def init_caches(self, batch: int, max_len: int, dtype=jnp.bfloat16):
+        stack = self.children["blocks"]
+        return [
+            {"attn": blk.children["attn"].init_cache(batch, max_len, dtype)}
+            for blk in stack.blocks()
+        ]

@@ -128,7 +128,7 @@ class TransformerBlock(Module):
         )
         self.child("drop", Dropout(dropout))
 
-    def apply(self, params, x, *, mask=None, cache=None, rng=None, train=False, **_):
+    def apply(self, params, x, *, mask=None, cache=None, positions=None, rng=None, train=False, **_):
         attn = self.children["attn"]
         mlp = self.children["mlp"]
         n1, n2 = self.children["norm1"], self.children["norm2"]
@@ -140,7 +140,7 @@ class TransformerBlock(Module):
         new_cache = None
         if self.norm_style == "pre":
             h = n1.apply(params["norm1"], x)
-            a = attn.apply(params["attn"], h, mask=mask, cache=cache)
+            a = attn.apply(params["attn"], h, mask=mask, cache=cache, positions=positions)
             if cache is not None:
                 a, new_cache = a
             x = x + drop.apply(params["drop"], a, rng=r1, train=train)
@@ -148,7 +148,7 @@ class TransformerBlock(Module):
             m = mlp.apply(params["mlp"], h, rng=r2, train=train)
             x = x + drop.apply(params["drop"], m, rng=r3, train=train)
         else:  # post-LN (BERT)
-            a = attn.apply(params["attn"], x, mask=mask, cache=cache)
+            a = attn.apply(params["attn"], x, mask=mask, cache=cache, positions=positions)
             if cache is not None:
                 a, new_cache = a
             x = n1.apply(params["norm1"], x + drop.apply(params["drop"], a, rng=r1, train=train))
@@ -168,18 +168,22 @@ class TransformerStack(Module):
         for i in range(num_layers):
             self.child(str(i), make_block(**block_kw))
 
-    def apply(self, params, x, *, mask=None, caches=None, rng=None, train=False, **_):
+    def apply(self, params, x, *, mask=None, caches=None, positions=None, rng=None, train=False, **_):
         new_caches = [] if caches is not None else None
         for i in range(self.num_layers):
             r = jax.random.fold_in(rng, i) if rng is not None else None
             blk = self.children[str(i)]
             if caches is not None:
                 x, c = blk.apply(
-                    params[str(i)], x, mask=mask, cache=caches[i], rng=r, train=train
+                    params[str(i)], x, mask=mask, cache=caches[i],
+                    positions=positions, rng=r, train=train,
                 )
                 new_caches.append(c)
             else:
-                x = blk.apply(params[str(i)], x, mask=mask, rng=r, train=train)
+                x = blk.apply(
+                    params[str(i)], x, mask=mask, positions=positions,
+                    rng=r, train=train,
+                )
         if caches is not None:
             return x, new_caches
         return x

@@ -1,0 +1,195 @@
+"""Sharded autoregressive inference engine (BASELINE.json config[4]:
+Llama-3-8B sharded inference across a pod slice).
+
+The reference has no inference path at all — serving would have meant the
+same pickled-module + socket hops as training (src/ml/distributed.py).
+Here inference is one XLA program per phase on a (data, model) mesh:
+
+- **prefill**: full-prompt forward populating the KV cache; causal flash
+  path, MXU-shaped.
+- **decode**: `lax.scan` over new tokens — the whole generation loop is a
+  single compiled program (no per-token Python or host↔device sync),
+  with the KV cache donated in place. TP collectives (psum from the
+  Megatron row-split projections) ride ICI; the `data` axis batches
+  independent sequences.
+
+Prompts are left-padded to a common length; positions derive from the
+per-row valid mask so RoPE and the causal mask see logical (unpadded)
+positions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tensorlink_tpu.nn.module import Module, spec_tree_to_shardings
+
+
+@dataclass(frozen=True)
+class GenerationConfig:
+    max_new_tokens: int = 64
+    temperature: float = 0.0  # 0 => greedy
+    top_k: int = 0  # 0 => full softmax
+    eos_token_id: int | None = None
+
+
+class InferenceEngine:
+    """Greedy/temperature sampling over a TP(+DP)-sharded model.
+
+    ``model.apply(params, ids, caches=..., positions=...)`` must follow the
+    decoder contract of models/gpt2.py / models/llama.py: returns
+    ``(logits, new_caches)`` when caches are given, and expose
+    ``init_caches(batch, max_len, dtype)``.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        model: Module,
+        params: Any,
+        *,
+        max_len: int = 2048,
+        cache_dtype=jnp.bfloat16,
+        param_dtype=jnp.bfloat16,
+        data_axis: str = "data",
+        model_axis: str = "model",
+    ):
+        self.mesh = mesh
+        self.model = model
+        self.max_len = max_len
+        self.cache_dtype = cache_dtype
+        self.data_axis = data_axis
+        self.model_axis = model_axis
+
+        specs = model.param_spec(model_axis=model_axis)
+        shardings = spec_tree_to_shardings(specs, mesh)
+        self.params = jax.tree.map(
+            lambda x, s: jax.device_put(
+                x.astype(param_dtype)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                else jnp.asarray(x),
+                s,
+            ),
+            params,
+            shardings,
+        )
+        self._generate_jit = {}
+
+    # ------------------------------------------------------------ internals
+    def _sample(self, logits, key, temperature, top_k):
+        logits = logits.astype(jnp.float32)
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1)
+        logits = logits / temperature
+        if top_k:
+            # lax.top_k is O(V log k) and TPU-optimized; this runs inside
+            # the per-token decode scan, so a full vocab sort would be on
+            # the hot path (review finding)
+            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        return jax.random.categorical(key, logits, axis=-1)
+
+    def _build(self, B: int, T0: int, gen: GenerationConfig):
+        """One jitted program: prefill + lax.scan decode. Retraced per
+        (batch, prompt_len, generation config) — cached across calls."""
+        model = self.model
+        L = self.max_len
+        temperature, top_k = float(gen.temperature), int(gen.top_k)
+        max_new = int(gen.max_new_tokens)
+        eos = gen.eos_token_id
+
+        def run(params, ids, pad_mask, key):
+            # logical positions: pads get 0, first real token position 0
+            pos = jnp.maximum(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
+            n_valid = pad_mask.sum(-1)  # [B]
+            caches = model.init_caches(B, L, dtype=self.cache_dtype)
+
+            # prefill attention mask over ALL cache slots [B, 1, T0, L]:
+            # key slot must be a real prompt token at or before the query
+            # slot (left padding => slot order == logical order)
+            qslot = jnp.arange(T0)[None, None, :, None]
+            kslot = jnp.arange(L)[None, None, None, :]
+            kreal = jnp.zeros((B, L), bool).at[:, :T0].set(pad_mask.astype(bool))
+            causal = (kslot <= qslot) & kreal[:, None, None, :]
+            logits, caches = model.apply(
+                params, ids, caches=caches, positions=pos, mask=causal
+            )
+            last = logits[:, -1]  # [B, V] (prompts are left-padded)
+
+            # valid-slot mask over the cache, extended as tokens generate
+            valid0 = jnp.zeros((B, L), bool).at[:, :T0].set(pad_mask.astype(bool))
+
+            def step(carry, i):
+                # the carried token was generated at loop index i-1: it is
+                # written to cache slot T0+i-1 and has logical position
+                # n_valid+i-1
+                caches, valid, tok, key, done = carry
+                key, sub = jax.random.split(key)
+                positions = (n_valid + i - 1)[:, None]  # [B, 1]
+                valid = valid.at[:, T0 + i - 1].set(True)
+                mask = valid[:, None, None, :]
+                logits, caches = model.apply(
+                    params, tok[:, None], caches=caches,
+                    positions=positions, mask=mask,
+                )
+                nxt = self._sample(logits[:, -1], sub, temperature, top_k)
+                if eos is not None:
+                    nxt = jnp.where(done, eos, nxt)
+                    done = done | (nxt == eos)
+                return (caches, valid, nxt, key, done), nxt
+
+            tok0 = self._sample(last, key, temperature, top_k)
+            done0 = (
+                (tok0 == eos) if eos is not None else jnp.zeros((B,), bool)
+            )
+            carry = (caches, valid0, tok0, key, done0)
+            (_, _, _, _, _), toks = jax.lax.scan(
+                step, carry, jnp.arange(1, max_new)
+            )
+            return jnp.concatenate([tok0[:, None], toks.T], axis=1)
+
+        dsh = NamedSharding(self.mesh, P(self.data_axis, None))
+        rep = NamedSharding(self.mesh, P())
+        return jax.jit(
+            run,
+            in_shardings=(None, dsh, dsh, rep),
+            out_shardings=dsh,
+        )
+
+    # ------------------------------------------------------------- public
+    def generate(
+        self,
+        ids: np.ndarray,
+        gen: GenerationConfig | None = None,
+        *,
+        pad_mask: np.ndarray | None = None,
+        rng: jax.Array | None = None,
+    ) -> np.ndarray:
+        """ids: [B, T0] left-padded prompts; returns [B, max_new_tokens]."""
+        gen = gen or GenerationConfig()
+        ids = np.asarray(ids)
+        B, T0 = ids.shape
+        if T0 + gen.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt {T0} + new {gen.max_new_tokens} exceeds max_len {self.max_len}"
+            )
+        if pad_mask is None:
+            pad_mask = np.ones_like(ids)
+        key = (B, T0, gen)
+        if key not in self._generate_jit:
+            self._generate_jit[key] = self._build(B, T0, gen)
+        fn = self._generate_jit[key]
+        out = fn(
+            self.params,
+            jnp.asarray(ids),
+            jnp.asarray(pad_mask, jnp.int32),
+            rng if rng is not None else jax.random.key(0),
+        )
+        return np.asarray(out)

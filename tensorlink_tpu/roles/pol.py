@@ -83,20 +83,36 @@ def params_digest(params: Any) -> str:
     return h.hexdigest()
 
 
+_REPLAY_CACHE: dict[str, Any] = {}
+
+
 def replay_stage(module_config: dict, params: Any, x: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Validator-side re-execution: rebuild the module from its spec (the
     job record the validator approved — trusted, never worker-supplied),
-    jit, and compute (forward output, input-cotangent of sum(out))."""
+    jit, and compute (forward output, input-cotangent of sum(out)).
+
+    The jitted program is cached per module_config: a fresh closure per
+    audit would defeat jax's compile cache and pay a full XLA compile on
+    every challenge (review finding — same fix as the worker's cached
+    ``StageRunner._pol``, whose program structure this must keep matching
+    bitwise)."""
     from tensorlink_tpu.nn.module import module_from_config
 
-    mod = module_from_config(module_config)
+    import json
 
-    # forward + input-grad in one jit; cotangent is fixed (ones) so both
-    # sides compute comparable gradients without extra wire traffic
-    @jax.jit
-    def run(p, xx):
-        out, vjp = jax.vjp(lambda xxx: mod.apply(p, xxx), xx)
-        (gx,) = vjp(jnp.ones_like(out))
-        return out, gx
+    key = json.dumps(module_config, sort_keys=True, default=str)
+    run = _REPLAY_CACHE.get(key)
+    if run is None:
+        mod = module_from_config(module_config)
+
+        # forward + input-grad in one jit; cotangent is fixed (ones) so
+        # both sides compute comparable gradients without extra traffic
+        @jax.jit
+        def run(p, xx):
+            out, vjp = jax.vjp(lambda xxx: mod.apply(p, xxx), xx)
+            (gx,) = vjp(jnp.ones_like(out))
+            return out, gx
+
+        _REPLAY_CACHE[key] = run
 
     return run(params, x)

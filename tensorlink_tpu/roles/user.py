@@ -56,6 +56,11 @@ def partition_sequential(
     return stages
 
 
+class StepEndFailure(RuntimeError):
+    """A failure during the STEP_END fan-out: some stages may have already
+    applied the optimizer update while others have not."""
+
+
 @dataclass
 class RemoteStage:
     index: int
@@ -148,11 +153,16 @@ class DistributedJob:
         for attempt in range(self.max_step_retries + 1):
             try:
                 return await self._try_train_step(batch_x, loss_grad_fn)
-            except (ConnectionError, asyncio.TimeoutError, RuntimeError):
+            except (ConnectionError, asyncio.TimeoutError, RuntimeError) as e:
                 if attempt == self.max_step_retries or self.validator is None:
                     raise
                 acked = await self._abort_step()
-                await self.recover_dead_stages(aborted=acked)
+                await self.recover_dead_stages(
+                    aborted=acked,
+                    # STEP_END may have landed on a subset of stages; the
+                    # only consistent restart point is the shared snapshot
+                    rollback_all=isinstance(e, StepEndFailure),
+                )
         raise AssertionError("unreachable")
 
     async def _try_train_step(self, batch_x, loss_grad_fn) -> float:
@@ -197,7 +207,14 @@ class DistributedJob:
             if resp.get("type") != "STEPPED":
                 raise RuntimeError(f"stage {st.index} step_end failed: {resp}")
 
-        await asyncio.gather(*(end(st) for st in self.stages))
+        try:
+            await asyncio.gather(*(end(st) for st in self.stages))
+        except (ConnectionError, asyncio.TimeoutError, RuntimeError) as e:
+            # some stages may have applied the step, others not: the
+            # retry must not train a mixed-version pipeline (review
+            # finding) — tagged so train_step rolls EVERY stage back to
+            # the same snapshot
+            raise StepEndFailure(str(e)) from e
         self.step += 1
         return float(np.mean(losses))
 
@@ -240,7 +257,9 @@ class DistributedJob:
         except (ConnectionError, asyncio.TimeoutError, OSError):
             return False
 
-    async def recover_dead_stages(self, aborted: set[int] | None = None) -> list[int]:
+    async def recover_dead_stages(
+        self, aborted: set[int] | None = None, rollback_all: bool = False
+    ) -> list[int]:
         """Probe all stages; re-place every dead one via the validator and
         re-ship its module spec + last-known params. Surviving stages are
         rolled back to the SAME cached snapshot — otherwise the pipeline
@@ -284,7 +303,7 @@ class DistributedJob:
             if st.index in dead:
                 await self.recover_stage(st.index, dead_id=st.peer.node_id)
                 recovered.append(st.index)
-        if recovered:
+        if recovered or rollback_all:
             await asyncio.gather(
                 *(
                     self._ship_stage(st.peer, st.index)

@@ -300,8 +300,27 @@ class ValidatorNode(Node):
                               step=proof.get("step"))
             elif not atomic and not digest_ok:
                 # legacy two-request flow raced a live optimizer step —
-                # inconclusive, never slashed (review finding)
-                record.update(passed=None, reason="params changed mid-audit")
+                # inconclusive once, but a worker that KEEPS withholding
+                # weights and never matches its digest is evading audits
+                # (it controls the reply, so it chooses the legacy path):
+                # three consecutive inconclusives slash (review finding)
+                prior = [
+                    a
+                    for a in self.job_state.get(job_id, {}).get("audits", [])
+                    if a.get("stage") == stage_index and a.get("worker") == wid
+                ]
+                streak = 0
+                for a in reversed(prior):
+                    if a.get("passed") is None:
+                        streak += 1
+                    else:
+                        break
+                if streak >= 2:  # this makes 3 consecutive inconclusives
+                    record.update(
+                        passed=False, reason="persistent inconclusive audits"
+                    )
+                else:
+                    record.update(passed=None, reason="params changed mid-audit")
             else:
                 # weights and proof arrive in one atomic reply: any
                 # mismatch is the worker's fault, never an audit race
