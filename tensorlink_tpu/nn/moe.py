@@ -1,0 +1,162 @@
+"""Mixture-of-Experts feed-forward with expert parallelism.
+
+The reference has no MoE/expert parallelism at all (survey §2.3: "EP —
+absent"); this is TPU-native from scratch. Design:
+
+- Experts are ONE stacked param tree with a leading [E, ...] axis, sharded
+  over the mesh's ``model`` axis (`P(model, ...)`) — expert parallelism is
+  just tensor sharding on that axis, and the dispatch/combine einsums
+  lower to `all_to_all` collectives under the XLA SPMD partitioner. No
+  per-expert Python modules, no host-side routing.
+- Token-choice top-k routing (Switch/GShard style) with a capacity
+  factor: position-in-expert comes from a cumulative sum over the token
+  axis, overflow tokens are dropped (their residual path carries them).
+- The router's auxiliary load-balancing loss (mean fraction x mean
+  probability per expert, scaled by E) is returned alongside the output
+  so trainers can add ``aux_weight * aux_loss``.
+
+Everything is dense einsum algebra on one-hot dispatch tensors —
+MXU-shaped, static shapes, no data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tensorlink_tpu.nn.module import Module, register_module_type
+from tensorlink_tpu.nn.layers import _lecun_normal, _normal
+
+
+@register_module_type
+class MoEFeedForward(Module):
+    """Drop-in replacement for FeedForward: [B, T, D] -> [B, T, D].
+
+    ``apply`` returns just the output; ``apply_with_aux`` returns
+    ``(output, aux_loss)`` for load-balanced training.
+    """
+
+    def __init__(
+        self,
+        dim: int,
+        hidden_dim: int,
+        num_experts: int = 8,
+        top_k: int = 2,
+        capacity_factor: float = 1.25,
+        gated: bool = True,
+        router_noise: float = 0.0,
+        activation: str = "gelu",
+    ):
+        super().__init__()
+        self.dim = dim
+        self.hidden_dim = hidden_dim
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.gated = gated
+        self.router_noise = router_noise
+        self.activation = activation
+
+    def init(self, key):
+        E, D, H = self.num_experts, self.dim, self.hidden_dim
+        kr, ku, kg, kd = jax.random.split(key, 4)
+        params = {
+            "router": {"w": _normal(kr, (D, E))},
+            "up": _lecun_normal(ku, (E, D, H), fan_in=D),
+            "down": _lecun_normal(kd, (E, H, D), fan_in=H),
+        }
+        if self.gated:
+            params["gate"] = _lecun_normal(kg, (E, D, H), fan_in=D)
+        return params
+
+    def param_spec(self, model_axis: str = "model"):
+        spec = {
+            "router": {"w": P()},
+            # expert axis sharded: this IS expert parallelism — the
+            # dispatch einsum becomes an all_to_all over `model_axis`
+            "up": P(model_axis, None, None),
+            "down": P(model_axis, None, None),
+        }
+        if self.gated:
+            spec["gate"] = P(model_axis, None, None)
+        return spec
+
+    def capacity(self, tokens_per_group: int) -> int:
+        c = int(self.capacity_factor * self.top_k * tokens_per_group
+                / self.num_experts)
+        return max(c, 1)
+
+    def _route(self, logits, rng=None, train=False):
+        """logits [B, T, E] -> (dispatch [B, T, E, C], combine [B, T, E, C],
+        aux_loss). Top-k with per-expert capacity."""
+        B, T, E = logits.shape
+        C = self.capacity(T)
+        if train and self.router_noise > 0 and rng is not None:
+            logits = logits + self.router_noise * jax.random.normal(
+                rng, logits.shape, logits.dtype
+            )
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+        dispatch = jnp.zeros((B, T, E, C), jnp.float32)
+        combine = jnp.zeros((B, T, E, C), jnp.float32)
+        # running per-expert fill, so expert k=2 choices respect capacity
+        # consumed by k=1 choices
+        fill = jnp.zeros((B, E), jnp.int32)
+        masked = probs
+        importance = jnp.zeros((B, E), jnp.float32)
+        for _ in range(self.top_k):
+            idx = jnp.argmax(masked, axis=-1)  # [B, T]
+            onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [B, T, E]
+            importance = importance + onehot.mean(axis=1)
+            # position of each token within its chosen expert
+            pos = jnp.cumsum(onehot, axis=1) - onehot + fill[:, None, :]
+            pos = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [B, T]
+            keep = pos < C
+            w = jnp.sum(probs * onehot, axis=-1) * keep  # [B, T]
+            poh = jax.nn.one_hot(pos, C, dtype=jnp.float32)  # [B, T, C]
+            sel = onehot[..., None] * poh[:, :, None, :]  # [B, T, E, C]
+            dispatch = dispatch + sel * keep[..., None, None]
+            combine = combine + sel * w[..., None, None]
+            fill = fill + jnp.sum(
+                onehot * keep[..., None], axis=1
+            ).astype(jnp.int32)
+            masked = masked * (1.0 - onehot)  # exclude chosen expert
+
+        # normalize combine weights over the selected experts
+        denom = combine.sum(axis=(2, 3), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+
+        # GShard aux loss: E * mean(fraction_routed) . mean(router_prob)
+        frac = importance / self.top_k  # [B, E] mean one-hot over tokens
+        mean_prob = probs.mean(axis=1)  # [B, E]
+        aux = E * jnp.mean(jnp.sum(frac * mean_prob, axis=-1))
+        return dispatch, combine, aux
+
+    def apply_with_aux(self, params, x, *, rng=None, train=False, **_):
+        B, T, D = x.shape
+        logits = x.astype(jnp.float32) @ params["router"]["w"].astype(jnp.float32)
+        dispatch, combine, aux = self._route(logits, rng=rng, train=train)
+        dispatch = dispatch.astype(x.dtype)
+        combine = combine.astype(x.dtype)
+
+        # dispatch -> [E, B, C, D]; under SPMD with `up`/`down` sharded on
+        # E this einsum inserts the EP all_to_all
+        expert_in = jnp.einsum("btec,btd->ebcd", dispatch, x)
+        up = jnp.einsum("ebcd,edh->ebch", expert_in, params["up"].astype(x.dtype))
+        if self.gated:
+            g = jnp.einsum(
+                "ebcd,edh->ebch", expert_in, params["gate"].astype(x.dtype)
+            )
+            h = jax.nn.silu(g) * up
+        else:
+            from tensorlink_tpu.nn.transformer import ACTIVATIONS
+
+            h = ACTIVATIONS[self.activation](up)
+        expert_out = jnp.einsum("ebch,ehd->ebcd", h, params["down"].astype(x.dtype))
+        out = jnp.einsum("btec,ebcd->btd", combine, expert_out)
+        return out, aux
+
+    def apply(self, params, x, *, rng=None, train=False, **_):
+        out, _ = self.apply_with_aux(params, x, rng=rng, train=train)
+        return out

@@ -82,6 +82,9 @@ class TransformerBlock(Module):
         rope: bool = False,
         rope_theta: float = 10000.0,
         dropout: float = 0.0,
+        moe_experts: int = 0,
+        moe_top_k: int = 2,
+        moe_capacity_factor: float = 1.25,
     ):
         super().__init__()
         self.dim = dim
@@ -100,6 +103,9 @@ class TransformerBlock(Module):
         self.rope = rope
         self.rope_theta = rope_theta
         self.dropout = dropout
+        self.moe_experts = moe_experts
+        self.moe_top_k = moe_top_k
+        self.moe_capacity_factor = moe_capacity_factor
         norm_cls = RMSNorm if norm == "rms" else LayerNorm
         self.child("norm1", norm_cls(dim, eps=norm_eps))
         self.child("norm2", norm_cls(dim, eps=norm_eps))
@@ -115,22 +121,51 @@ class TransformerBlock(Module):
                 rope_theta=rope_theta,
             ),
         )
-        self.child(
-            "mlp",
-            FeedForward(
-                dim,
-                hidden_dim,
-                activation=activation,
-                use_bias=use_bias,
-                gated=gated_mlp,
-                dropout=dropout,
-            ),
-        )
+        if moe_experts:
+            from tensorlink_tpu.nn.moe import MoEFeedForward
+
+            # the MoE FFN supports neither biases nor internal dropout —
+            # fail loudly instead of silently diverging from the dense
+            # FeedForward it replaces (review finding)
+            if use_bias:
+                raise ValueError("moe_experts requires use_bias=False")
+            if dropout:
+                raise ValueError("moe_experts requires dropout=0")
+            self.child(
+                "mlp",
+                MoEFeedForward(
+                    dim,
+                    hidden_dim,
+                    num_experts=moe_experts,
+                    top_k=moe_top_k,
+                    capacity_factor=moe_capacity_factor,
+                    gated=gated_mlp,
+                    activation=activation,
+                ),
+            )
+        else:
+            self.child(
+                "mlp",
+                FeedForward(
+                    dim,
+                    hidden_dim,
+                    activation=activation,
+                    use_bias=use_bias,
+                    gated=gated_mlp,
+                    dropout=dropout,
+                ),
+            )
         self.child("drop", Dropout(dropout))
 
-    def apply(self, params, x, *, mask=None, cache=None, positions=None, rng=None, train=False, **_):
-        attn = self.children["attn"]
+    def _mlp(self, mlp_params, h, rng, train):
+        """-> (out, aux). Dense FFN has no auxiliary loss."""
         mlp = self.children["mlp"]
+        if hasattr(mlp, "apply_with_aux"):
+            return mlp.apply_with_aux(mlp_params, h, rng=rng, train=train)
+        return mlp.apply(mlp_params, h, rng=rng, train=train), 0.0
+
+    def _run(self, params, x, mask, cache, positions, rng, train):
+        attn = self.children["attn"]
         n1, n2 = self.children["norm1"], self.children["norm2"]
         drop = self.children["drop"]
         r1, r2, r3 = (
@@ -145,18 +180,29 @@ class TransformerBlock(Module):
                 a, new_cache = a
             x = x + drop.apply(params["drop"], a, rng=r1, train=train)
             h = n2.apply(params["norm2"], x)
-            m = mlp.apply(params["mlp"], h, rng=r2, train=train)
+            m, aux = self._mlp(params["mlp"], h, r2, train)
             x = x + drop.apply(params["drop"], m, rng=r3, train=train)
         else:  # post-LN (BERT)
             a = attn.apply(params["attn"], x, mask=mask, cache=cache, positions=positions)
             if cache is not None:
                 a, new_cache = a
             x = n1.apply(params["norm1"], x + drop.apply(params["drop"], a, rng=r1, train=train))
-            m = mlp.apply(params["mlp"], x, rng=r2, train=train)
+            m, aux = self._mlp(params["mlp"], x, r2, train)
             x = n2.apply(params["norm2"], x + drop.apply(params["drop"], m, rng=r3, train=train))
+        return x, new_cache, aux
+
+    def apply(self, params, x, *, mask=None, cache=None, positions=None, rng=None, train=False, **_):
+        x, new_cache, _ = self._run(params, x, mask, cache, positions, rng, train)
         if cache is not None:
             return x, new_cache
         return x
+
+    def apply_with_aux(self, params, x, *, mask=None, positions=None, rng=None, train=False, **_):
+        """-> (out, aux_loss): the MoE router's load-balancing loss (0 for
+        dense blocks). Trainers add ``aux_weight * aux`` to the task loss
+        (review finding: plain apply() silently discarded it)."""
+        x, _, aux = self._run(params, x, mask, None, positions, rng, train)
+        return x, aux
 
 
 class TransformerStack(Module):
@@ -187,6 +233,18 @@ class TransformerStack(Module):
         if caches is not None:
             return x, new_caches
         return x
+
+    def apply_with_aux(self, params, x, *, mask=None, positions=None, rng=None, train=False, **_):
+        """-> (out, summed aux losses of all MoE blocks)."""
+        aux = 0.0
+        for i in range(self.num_layers):
+            r = jax.random.fold_in(rng, i) if rng is not None else None
+            x, a = self.children[str(i)].apply_with_aux(
+                params[str(i)], x, mask=mask, positions=positions,
+                rng=r, train=train,
+            )
+            aux = aux + a
+        return x, aux
 
     def blocks(self) -> list[Module]:
         return [self.children[str(i)] for i in range(self.num_layers)]

@@ -130,6 +130,7 @@ class Node:
                     "pubkey": self.identity.public_der,
                     "nonce": nonce_a,
                     "listen_port": self.port or 0,
+                    "caps": ["crc"],
                 }
             )
         )
@@ -148,6 +149,7 @@ class Node:
                 {"type": "HELLO_FIN", "sig": self.identity.sign(ack["nonce"] + nonce_a)}
             )
         )
+        stream.integrity = "crc" in ack.get("caps", [])
         info = PeerInfo(
             node_id=Identity.node_id_for(their_pub),
             role=str(ack["role"]),
@@ -186,6 +188,7 @@ class Node:
                         "nonce": nonce_b,
                         "sig": self.identity.sign(hello["nonce"] + nonce_b),
                         "listen_port": self.port or 0,
+                        "caps": ["crc"],
                     }
                 )
             )
@@ -196,6 +199,7 @@ class Node:
                 their_pub, fin["sig"], nonce_b + hello["nonce"]
             ):
                 raise ConnectionError("initiator failed signature challenge")
+            stream.integrity = "crc" in hello.get("caps", [])
             host = stream.peername[0] if stream.peername else "?"
             info = PeerInfo(
                 node_id=their_id,

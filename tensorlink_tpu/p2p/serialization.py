@@ -15,7 +15,6 @@ ever executable:
 
 from __future__ import annotations
 
-import io
 from typing import Any, Mapping, Sequence
 
 import msgpack
@@ -75,29 +74,36 @@ def pack_arrays(
 ) -> bytes:
     """{name: array} -> MAGIC + msgpack(manifest) + blob.
 
-    Flat names; pytrees are flattened by the caller (see tree_flatten_arrays).
+    Flat names; pytrees are flattened by the caller (see
+    tree_flatten_arrays). The tensor bytes are concatenated and
+    checksummed in one native pass (tensorlink_tpu/native) and the
+    CRC-32C rides the manifest — verified after decompression on the
+    receiving host, end-to-end through the compression codec.
     """
+    from tensorlink_tpu.native import gather
+
     if codec == "zstd" and _ZC is None:
         codec = "zlib"
     manifest: dict[str, Any] = {"codec": codec, "tensors": {}}
-    blob = io.BytesIO()
+    views: list[np.ndarray] = []
     offset = 0
     for name, arr in arrays.items():
         arr = np.ascontiguousarray(arr)
         if arr.dtype.byteorder == ">":  # dtype travels by NAME: wire is
             arr = arr.astype(arr.dtype.newbyteorder("="))  # native-endian
-        raw = arr.tobytes()
         manifest["tensors"][name] = {
             # dtype by NAME: ml_dtypes types (bfloat16, float8_*) have
             # dtype.str '<V2' which does not survive a round-trip
             "dtype": arr.dtype.name,
             "shape": list(arr.shape),
             "offset": offset,
-            "nbytes": len(raw),
+            "nbytes": arr.nbytes,
         }
-        blob.write(raw)
-        offset += len(raw)
-    body = _compress(blob.getvalue(), codec)
+        views.append(arr)
+        offset += arr.nbytes
+    raw, crc = gather(views, with_crc=True)
+    manifest["crc32c"] = crc
+    body = _compress(bytes(raw), codec)
     head = msgpack.packb(manifest, use_bin_type=True)
     return MAGIC + len(head).to_bytes(4, "big") + head + body
 
@@ -128,6 +134,12 @@ def unpack_arrays(data: bytes) -> dict[str, np.ndarray]:
     hlen = int.from_bytes(data[4:8], "big")
     manifest = msgpack.unpackb(data[8 : 8 + hlen], raw=False)
     body = _decompress(bytes(data[8 + hlen :]), manifest["codec"])
+    want = manifest.get("crc32c")
+    if want is not None:
+        from tensorlink_tpu.native import crc32c
+
+        if crc32c(body) != want:
+            raise ValueError("tensor blob CRC-32C mismatch (corrupt payload)")
     out = {}
     for name, meta in manifest["tensors"].items():
         raw = body[meta["offset"] : meta["offset"] + meta["nbytes"]]

@@ -91,6 +91,9 @@ class DistributedJob:
         # (seeded with the initial shipment; refreshed by checkpoint_stages)
         self._stage_params: dict[int, Any] = {}
         self.max_step_retries = 2
+        # bound what a snapshot rollback can cost (review finding): the
+        # recovery cache auto-refreshes every N successful steps
+        self.checkpoint_every_steps = 25
         # fencing epoch: bumped on every abort; stages reject data-plane
         # messages from older epochs, so a straggler from an aborted
         # attempt can never double-count into a retried step
@@ -209,13 +212,26 @@ class DistributedJob:
 
         try:
             await asyncio.gather(*(end(st) for st in self.stages))
-        except (ConnectionError, asyncio.TimeoutError, RuntimeError) as e:
-            # some stages may have applied the step, others not: the
-            # retry must not train a mixed-version pipeline (review
-            # finding) — tagged so train_step rolls EVERY stage back to
-            # the same snapshot
-            raise StepEndFailure(str(e)) from e
+        except (ConnectionError, asyncio.TimeoutError, RuntimeError):
+            # STEP_END is idempotent per (step, fence), so a transient
+            # timeout/blip is resolved by simply re-sending — stages that
+            # already applied skip, the rest apply their intact accum
+            # (review finding: escalating straight to a snapshot rollback
+            # here silently discarded all progress since the last
+            # checkpoint). Only a SECOND failure escalates.
+            await asyncio.sleep(0.5)
+            try:
+                await asyncio.gather(*(end(st) for st in self.stages))
+            except (ConnectionError, asyncio.TimeoutError, RuntimeError) as e:
+                raise StepEndFailure(str(e)) from e
         self.step += 1
+        if (
+            self.checkpoint_every_steps
+            and self.step % self.checkpoint_every_steps == 0
+        ):
+            # keep the recovery snapshot fresh so a rollback costs at most
+            # checkpoint_every_steps of progress
+            await self.checkpoint_stages()
         return float(np.mean(losses))
 
     # ------------------------------------------------------- fault recovery

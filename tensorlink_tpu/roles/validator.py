@@ -309,18 +309,29 @@ class ValidatorNode(Node):
                     for a in self.job_state.get(job_id, {}).get("audits", [])
                     if a.get("stage") == stage_index and a.get("worker") == wid
                 ]
-                streak = 0
+                streak = []
                 for a in reversed(prior):
                     if a.get("passed") is None:
-                        streak += 1
+                        streak.append(a)
                     else:
                         break
-                if streak >= 2:  # this makes 3 consecutive inconclusives
+                # an honest legacy worker that is actively TRAINING is
+                # inconclusive on every audit (the separate params fetch
+                # races the optimizer) — its reported step advances, so
+                # don't escalate. A worker whose step is stagnant across
+                # 3 inconclusive digest mismatches is not training and
+                # the mismatch cannot be a race: evasion (review finding)
+                cur_step = proof.get("step")
+                advancing = any(a.get("step") != cur_step for a in streak)
+                if len(streak) >= 2 and not advancing:
                     record.update(
                         passed=False, reason="persistent inconclusive audits"
                     )
                 else:
-                    record.update(passed=None, reason="params changed mid-audit")
+                    record.update(
+                        passed=None, reason="params changed mid-audit",
+                        step=cur_step,
+                    )
             else:
                 # weights and proof arrive in one atomic reply: any
                 # mismatch is the worker's fault, never an audit race

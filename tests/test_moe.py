@@ -1,0 +1,128 @@
+"""MoE feed-forward + expert parallelism (survey §2.3: EP absent in the
+reference — TPU-native from scratch here)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.nn.moe import MoEFeedForward
+
+KEY = jax.random.key(0)
+
+
+def test_single_expert_equals_dense_ffn():
+    """E=1, k=1, ample capacity: MoE must reduce to the plain gated FFN."""
+    m = MoEFeedForward(dim=16, hidden_dim=32, num_experts=1, top_k=1,
+                       capacity_factor=4.0, gated=True)
+    p = m.init(KEY)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 16))
+    out = m.apply(p, x)
+    up, gate, down = p["up"][0], p["gate"][0], p["down"][0]
+    ref = (jax.nn.silu(x @ gate) * (x @ up)) @ down
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_capacity_overflow_drops_tokens():
+    """capacity=1 per expert: overflow tokens must come out as zeros
+    (residual path carries them)."""
+    m = MoEFeedForward(dim=8, hidden_dim=16, num_experts=1, top_k=1,
+                       capacity_factor=1e-9)  # capacity -> 1
+    p = m.init(KEY)
+    assert m.capacity(16) == 1
+    x = jax.random.normal(jax.random.key(2), (1, 16, 8))
+    out = m.apply(p, x)
+    # only the first token fits expert 0's capacity
+    assert not np.allclose(np.asarray(out[0, 0]), 0.0)
+    np.testing.assert_allclose(np.asarray(out[0, 1:]), 0.0, atol=1e-7)
+
+
+def test_aux_loss_and_grads():
+    m = MoEFeedForward(dim=16, hidden_dim=32, num_experts=4, top_k=2)
+    p = m.init(KEY)
+    x = jax.random.normal(jax.random.key(3), (2, 32, 16))
+
+    def loss(pp):
+        out, aux = m.apply_with_aux(pp, x)
+        return jnp.mean(out**2) + 0.01 * aux
+
+    val, grads = jax.value_and_grad(loss)(p)
+    assert np.isfinite(float(val))
+    rnorm = float(jnp.sum(grads["router"]["w"] ** 2))
+    assert rnorm > 0, "router got no gradient"
+    # aux loss is ~1 for near-uniform routing, and always >= 1 - eps bound
+    _, aux = m.apply_with_aux(p, x)
+    assert 0.5 < float(aux) < 4.0
+
+
+def test_top2_combines_two_experts():
+    m = MoEFeedForward(dim=8, hidden_dim=16, num_experts=4, top_k=2,
+                       capacity_factor=4.0)
+    p = m.init(KEY)
+    x = jax.random.normal(jax.random.key(4), (1, 8, 8))
+    logits = x.astype(jnp.float32) @ p["router"]["w"]
+    dispatch, combine, _ = m._route(logits)
+    # every token lands in exactly 2 expert slots with weights summing to 1
+    per_tok = np.asarray(dispatch.sum(axis=(2, 3)))
+    np.testing.assert_allclose(per_tok, 2.0)
+    np.testing.assert_allclose(np.asarray(combine.sum(axis=(2, 3))), 1.0, atol=1e-6)
+
+
+def test_expert_parallel_sharding_matches_single(devices):
+    """Experts sharded over the model axis (EP): same numbers as
+    unsharded, with the stacked expert weights actually distributed."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from tensorlink_tpu.config import MeshConfig
+    from tensorlink_tpu.nn.module import spec_tree_to_shardings
+    from tensorlink_tpu.runtime.mesh import make_mesh
+
+    m = MoEFeedForward(dim=16, hidden_dim=32, num_experts=8, top_k=2)
+    p = m.init(KEY)
+    x = jax.random.normal(jax.random.key(5), (4, 16, 16))
+    ref = np.asarray(m.apply(p, x))
+
+    mesh = make_mesh(MeshConfig(data=2, model=4))
+    shardings = spec_tree_to_shardings(m.param_spec(), mesh)
+    ps = jax.tree.map(jax.device_put, p, shardings)
+    assert ps["up"].sharding.spec == P("model", None, None)
+    xs = jax.device_put(x, NamedSharding(mesh, P("data", None, None)))
+    out = jax.jit(m.apply)(ps, xs)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
+
+
+def test_moe_transformer_block():
+    from tensorlink_tpu.nn.transformer import TransformerBlock
+    from tensorlink_tpu.nn.module import module_from_config
+
+    blk = TransformerBlock(
+        dim=16, num_heads=2, hidden_dim=32, moe_experts=4, gated_mlp=True,
+        causal=True, use_bias=False,
+    )
+    p = blk.init(KEY)
+    x = jax.random.normal(jax.random.key(6), (2, 8, 16))
+    out = blk.apply(p, x)
+    assert out.shape == x.shape
+    # aux loss surfaces through block and stack (review finding)
+    out_aux, aux = blk.apply_with_aux(p, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_aux), atol=1e-6)
+    assert float(aux) > 0
+    from tensorlink_tpu.nn.transformer import TransformerStack
+
+    stack = TransformerStack(
+        2, TransformerBlock, dim=16, num_heads=2, hidden_dim=32,
+        moe_experts=4, gated_mlp=True, causal=True, use_bias=False,
+    )
+    sp = stack.init(KEY)
+    _, aux2 = stack.apply_with_aux(sp, x)
+    assert float(aux2) > 0
+    # spec-shipping round trip preserves the MoE mlp
+    rebuilt = module_from_config(blk.config())
+    out2 = rebuilt.apply(p, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2), atol=1e-6)
+    # unsupported combos fail loudly
+    with pytest.raises(ValueError, match="use_bias"):
+        TransformerBlock(dim=16, num_heads=2, moe_experts=4)
+    with pytest.raises(ValueError, match="dropout"):
+        TransformerBlock(
+            dim=16, num_heads=2, moe_experts=4, use_bias=False, dropout=0.1
+        )

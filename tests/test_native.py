@@ -1,0 +1,100 @@
+"""Native wire codec: CRC-32C vectors, gather parity, frame integrity.
+
+The C++ library (tensorlink_tpu/native/wirecodec.cpp) and the pure-Python
+fallback must be bit-identical — cross-host integrity checks compare
+checksums computed by either implementation.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from tensorlink_tpu import native
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 / standard test vectors
+    assert native.crc32c(b"") == 0
+    assert native.crc32c(b"123456789") == 0xE3069283
+    assert native.crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert native.crc32c(b"\xff" * 32) == 0x62A8AB43
+
+
+def test_crc32c_python_fallback_matches_native():
+    r = np.random.default_rng(0)
+    for n in (1, 7, 8, 63, 1024, 100_001):
+        data = r.integers(0, 256, n, np.uint8).tobytes()
+        assert native._py_crc32c(data) == native.crc32c(data)
+
+
+def test_crc32c_chaining():
+    data = b"the quick brown fox jumps over the lazy dog"
+    whole = native.crc32c(data)
+    part = native.crc32c(data[10:], native.crc32c(data[:10]))
+    assert whole == part
+
+
+def test_gather_matches_concat():
+    r = np.random.default_rng(1)
+    arrs = [
+        r.normal(size=s).astype(d)
+        for s, d in [((3, 5), np.float32), ((7,), np.float64), ((2, 2, 2), np.float32)]
+    ]
+    blob, crc = native.gather(arrs)
+    ref = b"".join(np.ascontiguousarray(a).tobytes() for a in arrs)
+    assert bytes(blob) == ref
+    assert crc == native.crc32c(ref)
+
+
+def test_pack_arrays_carries_crc_and_detects_corruption():
+    from tensorlink_tpu.p2p.serialization import pack_arrays, unpack_arrays
+
+    arrs = {"a": np.arange(100, dtype=np.float32), "b": np.ones((4, 4), np.int32)}
+    blob = pack_arrays(arrs, codec="none")
+    out = unpack_arrays(blob)
+    np.testing.assert_array_equal(out["a"], arrs["a"])
+
+    # flip one byte in the tensor body -> must raise, not return garbage
+    bad = bytearray(blob)
+    bad[-3] ^= 0xFF
+    with pytest.raises(ValueError, match="CRC-32C"):
+        unpack_arrays(bytes(bad))
+
+
+@pytest.mark.asyncio
+async def test_framed_stream_integrity_roundtrip_and_corruption():
+    from tensorlink_tpu.p2p.connection import FramedStream, FrameCorruptionError
+
+    server_streams = []
+
+    async def on_conn(reader, writer):
+        server_streams.append(FramedStream(reader, writer))
+
+    server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    client = FramedStream(reader, writer)
+    await asyncio.sleep(0.05)
+    srv = server_streams[0]
+
+    payload = np.random.default_rng(2).bytes(100_000)
+    await client.send(payload)
+    got = await srv.recv()
+    assert got == payload
+
+    # corrupt a frame on the wire: write a frame with a bad crc by hand
+    from tensorlink_tpu.p2p.connection import FLAG_CRC, FLAG_NONE
+
+    raw = b"hello world"
+    bad_crc = (native.crc32c(raw) ^ 1).to_bytes(4, "big")
+    header = len(raw).to_bytes(4, "big") + bytes([FLAG_NONE | FLAG_CRC]) + bad_crc
+    client.writer.write(header + raw)
+    await client.writer.drain()
+    with pytest.raises(FrameCorruptionError):
+        await srv.recv()
+
+    client.close()
+    srv.close()
+    server.close()
+    await server.wait_closed()
