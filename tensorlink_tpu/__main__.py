@@ -1,0 +1,185 @@
+"""CLI: `python -m tensorlink_tpu <command>`.
+
+The reference ships per-role launch scripts with hardcoded keys and ports
+(tests/run/test_worker.py etc.) and no CLI (survey §5.6). Here one typed
+entry point launches any role, shows device info, or runs the demo:
+
+    python -m tensorlink_tpu worker --port 38751 --http-port 8080
+    python -m tensorlink_tpu validator --port 38752
+    python -m tensorlink_tpu demo            # in-process e2e training job
+    python -m tensorlink_tpu info            # devices + mesh capacity
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+def _node_cfg(args, role: str):
+    from tensorlink_tpu.config import NodeConfig
+
+    return NodeConfig(
+        role=role,
+        host=args.host,
+        port=args.port,
+        key_dir=args.key_dir,
+        http_status_port=args.http_port,
+    )
+
+
+def _add_node_args(p: argparse.ArgumentParser) -> None:
+    # loopback by default: the status endpoint is unauthenticated, so
+    # exposing it network-wide must be an explicit operator choice
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (0.0.0.0 to serve the network)")
+    p.add_argument("--port", type=int, default=0, help="0 = OS-assigned")
+    p.add_argument("--http-port", type=int, default=None,
+                   help="HTTP status endpoint port (off when omitted)")
+    p.add_argument("--key-dir", default=None,
+                   help="persistent identity dir (ephemeral when omitted)")
+    p.add_argument("--bootstrap", default=None, metavar="HOST:PORT",
+                   help="validator to join via")
+
+
+async def _run_role(role: str, args) -> None:
+    from tensorlink_tpu.roles.registry import InMemoryRegistry
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    cls = {"worker": WorkerNode, "validator": ValidatorNode, "user": UserNode}[role]
+    kw = {}
+    if role == "validator":
+        kw["registry"] = InMemoryRegistry()
+    node = cls(_node_cfg(args, role), **kw)
+    await node.start()
+    if args.bootstrap:
+        host, port = args.bootstrap.rsplit(":", 1)
+        await node.connect(host, int(port))
+    node.start_heartbeat()
+    print(f"{role} {node.node_id[:16]} listening on {args.host}:{node.port}"
+          + (f", status :{node._http.bound_port}" if node._http else ""))
+    try:
+        await asyncio.Event().wait()  # run until interrupted
+    finally:
+        await node.stop()
+
+
+def _cmd_info() -> int:
+    import jax
+
+    from tensorlink_tpu.runtime.mesh import local_device_info
+
+    print(json.dumps(
+        {
+            "backend": jax.default_backend(),
+            "device_count": jax.device_count(),
+            "devices": local_device_info(),
+        },
+        indent=2, default=str,
+    ))
+    return 0
+
+
+async def _cmd_demo() -> int:
+    """Minimum end-to-end slice (SURVEY §7.4) in one process."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorlink_tpu.config import NodeConfig
+    from tensorlink_tpu.models.mlp import MLP, MLPConfig
+    from tensorlink_tpu.roles.registry import InMemoryRegistry
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    def cfg(role):
+        return NodeConfig(role=role, host="127.0.0.1", port=0)
+
+    reg = InMemoryRegistry()
+    validator = ValidatorNode(cfg("validator"), registry=reg)
+    await validator.start()
+    workers = []
+    for _ in range(2):
+        w = WorkerNode(cfg("worker"))
+        await w.start()
+        await w.connect("127.0.0.1", validator.port)
+        workers.append(w)
+    user = UserNode(cfg("user"))
+    await user.start()
+    v_peer = await user.connect("127.0.0.1", validator.port)
+
+    m = MLP(MLPConfig(in_dim=16, hidden_dim=32, out_dim=4, num_layers=2))
+    p = m.init(jax.random.key(0))
+    job = await user.request_job(
+        m.seq, p["seq"], v_peer, max_stage_bytes=16 * 32 * 4 + 200,
+        micro_batches=2, train={"optimizer": "sgd", "learning_rate": 0.05},
+    )
+    print(f"job {job.job.job_id[:16]} placed on "
+          f"{[st.peer.node_id[:8] for st in job.stages]}")
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 16)).astype(np.float32)
+    w_true = rng.normal(size=(16, 4))
+    y = np.argmax(x @ w_true, -1)
+
+    def loss_grad(logits, micro):
+        lj = jnp.asarray(logits)
+        yj = jnp.asarray(np.array_split(y, 2)[micro])
+
+        def f(l):
+            logz = jax.nn.logsumexp(l, axis=-1)
+            ll = jnp.take_along_axis(l, yj[:, None], axis=-1)[..., 0]
+            return jnp.mean(logz - ll)
+
+        val, g = jax.value_and_grad(f)(lj)
+        return float(val), np.asarray(g)
+
+    for i in range(10):
+        loss = await job.train_step(x, loss_grad)
+        print(f"step {i}: loss {loss:.4f}")
+    for n in (user, validator, *workers):
+        await n.stop()
+    print("demo OK")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="tensorlink_tpu")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for role in ("worker", "validator", "user"):
+        sp = sub.add_parser(role, help=f"run a {role} node")
+        _add_node_args(sp)
+    sub.add_parser("info", help="local devices and capacity")
+    sub.add_parser("demo", help="in-process end-to-end training demo")
+    sub.add_parser("bench", help="run the repo benchmark (prints one JSON line)")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "info":
+        return _cmd_info()
+    if args.cmd == "demo":
+        return asyncio.run(_cmd_demo())
+    if args.cmd == "bench":
+        import runpy
+        import os
+
+        sys.argv = ["bench.py"]
+        runpy.run_path(
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "bench.py"),
+            run_name="__main__",
+        )
+        return 0
+    try:
+        asyncio.run(_run_role(args.cmd, args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -71,6 +71,10 @@ class Node:
         self.port: int | None = None
         self.started = asyncio.Event()
         self._stopping = False
+        self._http = None
+        from tensorlink_tpu.runtime.metrics import Metrics
+
+        self.metrics = Metrics()  # published via GET /metrics
         self.register_handlers()
 
     # ------------------------------------------------------------ lifecycle
@@ -79,11 +83,22 @@ class Node:
             self._accept, self.cfg.host, self.cfg.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        if self.cfg.http_status_port is not None:
+            from tensorlink_tpu.runtime.http_status import StatusServer
+
+            self._http = StatusServer(
+                self, self.cfg.host, self.cfg.http_status_port
+            )
+            await self._http.start()
+            self.log.info("status endpoint on :%s", self._http.bound_port)
         self.started.set()
         self.log.info("listening on %s:%s", self.cfg.host, self.port)
 
     async def stop(self) -> None:
         self._stopping = True
+        if getattr(self, "_http", None) is not None:
+            await self._http.stop()
+            self._http = None
         for t in list(self._tasks):
             t.cancel()
         # Close peer transports BEFORE wait_closed: on 3.12+ wait_closed
@@ -265,6 +280,8 @@ class Node:
                     self._penalize(peer)
                     continue
                 peer.msgs_in += 1
+                self.metrics.incr("msgs_in")
+                self.metrics.incr(f"msg:{msg.get('type', '?')}")
                 self._spawn(self._dispatch(peer, msg))
         finally:
             self._drop_peer(peer)
@@ -322,6 +339,7 @@ class Node:
     # ------------------------------------------------------------ messaging
     async def send(self, peer: Peer, msg: dict) -> None:
         peer.msgs_out += 1
+        self.metrics.incr("msgs_out")
         await peer.stream.send(encode_message(msg))
 
     async def request(

@@ -169,6 +169,9 @@ class DistributedJob:
         raise AssertionError("unreachable")
 
     async def _try_train_step(self, batch_x, loss_grad_fn) -> float:
+        import time as _time
+
+        t_start = _time.perf_counter()
         m = self.job.micro_batches
         micros = np.array_split(np.asarray(batch_x), m)
         step = self.step
@@ -225,6 +228,10 @@ class DistributedJob:
             except (ConnectionError, asyncio.TimeoutError, RuntimeError) as e:
                 raise StepEndFailure(str(e)) from e
         self.step += 1
+        loss = float(np.mean(losses))
+        self.user.metrics.observe("loss", loss)
+        self.user.metrics.observe("step_s", _time.perf_counter() - t_start)
+        self.user.metrics.incr("train_steps")
         if (
             self.checkpoint_every_steps
             and self.step % self.checkpoint_every_steps == 0
@@ -232,7 +239,7 @@ class DistributedJob:
             # keep the recovery snapshot fresh so a rollback costs at most
             # checkpoint_every_steps of progress
             await self.checkpoint_stages()
-        return float(np.mean(losses))
+        return loss
 
     # ------------------------------------------------------- fault recovery
     async def _abort_step(self, timeout: float = 5.0) -> set[int]:

@@ -1,0 +1,111 @@
+"""HTTP status endpoint for nodes.
+
+The reference exposes a Flask+CORS sidecar with one real route
+(`GET /node` -> get_self_info(), src/p2p/node_api.py:5-12, launched only
+by the User role on a hardcoded port, src/roles/user.py:44-48). Here every
+node can serve status: a dependency-free asyncio HTTP/1.1 responder with
+
+    GET /node     -> node.status()               (reference parity)
+    GET /metrics  -> node.metrics snapshot       (loss, throughput, ...)
+    GET /jobs     -> validator job table         (when the node has one)
+    GET /healthz  -> {"ok": true}
+
+JSON only, read only, bound to the node's host.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any, Callable
+
+
+class StatusServer:
+    def __init__(self, node: Any, host: str, port: int):
+        self.node = node
+        self.host = host
+        self.port = port
+        self._server: asyncio.AbstractServer | None = None
+
+    @property
+    def bound_port(self) -> int | None:
+        if self._server is None or not self._server.sockets:
+            return None
+        return self._server.sockets[0].getsockname()[1]
+
+    def _routes(self) -> dict[str, Callable[[], Any]]:
+        node = self.node
+        routes: dict[str, Callable[[], Any]] = {
+            "/healthz": lambda: {"ok": True},
+            "/node": node.status,
+        }
+        metrics = getattr(node, "metrics", None)
+        if metrics is not None:
+            routes["/metrics"] = metrics.snapshot
+        if hasattr(node, "jobs"):
+            routes["/jobs"] = lambda: {
+                jid: {
+                    "author": j.author,
+                    "stages": j.n_stages,
+                    "workers": [
+                        (w or {}).get("node_id") for w in (j.workers or [])
+                    ],
+                    "state": node.job_state.get(jid, {}),
+                }
+                for jid, j in node.jobs.items()
+            }
+        return routes
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            # one overall deadline for the whole request (a per-line
+            # timeout would let a client trickle header lines and pin a
+            # task forever — review finding)
+            async with asyncio.timeout(5.0):
+                request = await reader.readline()
+                parts = request.decode("latin1").split()
+                path = parts[1] if len(parts) >= 2 else "/"
+                # drain headers
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+            handler = self._routes().get(path.split("?")[0])
+            if parts and parts[0] != "GET":
+                status, body = "405 Method Not Allowed", {"error": "GET only"}
+            elif handler is None:
+                status, body = "404 Not Found", {"error": f"no route {path}"}
+            else:
+                try:
+                    status, body = "200 OK", handler()
+                except Exception as e:  # noqa: BLE001 — must answer 500
+                    status, body = "500 Internal Server Error", {
+                        "error": type(e).__name__
+                    }
+            payload = json.dumps(body, default=str).encode()
+            writer.write(
+                f"HTTP/1.1 {status}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"Content-Length: {len(payload)}\r\n"
+                f"Access-Control-Allow-Origin: *\r\n"
+                f"Connection: close\r\n\r\n".encode() + payload
+            )
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None

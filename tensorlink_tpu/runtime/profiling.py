@@ -1,0 +1,54 @@
+"""Profiling: jax.profiler traces + per-step timing.
+
+The reference's only timing instrumentation is a PING/PONG latency probe
+(src/p2p/smart_node.py:889-892); there is no tracer of any kind (survey
+§5.1). Here: `trace()` wraps `jax.profiler.trace` so any training or
+inference region can be captured and opened in XProf/TensorBoard, and
+`profiled_steps` annotates per-step named traces.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/tensorlink_tpu_trace") -> Iterator[str]:
+    """Capture an XLA/device trace of the enclosed region.
+
+    View with: `tensorboard --logdir <dir>` (profile plugin) or xprof.
+    """
+    with jax.profiler.trace(log_dir):
+        yield log_dir
+
+
+@contextlib.contextmanager
+def step_trace(name: str) -> Iterator[None]:
+    """Named sub-span inside an active trace (shows up on the timeline)."""
+    with jax.profiler.StepTraceAnnotation(name):
+        yield
+
+
+class Stopwatch:
+    """Synchronized device timing: forces a host read of `arr` before
+    stopping the clock. On the tunneled runtime `block_until_ready` does
+    NOT drain the dispatch queue (BASELINE.md caveat) — a scalar host
+    read does."""
+
+    def __init__(self):
+        self.t0 = None
+        self.elapsed_s = 0.0
+
+    def start(self) -> "Stopwatch":
+        self.t0 = time.perf_counter()
+        return self
+
+    def stop(self, sync_array=None) -> float:
+        if sync_array is not None:
+            float(jax.tree.leaves(sync_array)[0].reshape(-1)[0])
+        self.elapsed_s = time.perf_counter() - self.t0
+        return self.elapsed_s

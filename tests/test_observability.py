@@ -1,0 +1,95 @@
+"""HTTP status endpoint, metrics, profiling, CLI (survey §§5.1, 5.5, 5.6:
+the reference had a single Flask route, no tracer, no CLI)."""
+
+import asyncio
+import json
+import subprocess
+import sys
+
+import pytest
+
+from tensorlink_tpu.config import NodeConfig
+
+
+async def _http_get(host: str, port: int, path: str) -> tuple[int, dict]:
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read(1 << 20)
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status = int(head.split()[1])
+    return status, json.loads(body) if body else {}
+
+
+@pytest.mark.asyncio
+async def test_status_endpoint_routes():
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    node = WorkerNode(
+        NodeConfig(role="worker", host="127.0.0.1", port=0, http_status_port=0)
+    )
+    await node.start()
+    try:
+        port = node._http.bound_port
+        st, body = await _http_get("127.0.0.1", port, "/healthz")
+        assert st == 200 and body == {"ok": True}
+        st, body = await _http_get("127.0.0.1", port, "/node")
+        assert st == 200
+        assert body["node_id"] == node.node_id and body["role"] == "worker"
+        node.metrics.observe("loss", 1.5)
+        node.metrics.incr("steps")
+        st, body = await _http_get("127.0.0.1", port, "/metrics")
+        assert st == 200
+        assert body["loss"]["last"] == 1.5 and body["counters"]["steps"] == 1
+        st, _ = await _http_get("127.0.0.1", port, "/nope")
+        assert st == 404
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_validator_jobs_route():
+    from tensorlink_tpu.roles.registry import InMemoryRegistry
+    from tensorlink_tpu.roles.validator import ValidatorNode
+
+    node = ValidatorNode(
+        NodeConfig(role="validator", host="127.0.0.1", port=0, http_status_port=0),
+        registry=InMemoryRegistry(),
+    )
+    await node.start()
+    try:
+        st, body = await _http_get("127.0.0.1", node._http.bound_port, "/jobs")
+        assert st == 200 and body == {}
+    finally:
+        await node.stop()
+
+
+def test_cli_info_runs():
+    out = subprocess.run(
+        [sys.executable, "-m", "tensorlink_tpu", "info"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    info = json.loads(out.stdout)
+    assert info["device_count"] >= 1
+
+
+def test_profiling_helpers():
+    import jax.numpy as jnp
+
+    from tensorlink_tpu.runtime.profiling import Stopwatch, step_trace, trace
+
+    sw = Stopwatch().start()
+    x = jnp.ones((8, 8)) * 2
+    dt = sw.stop(sync_array=x)
+    assert dt > 0
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        with trace(d):
+            with step_trace("step0"):
+                (jnp.ones((16, 16)) @ jnp.ones((16, 16))).block_until_ready()
+        import os
+
+        assert any(os.scandir(d)), "profiler trace wrote nothing"
