@@ -281,7 +281,13 @@ class Node:
                     continue
                 peer.msgs_in += 1
                 self.metrics.incr("msgs_in")
-                self.metrics.incr(f"msg:{msg.get('type', '?')}")
+                # only known types get their own counter: a peer spraying
+                # random type strings must not grow the registry (and the
+                # /metrics payload) without bound
+                mtype = msg.get("type")
+                self.metrics.incr(
+                    f"msg:{mtype}" if mtype in self._handlers else "msg:unknown"
+                )
                 self._spawn(self._dispatch(peer, msg))
         finally:
             self._drop_peer(peer)

@@ -1,0 +1,177 @@
+"""Privacy-preserving (obfuscated) offloading.
+
+The reference's whitepaper promises that workers learn "only submodule
+shards + activations", never the user's data or full model (Whitepaper:31,
+survey §7.1.6) — but ships raw weights and raw activations, so a worker
+holding the first stage sees the user's inputs bit-for-bit. Here the
+promise is made real with secret random orthogonal rotations:
+
+- Per stage boundary the user samples an orthogonal matrix (QR of a
+  Gaussian; the seed never leaves the user).
+- The INPUT rotation R is folded into the stage's first Dense weight
+  (``W -> R^T W``) before shipping, and the user sends ``x R`` instead of
+  ``x``: the worker computes exactly the same function but sees only a
+  rotated view of both the activations and the weight matrix.
+- The OUTPUT rotation S is folded into the stage's last Dense
+  (``W -> W S``, ``b -> b S``); the user un-rotates ``y' S^T`` on
+  receipt. Gradients flow in the rotated basis symmetrically
+  (``dL/dx' = dL/dx R``), so the backward path leaks no more than the
+  forward.
+
+Zero steady-state overhead on the worker (the fold is a one-time weight
+transform) and one [B, D] x [D, D] matmul per hop on the master.
+
+Limits (stated, not hidden): folding needs the stage's first/last
+parameterized op to be a Dense; a LayerNorm/RMSNorm-fronted transformer
+stage is NOT foldable because normalization does not commute with
+rotation — ``ObfuscationPlan.build`` raises for such stages. Rotation
+hides the activation/weight basis; it is not cryptographic secrecy
+(norms and spectra are preserved).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorlink_tpu.nn.module import Module, Sequential
+from tensorlink_tpu.nn.layers import Dense
+
+
+def random_orthogonal(key: jax.Array, dim: int) -> np.ndarray:
+    """Haar-ish random orthogonal via QR of a Gaussian (float64 for a
+    crisp inverse; stored float32)."""
+    g = np.asarray(
+        jax.random.normal(key, (dim, dim), jnp.float32), np.float64
+    )
+    q, r = np.linalg.qr(g)
+    q = q * np.sign(np.diag(r))  # fix QR sign ambiguity
+    return q.astype(np.float32)
+
+
+def _dense_positions(seq: Sequential) -> tuple[int, int]:
+    """Indices of the first and last Dense layers in a stage."""
+    idx = [i for i, l in enumerate(seq.layers) if isinstance(l, Dense)]
+    if not idx:
+        raise ValueError("stage has no Dense layer to fold a rotation into")
+    return idx[0], idx[-1]
+
+
+@dataclass
+class StageObfuscation:
+    r_in: np.ndarray | None  # [D_in, D_in] input rotation (None = identity)
+    s_out: np.ndarray | None  # [D_out, D_out] output rotation
+
+
+@dataclass
+class ObfuscationPlan:
+    """Master-side secret: per-stage boundary rotations. Never serialized
+    onto the wire; recovery re-folds from the cached folded params."""
+
+    stages: list[StageObfuscation] = field(default_factory=list)
+
+    @classmethod
+    def build(
+        cls,
+        key: jax.Array,
+        stage_parts: list[tuple[Sequential, dict]],
+        *,
+        obfuscate_final_output: bool = False,
+    ) -> "ObfuscationPlan":
+        """One rotation per boundary. The model's true input boundary is
+        the user's own data (already local), so stage 0 gets an input
+        rotation too — the first worker is exactly the one that would
+        otherwise see raw user data. The final output rotation defaults
+        to off (the master consumes it immediately)."""
+        plan = cls()
+        n = len(stage_parts)
+        for i, (seq, params) in enumerate(stage_parts):
+            fi, li = _dense_positions(seq)
+            d_in = seq.layers[fi].in_dim
+            d_out = seq.layers[li].out_dim
+            key, k1, k2 = jax.random.split(key, 3)
+            r_in = random_orthogonal(k1, d_in)
+            # an output rotation folds into the LAST layer only if that
+            # layer is the stage's final op — a trailing nonlinearity
+            # (e.g. [Dense, relu]) does not commute with rotation, so the
+            # boundary stays in the clear basis there (the next stage's
+            # input rotation still hides it from the next worker)
+            s_out = (
+                random_orthogonal(k2, d_out)
+                if (i < n - 1 or obfuscate_final_output)
+                and li == len(seq.layers) - 1
+                else None
+            )
+            if fi != 0:
+                # rotation only reaches the first Dense if everything
+                # before it is elementwise; a leading non-Dense
+                # parameterized/normalizing op breaks equivalence
+                raise ValueError(
+                    f"stage {i}: first layer is not Dense (index {fi}); "
+                    "cannot fold the input rotation soundly"
+                )
+            plan.stages.append(StageObfuscation(r_in=r_in, s_out=s_out))
+        return plan
+
+    # ------------------------------------------------------------ folding
+    def fold_stage(self, index: int, seq: Sequential, params: dict) -> dict:
+        """Return params with the stage's boundary rotations folded in —
+        this is what ships to the worker."""
+        ob = self.stages[index]
+        fi, li = _dense_positions(seq)
+        out = jax.tree.map(lambda x: x, params)  # shallow-ish copy
+        if ob.r_in is not None:
+            w = np.asarray(out[str(fi)]["w"])
+            out[str(fi)] = dict(out[str(fi)], w=jnp.asarray(ob.r_in.T @ w))
+        if ob.s_out is not None:
+            last = dict(out[str(li)])
+            w = np.asarray(last["w"])
+            last["w"] = jnp.asarray(w @ ob.s_out)
+            if "b" in last:
+                last["b"] = jnp.asarray(np.asarray(last["b"]) @ ob.s_out)
+            out[str(li)] = last
+        return out
+
+    def unfold_stage(self, index: int, seq: Sequential, params: dict) -> dict:
+        """Inverse of fold_stage — recover true params from a worker's
+        (trained) obfuscated params. Orthogonality makes this exact:
+        training updates in the rotated basis map back one-to-one."""
+        ob = self.stages[index]
+        fi, li = _dense_positions(seq)
+        out = jax.tree.map(lambda x: x, params)
+        if ob.r_in is not None:
+            w = np.asarray(out[str(fi)]["w"])
+            out[str(fi)] = dict(out[str(fi)], w=jnp.asarray(ob.r_in @ w))
+        if ob.s_out is not None:
+            last = dict(out[str(li)])
+            w = np.asarray(last["w"])
+            last["w"] = jnp.asarray(w @ ob.s_out.T)
+            if "b" in last:
+                last["b"] = jnp.asarray(np.asarray(last["b"]) @ ob.s_out.T)
+            out[str(li)] = last
+        return out
+
+    # --------------------------------------------------------- activations
+    def forward_in(self, index: int, x: np.ndarray) -> np.ndarray:
+        r = self.stages[index].r_in
+        return x if r is None else np.asarray(x) @ r
+
+    def forward_out(self, index: int, y: np.ndarray) -> np.ndarray:
+        s = self.stages[index].s_out
+        return y if s is None else np.asarray(y) @ s.T
+
+    def backward_in(self, index: int, g: np.ndarray) -> np.ndarray:
+        """Master -> worker: cotangent of the stage output, into the
+        rotated basis (dL/dy' = dL/dy S)."""
+        s = self.stages[index].s_out
+        return g if s is None else np.asarray(g) @ s
+
+    def backward_out(self, index: int, g: np.ndarray) -> np.ndarray:
+        """Worker -> master: returned input-cotangent, back to the true
+        basis (dL/dx = dL/dx' R^T)."""
+        r = self.stages[index].r_in
+        return g if r is None else np.asarray(g) @ r.T

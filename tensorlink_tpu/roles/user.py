@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import math
+import time
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -81,11 +82,15 @@ class DistributedJob:
         job: JobRecord,
         stages: list[RemoteStage],
         validator: Peer | None = None,
+        plan=None,  # ObfuscationPlan: master-side secret rotations
+        stage_modules: "list[Sequential] | None" = None,
     ):
         self.user = user
         self.job = job
         self.stages = stages
         self.validator = validator  # for elastic re-recruitment
+        self.plan = plan
+        self.stage_modules = stage_modules
         self.step = 0
         # last-known params per stage, used to re-ship on stage recovery
         # (seeded with the initial shipment; refreshed by checkpoint_stages)
@@ -101,6 +106,8 @@ class DistributedJob:
 
     async def _micro_forward(self, step: int, micro: int, x: np.ndarray) -> np.ndarray:
         for st in self.stages:
+            if self.plan is not None:
+                x = self.plan.forward_in(st.index, x)
             resp = await self.user.request(
                 st.peer,
                 {
@@ -117,10 +124,14 @@ class DistributedJob:
             if resp.get("type") != "ACTIVATION":
                 raise RuntimeError(f"stage {st.index} forward failed: {resp}")
             x = unpack_arrays(resp["data"])["x"]
+            if self.plan is not None:
+                x = self.plan.forward_out(st.index, x)
         return x
 
     async def _micro_backward(self, step: int, micro: int, g: np.ndarray) -> np.ndarray:
         for st in reversed(self.stages):
+            if self.plan is not None:
+                g = self.plan.backward_in(st.index, g)
             resp = await self.user.request(
                 st.peer,
                 {
@@ -137,6 +148,8 @@ class DistributedJob:
             if resp.get("type") != "INPUT_GRAD":
                 raise RuntimeError(f"stage {st.index} backward failed: {resp}")
             g = unpack_arrays(resp["data"])["g"]
+            if self.plan is not None:
+                g = self.plan.backward_out(st.index, g)
         return g
 
     async def train_step(
@@ -169,9 +182,7 @@ class DistributedJob:
         raise AssertionError("unreachable")
 
     async def _try_train_step(self, batch_x, loss_grad_fn) -> float:
-        import time as _time
-
-        t_start = _time.perf_counter()
+        t_start = time.perf_counter()
         m = self.job.micro_batches
         micros = np.array_split(np.asarray(batch_x), m)
         step = self.step
@@ -230,7 +241,7 @@ class DistributedJob:
         self.step += 1
         loss = float(np.mean(losses))
         self.user.metrics.observe("loss", loss)
-        self.user.metrics.observe("step_s", _time.perf_counter() - t_start)
+        self.user.metrics.observe("step_s", time.perf_counter() - t_start)
         self.user.metrics.incr("train_steps")
         if (
             self.checkpoint_every_steps
@@ -387,15 +398,20 @@ class DistributedJob:
 
     async def checkpoint_stages(self) -> dict[int, Any]:
         """Refresh the last-known params cache from every stage (the state
-        a recovery re-ships; pair with runtime.checkpoint for durability)."""
-        parts = await self.fetch_params()
+        a recovery re-ships; pair with runtime.checkpoint for durability).
+        The cache stays in WIRE basis (folded, if obfuscated): it is what
+        gets re-shipped verbatim on recovery."""
+        parts = await self.fetch_params(deobfuscate=False)
         for st, p in zip(self.stages, parts):
             self._stage_params[st.index] = p
         return self._stage_params
 
-    async def fetch_params(self) -> list[dict]:
+    async def fetch_params(self, deobfuscate: bool = True) -> list[dict]:
         """Gather current params from every stage (reference:
-        parameters(distributed=True), distributed.py:236-276)."""
+        parameters(distributed=True), distributed.py:236-276). When the
+        job runs obfuscated, worker params live in the rotated basis;
+        ``deobfuscate`` maps them back to the true basis (exact — the
+        rotation is orthogonal)."""
         out = []
         for st in self.stages:
             resp = await self.user.request(
@@ -409,7 +425,12 @@ class DistributedJob:
             )
             from tensorlink_tpu.p2p.serialization import tree_unflatten_arrays
 
-            out.append(tree_unflatten_arrays(unpack_arrays(resp["weights"])))
+            p = tree_unflatten_arrays(unpack_arrays(resp["weights"]))
+            if deobfuscate and self.plan is not None:
+                p = self.plan.unfold_stage(
+                    st.index, self.stage_modules[st.index], p
+                )
+            out.append(p)
         return out
 
     async def report(self, validator: Peer, loss: float) -> None:
@@ -439,10 +460,55 @@ class UserNode(Node):
         micro_batches: int = 1,
         dp_factor: int = 1,
         train: dict | None = None,
+        obfuscate: bool = False,
+        obfuscate_key: jax.Array | None = None,
     ) -> DistributedJob:
         """Partition -> JOB_REQ -> connect workers -> ship specs+weights ->
-        LOADED acks -> DistributedJob (reference call stack §3.1)."""
+        LOADED acks -> DistributedJob (reference call stack §3.1).
+
+        ``obfuscate=True`` folds secret orthogonal rotations into each
+        stage's BOUNDARY Dense layers (roles/privacy.py): the activations
+        crossing the wire and the first/last weight matrices of every
+        stage are basis-hidden from the worker. Interior layers of a
+        multi-layer stage ship as-is, rotation is not cryptographic
+        secrecy (norms/spectra are preserved), and the final stage's
+        output is clear unless the plan obfuscates it — see privacy.py's
+        stated limits. Exact training equivalence holds for sgd (rotation
+        commutes with the update); adaptive elementwise optimizers (adam,
+        adamw) train in the rotated basis with slightly different
+        dynamics — a warning is logged."""
         stage_parts = partition_sequential(model, params, max_stage_bytes)
+        plan = None
+        if obfuscate:
+            from tensorlink_tpu.roles.privacy import ObfuscationPlan
+
+            opt_name = (train or {}).get("optimizer", "adam")
+            if opt_name not in ("sgd",):
+                self.log.warning(
+                    "obfuscate=True with optimizer %r: elementwise adaptive "
+                    "statistics are not rotation-invariant, so training "
+                    "dynamics differ slightly from the unobfuscated run "
+                    "(sgd is exactly equivalent)",
+                    opt_name,
+                )
+
+            key = (
+                obfuscate_key
+                if obfuscate_key is not None
+                else jax.random.key(np.random.SeedSequence().entropy % (2**63))
+            )
+
+            def build_and_fold():
+                # off the event loop: the QR/fold jax work can take
+                # seconds of compile, and a starved loop makes co-hosted
+                # peers miss handshake/heartbeat deadlines
+                plan = ObfuscationPlan.build(key, stage_parts)
+                return plan, [
+                    (seq, plan.fold_stage(i, seq, p))
+                    for i, (seq, p) in enumerate(stage_parts)
+                ]
+
+            plan, stage_parts = await asyncio.to_thread(build_and_fold)
         specs = [
             StageSpec(
                 index=i,
@@ -501,6 +567,9 @@ class UserNode(Node):
         await asyncio.gather(
             *(ship(st, p) for st, (_, p) in zip(remote, stage_parts))
         )
-        dj = DistributedJob(self, job, remote, validator=validator)
+        dj = DistributedJob(
+            self, job, remote, validator=validator, plan=plan,
+            stage_modules=[seq for seq, _ in stage_parts],
+        )
         dj._stage_params = {i: p for i, (_, p) in enumerate(stage_parts)}
         return dj

@@ -83,11 +83,13 @@ class StatusServer:
                         "error": type(e).__name__
                     }
             payload = json.dumps(body, default=str).encode()
+            # no CORS header: a wildcard ACAO would let any web page the
+            # operator's browser visits read this unauthenticated endpoint
+            # cross-origin, defeating the loopback-bind default
             writer.write(
                 f"HTTP/1.1 {status}\r\n"
                 f"Content-Type: application/json\r\n"
                 f"Content-Length: {len(payload)}\r\n"
-                f"Access-Control-Allow-Origin: *\r\n"
                 f"Connection: close\r\n\r\n".encode() + payload
             )
             await writer.drain()

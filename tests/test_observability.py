@@ -66,9 +66,19 @@ async def test_validator_jobs_route():
 
 
 def test_cli_info_runs():
+    import os
+
+    # drop any sitecustomize dir (e.g. a tunneled-TPU registration) from
+    # the child's path: the CLI must run hermetically on CPU here, not
+    # contend for a remote accelerator mid-suite
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "site" not in os.path.basename(p)
+    )
     out = subprocess.run(
         [sys.executable, "-m", "tensorlink_tpu", "info"],
-        capture_output=True, text=True, timeout=120,
+        capture_output=True, text=True, timeout=120, env=env,
     )
     assert out.returncode == 0, out.stderr
     info = json.loads(out.stdout)

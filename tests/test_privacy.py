@@ -1,0 +1,150 @@
+"""Obfuscated offloading (whitepaper privacy posture, survey §7.1.6):
+workers compute on rotated activations/weights; the master's secret
+rotations make the composition exact."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.models.mlp import MLP, MLPConfig
+from tensorlink_tpu.roles.privacy import ObfuscationPlan, random_orthogonal
+from tensorlink_tpu.roles.user import partition_sequential
+
+KEY = jax.random.key(0)
+
+
+def _stages():
+    m = MLP(MLPConfig(in_dim=16, hidden_dim=32, out_dim=4, num_layers=2))
+    p = m.init(KEY)
+    parts = partition_sequential(m.seq, p["seq"], max_stage_bytes=16 * 32 * 4 + 200)
+    assert len(parts) == 2
+    return m, p, parts
+
+
+def test_random_orthogonal_is_orthogonal():
+    r = random_orthogonal(KEY, 32)
+    np.testing.assert_allclose(r @ r.T, np.eye(32), atol=1e-5)
+
+
+def test_folded_stage_equals_original_composition():
+    """seq(folded, x R) S^T == seq(orig, x) for every stage."""
+    m, p, parts = _stages()
+    plan = ObfuscationPlan.build(KEY, parts)
+    x = np.asarray(jax.random.normal(jax.random.key(1), (8, 16)))
+    h_true, h_obf = x, x
+    for i, (seq, sp) in enumerate(parts):
+        folded = plan.fold_stage(i, seq, sp)
+        # the wire view is rotated: the worker must not see true activations
+        x_wire = plan.forward_in(i, h_obf)
+        if plan.stages[i].r_in is not None:
+            assert not np.allclose(x_wire, h_true, atol=1e-3)
+        y_wire = np.asarray(seq.apply(folded, jnp.asarray(x_wire)))
+        h_obf = plan.forward_out(i, y_wire)
+        h_true = np.asarray(seq.apply(sp, jnp.asarray(h_true)))
+        np.testing.assert_allclose(h_obf, h_true, atol=1e-4)
+    # folded weights differ from true weights (worker cannot read them off)
+    folded0 = plan.fold_stage(0, *parts[0])
+    assert not np.allclose(
+        np.asarray(folded0["0"]["w"]), np.asarray(parts[0][1]["0"]["w"]), atol=1e-3
+    )
+
+
+def test_fold_unfold_roundtrip():
+    m, p, parts = _stages()
+    plan = ObfuscationPlan.build(KEY, parts)
+    for i, (seq, sp) in enumerate(parts):
+        folded = plan.fold_stage(i, seq, sp)
+        back = plan.unfold_stage(i, seq, folded)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5
+            ),
+            sp,
+            back,
+        )
+
+
+def test_transformer_stage_rejected():
+    """LayerNorm-fronted stages must fail loudly, not silently corrupt."""
+    from tensorlink_tpu.nn.module import Sequential
+    from tensorlink_tpu.nn.transformer import TransformerBlock
+
+    blk = TransformerBlock(dim=16, num_heads=2, hidden_dim=32)
+    seq = Sequential([blk])
+    p = seq.init(KEY)
+    with pytest.raises(ValueError):
+        ObfuscationPlan.build(KEY, [(seq, p)])
+
+
+@pytest.mark.asyncio
+async def test_e2e_obfuscated_training_matches_plain():
+    """Obfuscated distributed SGD == plain distributed SGD (orthogonal
+    rotations commute with the SGD update exactly; float32 tolerance)."""
+    from tensorlink_tpu.config import NodeConfig
+    from tensorlink_tpu.roles.registry import InMemoryRegistry
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    def cfg(role):
+        return NodeConfig(role=role, host="127.0.0.1", port=0)
+
+    async def run(obfuscate: bool) -> tuple[list, list]:
+        reg = InMemoryRegistry()
+        validator = ValidatorNode(cfg("validator"), registry=reg)
+        await validator.start()
+        workers = []
+        for _ in range(2):
+            w = WorkerNode(cfg("worker"))
+            await w.start()
+            await w.connect("127.0.0.1", validator.port)
+            workers.append(w)
+        user = UserNode(cfg("user"))
+        await user.start()
+        v_peer = await user.connect("127.0.0.1", validator.port)
+        m = MLP(MLPConfig(in_dim=16, hidden_dim=32, out_dim=4, num_layers=2))
+        p = m.init(KEY)
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer,
+            max_stage_bytes=16 * 32 * 4 + 200, micro_batches=2,
+            train={"optimizer": "sgd", "learning_rate": 0.05},
+            obfuscate=obfuscate, obfuscate_key=jax.random.key(42),
+        )
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 16)).astype(np.float32)
+        y = rng.integers(0, 4, 16)
+
+        def lg(logits, micro):
+            lj = jnp.asarray(logits)
+            yj = jnp.asarray(np.array_split(y, 2)[micro])
+
+            def f(l):
+                return jnp.mean(
+                    jax.nn.logsumexp(l, -1)
+                    - jnp.take_along_axis(l, yj[:, None], -1)[..., 0]
+                )
+
+            val, g = jax.value_and_grad(f)(lj)
+            return float(val), np.asarray(g)
+
+        losses = [await job.train_step(x, lg) for _ in range(8)]
+        fetched = await job.fetch_params()  # deobfuscated by default
+        for n in (user, validator, *workers):
+            await n.stop()
+        return losses, fetched
+
+    plain_losses, plain_params = await run(False)
+    obf_losses, obf_params = await run(True)
+    np.testing.assert_allclose(plain_losses, obf_losses, rtol=1e-3)
+    assert obf_losses[-1] < obf_losses[0]
+    for a, b in zip(plain_params, obf_params):
+        jax.tree.map(
+            lambda u, v: np.testing.assert_allclose(
+                np.asarray(u), np.asarray(v), atol=2e-3
+            ),
+            a,
+            b,
+        )
