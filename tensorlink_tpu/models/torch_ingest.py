@@ -1,0 +1,127 @@
+"""Torch model ingestion: structural conversion to native modules.
+
+The reference wraps a live ``torch.nn.Module`` and ships pickled subtrees
+to workers (src/ml/distributed.py:305-378, src/p2p/torch_node.py:159-162).
+Shipping torch code is impossible (and undesirable) TPU-side; the north
+star is tracing torch -> XLA. The practical path (SURVEY §7.5.3) is:
+
+1. **architecture re-implementation + weight import** for known families
+   (models/hf_import.py covers BERT / GPT-2 / ViT / Llama), and
+2. **structural conversion** — this module — for the long tail of
+   container-style models: walk a ``torch.nn`` tree built from standard
+   layers and emit the equivalent native `Sequential` + param pytree.
+   The result partitions, ships, and jit-compiles like any native model
+   (see tests/test_torch_ingest.py: ingested torch MLP -> request_job).
+
+Supported leaves: Linear, ReLU, GELU, SiLU, Tanh, Sigmoid, LayerNorm,
+Dropout, Embedding, Flatten, Identity, and nested Sequential. Anything
+else raises with the module path — loud, not lossy.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from tensorlink_tpu.nn.layers import Dense, Dropout, Embedding, LayerNorm
+from tensorlink_tpu.nn.module import Lambda, Module, Sequential, _ACTIVATION_FNS
+
+
+class UnsupportedTorchModule(ValueError):
+    pass
+
+
+def _act(name: str) -> Lambda:
+    return Lambda(_ACTIVATION_FNS[name], name=name)
+
+
+def _convert_leaf(mod: Any, path: str) -> tuple[Module, Any] | None:
+    """-> (native module, params) or None to skip (e.g. Identity)."""
+    import torch.nn as tn
+
+    if isinstance(mod, tn.Linear):
+        dense = Dense(mod.in_features, mod.out_features, use_bias=mod.bias is not None)
+        p = {"w": np.asarray(mod.weight.detach().cpu()).T}
+        if mod.bias is not None:
+            p["b"] = np.asarray(mod.bias.detach().cpu())
+        return dense, p
+    if isinstance(mod, tn.Embedding):
+        emb = Embedding(mod.num_embeddings, mod.embedding_dim)
+        return emb, {"table": np.asarray(mod.weight.detach().cpu())}
+    if isinstance(mod, tn.LayerNorm):
+        if len(mod.normalized_shape) != 1:
+            raise UnsupportedTorchModule(
+                f"{path}: only last-dim LayerNorm supported"
+            )
+        if mod.weight is None or mod.bias is None:
+            raise UnsupportedTorchModule(
+                f"{path}: non-affine / bias-free LayerNorm not supported"
+            )
+        ln = LayerNorm(mod.normalized_shape[0], eps=mod.eps)
+        return ln, {
+            "scale": np.asarray(mod.weight.detach().cpu()),
+            "bias": np.asarray(mod.bias.detach().cpu()),
+        }
+    if isinstance(mod, tn.Dropout):
+        return Dropout(mod.p), {}
+    if isinstance(mod, tn.ReLU):
+        return _act("relu"), {}
+    if isinstance(mod, tn.GELU):
+        # torch GELU(approximate="none") is the erf form
+        return _act("gelu" if mod.approximate == "tanh" else "gelu_exact"), {}
+    if isinstance(mod, tn.SiLU):
+        return _act("silu"), {}
+    if isinstance(mod, tn.Tanh):
+        return _act("tanh"), {}
+    if isinstance(mod, tn.Sigmoid):
+        return _act("sigmoid"), {}
+    if isinstance(mod, tn.Flatten):
+        if mod.start_dim != 1 or mod.end_dim != -1:
+            raise UnsupportedTorchModule(f"{path}: only Flatten(1, -1)")
+        # the registered fn, not an inline twin: workers rebuild Lambdas
+        # from config BY NAME, and two definitions could drift
+        return _act("flatten"), {}
+    if isinstance(mod, tn.Identity):
+        return None
+    raise UnsupportedTorchModule(
+        f"{path}: {type(mod).__name__} has no native equivalent; "
+        "re-implement the architecture and import weights instead "
+        "(models/hf_import.py pattern)"
+    )
+
+
+def from_torch(module: Any, path: str = "root") -> tuple[Sequential, dict]:
+    """torch nn.Sequential (possibly nested) -> (native Sequential, params).
+
+    Parameters come out as a flat {"0": ..., "1": ...} tree mirroring the
+    native Sequential layout, ready for `partition_sequential` /
+    `UserNode.request_job`.
+    """
+    import torch.nn as tn
+
+    if not isinstance(module, tn.Sequential):
+        # single leaf: wrap
+        conv = _convert_leaf(module, path)
+        if conv is None:
+            return Sequential([]), {}
+        mod, p = conv
+        return Sequential([mod]), {"0": p}
+
+    layers: list[Module] = []
+    params: dict = {}
+    for i, child in enumerate(module):
+        cpath = f"{path}.{i}"
+        if isinstance(child, tn.Sequential):
+            sub, sub_p = from_torch(child, cpath)
+            for j, l in enumerate(sub.layers):
+                params[str(len(layers))] = sub_p[str(j)]
+                layers.append(l)
+            continue
+        conv = _convert_leaf(child, cpath)
+        if conv is None:
+            continue
+        mod, p = conv
+        params[str(len(layers))] = p
+        layers.append(mod)
+    return Sequential(layers), params

@@ -34,5 +34,10 @@ for _cls in (
 
 import jax as _jax  # noqa: E402
 
-for _name, _fn in {**ACTIVATIONS, "tanh": _jax.numpy.tanh}.items():
+for _name, _fn in {
+    **ACTIVATIONS,
+    "tanh": _jax.numpy.tanh,
+    "sigmoid": _jax.nn.sigmoid,
+    "flatten": lambda x: x.reshape(x.shape[0], -1),
+}.items():
     register_activation(_name, _fn)

@@ -573,3 +573,120 @@ class UserNode(Node):
         )
         dj._stage_params = {i: p for i, (_, p) in enumerate(stage_parts)}
         return dj
+
+    async def reattach_job(
+        self,
+        job_id: str,
+        validator: Peer,
+        *,
+        obfuscate_key: jax.Array | None = None,
+    ) -> DistributedJob:
+        """Re-attach to a live job after a master restart (the reference
+        leaves this as a TODO, src/roles/user.py:169-171).
+
+        Requires the SAME identity (cfg.key_dir) that created the job:
+        workers authorize data-plane ops by the owner node_id. The job
+        record comes from the validator/DHT, stage modules are rebuilt
+        from their specs, and current params are pulled from the workers
+        to seed the recovery snapshot. For an obfuscated job, pass the
+        original ``obfuscate_key`` — the rotation plan is a deterministic
+        function of (key, stage shapes) and is rebuilt exactly.
+        """
+        from tensorlink_tpu.nn.module import module_from_config
+
+        resp = await self.request(
+            validator, {"type": "JOB_INFO", "job_id": job_id}, timeout=30.0
+        )
+        if resp.get("type") != "JOB":
+            raise RuntimeError(f"job lookup failed: {resp.get('error')}")
+        job = JobRecord.from_wire(resp["job"])
+        if job.author != self.node_id:
+            raise RuntimeError(
+                "reattach requires the job author's identity "
+                f"(job author {job.author[:8]}, we are {self.node_id[:8]})"
+            )
+        if not job.workers:
+            raise RuntimeError("job record carries no placements")
+
+        remote: list[RemoteStage] = []
+        for placement in job.workers:
+            peer = self.peers.get(placement["node_id"])
+            if peer is None:
+                peer = await self.connect(
+                    placement["host"], int(placement["port"])
+                )
+            remote.append(
+                RemoteStage(index=int(placement["stage"]), peer=peer,
+                            info=placement)
+            )
+        remote.sort(key=lambda s: s.index)
+
+        stage_modules = [
+            module_from_config(s.module_config) for s in job.stages
+        ]
+        plan = None
+        if obfuscate_key is not None:
+            from tensorlink_tpu.roles.privacy import ObfuscationPlan
+
+            plan = ObfuscationPlan.build(
+                obfuscate_key, [(seq, {}) for seq in stage_modules]
+            )
+        dj = DistributedJob(
+            self, job, remote, validator=validator, plan=plan,
+            stage_modules=stage_modules,
+        )
+        # 1) abort any partial step the dead master left behind (stale
+        # grad accum / stashed activations would corrupt the first
+        # resumed update) and learn each runner's current fence epoch —
+        # resuming at fence 0 against a runner whose fence advanced
+        # would have every data-plane message rejected as stale
+        # (review findings)
+        async def abort(st: RemoteStage) -> int:
+            r = await self.request(
+                st.peer,
+                {"type": "ABORT_STEP", "job_id": job.job_id,
+                 "stage": st.index, "fence": 0},
+                timeout=10.0,
+            )
+            if r.get("type") != "STEP_ABORTED":
+                raise RuntimeError(f"stage {st.index} abort failed: {r}")
+            return int(r.get("fence", 0))
+
+        fences = await asyncio.gather(*(abort(st) for st in remote))
+        dj._fence = max(fences)
+
+        # 2) seed the recovery snapshot from the live workers (wire
+        # basis) and resynchronize the logical step counter: runners
+        # guard STEP_END idempotency by last APPLIED master step, so the
+        # resumed counter must sit strictly above every stage's
+        # (review finding: runner.step alone can lag it)
+        from tensorlink_tpu.p2p.serialization import tree_unflatten_arrays
+
+        async def fetch(st: RemoteStage) -> tuple[int, int, int]:
+            presp = await self.request(
+                st.peer,
+                {"type": "PARAMS_REQUEST", "job_id": job.job_id,
+                 "stage": st.index},
+                timeout=60.0,
+            )
+            if presp.get("type") != "PARAMETERS":
+                raise RuntimeError(
+                    f"stage {st.index} params fetch failed: {presp}"
+                )
+            dj._stage_params[st.index] = tree_unflatten_arrays(
+                unpack_arrays(presp["weights"])
+            )
+            return (
+                int(presp.get("step", 0)),
+                int(presp.get("applied_step", -1)),
+                st.index,
+            )
+
+        fetched = await asyncio.gather(*(fetch(st) for st in remote))
+        state = resp.get("state") or {}
+        dj.step = max(
+            [int(state.get("step", 0) or 0)]
+            + [s for s, _, _ in fetched]
+            + [a + 1 for _, a, _ in fetched]
+        )
+        return dj

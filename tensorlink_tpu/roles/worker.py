@@ -427,6 +427,10 @@ class WorkerNode(Node):
             "job_id": msg["job_id"],
             "stage": msg["stage"],
             "step": runner.step,
+            # last APPLIED master step: a reattaching master must resume
+            # strictly above this or its STEP_ENDs are skipped as dupes
+            "applied_step": runner.last_applied_step,
+            "fence": runner.fence,
             "weights": pack_arrays(flat),
         }
 

@@ -501,3 +501,59 @@ def test_stale_fence_rejected_at_accumulate_time():
     with pytest.raises(StaleFenceError):
         r.backward(0, 0, np.ones_like(out), fence=0)
     assert r.grad_accum is None and r.micro_seen == 0
+
+
+@pytest.mark.asyncio
+async def test_job_reattach_after_master_restart():
+    """Reference TODO (src/roles/user.py:169-171) made real: a new master
+    process with the SAME identity re-attaches to a live job, resumes
+    training where it left off, and a stranger identity is rejected."""
+    import tempfile
+
+    reg, validator, workers, user, v_peer = await _setup_network(2)
+    keydir = tempfile.mkdtemp()
+    # re-create the user with a persistent identity so a "restart" can
+    # prove ownership
+    await user.stop()
+    user = UserNode(NodeConfig(role="user", host="127.0.0.1", port=0, key_dir=keydir))
+    await user.start()
+    v_peer = await user.connect("127.0.0.1", validator.port)
+    try:
+        m, p = _model()
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer,
+            max_stage_bytes=16 * 32 * 4 + 200, micro_batches=2,
+            train={"optimizer": "sgd", "learning_rate": 0.05},
+        )
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 16)).astype(np.float32)
+
+        def lg(logits, micro):
+            lj = jnp.asarray(logits)
+            val, g = jax.value_and_grad(lambda l: jnp.mean(l**2))(lj)
+            return float(val), np.asarray(g)
+
+        l0 = await job.train_step(x, lg)
+        await job.report(v_peer, l0)
+        job_id = job.job.job_id
+
+        # master dies; a new node with the same identity comes back
+        await user.stop()
+        user2 = UserNode(NodeConfig(role="user", host="127.0.0.1", port=0, key_dir=keydir))
+        await user2.start()
+        v_peer2 = await user2.connect("127.0.0.1", validator.port)
+        job2 = await user2.reattach_job(job_id, v_peer2)
+        assert job2.step >= 1  # resynced from workers, not restarted at 0
+        losses = [await job2.train_step(x, lg) for _ in range(5)]
+        assert losses[-1] < losses[0]
+
+        # a stranger cannot reattach
+        thief = UserNode(_cfg("user"))
+        await thief.start()
+        v_peer3 = await thief.connect("127.0.0.1", validator.port)
+        with pytest.raises(RuntimeError, match="author"):
+            await thief.reattach_job(job_id, v_peer3)
+        await thief.stop()
+        user = user2
+    finally:
+        await _teardown(user, validator, *workers)
