@@ -67,6 +67,7 @@ class RemoteStage:
     index: int
     peer: Peer
     info: dict
+    replica: int = 0
 
 
 class DistributedJob:
@@ -87,10 +88,20 @@ class DistributedJob:
     ):
         self.user = user
         self.job = job
-        self.stages = stages
+        self.stages = stages  # ALL stage slots (every replica)
         self.validator = validator  # for elastic re-recruitment
         self.plan = plan
         self.stage_modules = stage_modules
+        # data-parallel pipelines: chains[r] = replica r's stage chain;
+        # micro-batch m routes through chains[m % dp] (reference planned
+        # this as dp_factor, src/roles/user.py:161 — never built)
+        by_replica: dict[int, list[RemoteStage]] = {}
+        for st in stages:
+            by_replica.setdefault(st.replica, []).append(st)
+        self.chains = [
+            sorted(by_replica[r], key=lambda s: s.index)
+            for r in sorted(by_replica)
+        ]
         self.step = 0
         # last-known params per stage, used to re-ship on stage recovery
         # (seeded with the initial shipment; refreshed by checkpoint_stages)
@@ -105,7 +116,8 @@ class DistributedJob:
         self._fence = 0
 
     async def _micro_forward(self, step: int, micro: int, x: np.ndarray) -> np.ndarray:
-        for st in self.stages:
+        chain = self.chains[micro % len(self.chains)]
+        for st in chain:
             if self.plan is not None:
                 x = self.plan.forward_in(st.index, x)
             resp = await self.user.request(
@@ -129,7 +141,8 @@ class DistributedJob:
         return x
 
     async def _micro_backward(self, step: int, micro: int, g: np.ndarray) -> np.ndarray:
-        for st in reversed(self.stages):
+        chain = self.chains[micro % len(self.chains)]
+        for st in reversed(chain):
             if self.plan is not None:
                 g = self.plan.backward_in(st.index, g)
             resp = await self.user.request(

@@ -90,9 +90,12 @@ class ValidatorNode(Node):
         stage_index: int,
         stats: dict[str, dict],
         taken: set[str],
+        replica: int = 0,
     ) -> dict | None:
         """Best-fit recruitment with decline fallback (reference:
-        recruit_worker, validator.py:244-296)."""
+        recruit_worker, validator.py:244-296). ``replica`` tags the
+        data-parallel replica slot (reference: planned dp_factor,
+        src/roles/user.py:161 — implemented here)."""
         spec = job.stages[stage_index]
         candidates = sorted(
             (
@@ -122,7 +125,7 @@ class ValidatorNode(Node):
                 continue
             if resp.get("type") == "ACCEPT_JOB":
                 taken.add(nid)
-                return dict(resp["info"], stage=stage_index)
+                return dict(resp["info"], stage=stage_index, replica=replica)
         return None
 
     async def _h_job_req(self, node, peer, msg) -> dict:
@@ -141,12 +144,15 @@ class ValidatorNode(Node):
         stats = await self._poll_worker_stats()
         taken: set[str] = set()
         placements: list[dict | None] = []
-        for i in range(job.n_stages):  # sequential: taken-set must grow
-            placements.append(await self._recruit_stage(job, i, stats, taken))
+        for r in range(job.dp_factor):
+            for i in range(job.n_stages):  # sequential: taken-set must grow
+                placements.append(
+                    await self._recruit_stage(job, i, stats, taken, replica=r)
+                )
         if any(p is None for p in placements):
             return {
                 "type": "DECLINE_JOB",
-                "reason": f"could not place stages "
+                "reason": f"could not place stage slots "
                 f"{[i for i, p in enumerate(placements) if p is None]}",
             }
         job.workers = placements
@@ -193,13 +199,27 @@ class ValidatorNode(Node):
         if job.author != peer.node_id:
             return {"type": "ERROR", "error": "unauthorized"}
         stage_index = int(msg["stage"])
+        replica = int(msg.get("replica", 0))
         if not 0 <= stage_index < job.n_stages:
             return {"type": "ERROR", "error": "bad stage"}
+        workers = job.workers or []
+        slot = next(
+            (
+                k
+                for k, w in enumerate(workers)
+                if w
+                and int(w.get("stage", -1)) == stage_index
+                and int(w.get("replica", 0)) == replica
+            ),
+            None,
+        )
+        if slot is None:
+            return {"type": "ERROR", "error": "unknown stage slot"}
         exclude = {str(x) for x in msg.get("exclude", [])}
-        # only the worker actually recorded on this stage gets a liveness
+        # only the worker actually recorded on this slot gets a liveness
         # ding — the exclude list is caller-supplied and must not be a
         # reputation weapon against arbitrary nodes (review finding)
-        current = (job.workers or [None] * job.n_stages)[stage_index]
+        current = workers[slot]
         if current and current["node_id"] in exclude:
             nid = current["node_id"]
             rep = self.dht.get_local(f"rep:{nid}")
@@ -208,18 +228,19 @@ class ValidatorNode(Node):
             )
         stats = await self._poll_worker_stats()
         taken = exclude | {
-            w["node_id"]
-            for i, w in enumerate(job.workers or [])
-            if w and i != stage_index
+            w["node_id"] for k, w in enumerate(workers) if w and k != slot
         }
-        placement = await self._recruit_stage(job, stage_index, stats, taken)
+        placement = await self._recruit_stage(
+            job, stage_index, stats, taken, replica=replica
+        )
         if placement is None:
             return {"type": "ERROR", "error": "no replacement available"}
-        job.workers[stage_index] = placement
+        job.workers[slot] = placement
         await self.dht_store(f"job:{jid}", job.to_wire())
         st = self.job_state.setdefault(jid, {})
         st.setdefault("replacements", []).append(
-            {"stage": stage_index, "new": placement["node_id"], "at": time.time()}
+            {"stage": stage_index, "replica": replica,
+             "new": placement["node_id"], "at": time.time()}
         )
         return {"type": "WORKER_REPLACED", "job_id": jid, "worker": placement}
 

@@ -75,6 +75,11 @@ class StageRunner:
     grad_accum: Any = None
     micro_seen: int = 0
     last_applied_step: int = -1  # master step already applied (idempotency)
+    # data-parallel replica set (reference: planned dp_factor gradient
+    # averaging, Whitepaper:21 / src/roles/user.py:161 — implemented):
+    replica: int = 0
+    replica_peers: list = field(default_factory=list)  # [{node_id,host,port}]
+    _snapped_step: int = -1  # guards double-snapshot on STEP_END retry
 
     def __post_init__(self):
         import threading
@@ -138,6 +143,7 @@ class StageRunner:
             self.grad_accum = None
             self.micro_seen = 0
             self.inputs.clear()
+            self._snapped_step = -1  # the retried step may snapshot again
 
     def apply_step(self, master_step: int | None = None, fence: int = 0) -> bool:
         """Apply the accumulated gradient. Idempotent per logical
@@ -171,6 +177,52 @@ class StageRunner:
         self.step += 1
         return True
 
+    def take_accum(self, master_step: int | None, fence: int):
+        """Snapshot-and-clear the gradient accumulator for DP sync.
+        Returns (grads_or_None, micro_count) or None if this logical step
+        was already snapshotted/applied or the fence is stale."""
+        with self._lock:
+            if fence < self.fence:
+                return None
+            if master_step is not None and (
+                master_step <= self.last_applied_step
+                or master_step <= self._snapped_step
+            ):
+                return None
+            if master_step is not None:
+                self._snapped_step = master_step
+            g, n = self.grad_accum, self.micro_seen
+            self.grad_accum = None
+            self.micro_seen = 0
+        return g, n
+
+    def apply_synced(self, master_step: int | None, contributions) -> bool:
+        """Apply the replica-averaged gradient. ``contributions`` is the
+        DETERMINISTICALLY ORDERED [(grads_or_None, n), ...] across all
+        replicas (own included) — same order on every replica, so the
+        floating-point sum (and thus the params) stays bitwise identical
+        across the replica set."""
+        total_n = sum(n for _, n in contributions)
+        if total_n == 0:
+            return False
+        acc = None
+        for g, n in contributions:
+            if g is None:
+                continue
+            acc = g if acc is None else jax.tree.map(jnp.add, acc, g)
+        grads = jax.tree.map(lambda x: x / total_n, acc)
+        with self._lock:
+            if master_step is not None and master_step <= self.last_applied_step:
+                return False
+            if master_step is not None:
+                self.last_applied_step = master_step
+        updates, self.opt_state = self.opt.update(
+            grads, self.opt_state, self.params, self.step
+        )
+        self.params = apply_updates(self.params, updates)
+        self.step += 1
+        return True
+
 
 class WorkerNode(Node):
     """Handles: STATS_REQUEST, JOB_OFFER, MODULE_SPEC, FORWARD, BACKWARD,
@@ -183,6 +235,8 @@ class WorkerNode(Node):
         super().__init__(cfg, **kw)
         self.registry = registry  # optional: verifies validator identity
         self.stages: dict[tuple[str, int], StageRunner] = {}
+        # DP replica grad exchange: (job, stage, step, sender) -> (g, n)
+        self._grad_inbox: dict[tuple, tuple[Any, int]] = {}
         # (job_id, stage) -> (bytes, expires_at, author); converted to a
         # live stage by MODULE_SPEC (author-only), or expired — never
         # leaked (review finding).
@@ -211,6 +265,7 @@ class WorkerNode(Node):
         self.on("FORWARD", self._h_forward)
         self.on("BACKWARD", self._h_backward)
         self.on("STEP_END", self._h_step_end)
+        self.on("GRAD_SHARE", self._h_grad_share)
         self.on("ABORT_STEP", self._h_abort_step)
         self.on("PARAMS_REQUEST", self._h_params_request)
         self.on("POL_CHALLENGE", self._h_pol_challenge)
@@ -307,6 +362,12 @@ class WorkerNode(Node):
             opt=opt,
             opt_state=opt.init(params),
             owner=peer.node_id,
+            replica=int(msg.get("replica", 0)),
+            replica_peers=[
+                dict(p)
+                for p in msg.get("replicas", [])
+                if p.get("node_id") != self.node_id
+            ],
         )
         self.stages[(runner.job_id, runner.stage_index)] = runner
         self.training = True
@@ -393,15 +454,109 @@ class WorkerNode(Node):
 
     async def _h_step_end(self, node, peer, msg) -> dict:
         """All micro-grads in: optimizer step (correctly: step, no
-        pre-zeroing — contrast worker.py:320-321)."""
+        pre-zeroing — contrast worker.py:320-321). When the stage has
+        data-parallel replicas, grads are exchanged worker-to-worker and
+        averaged deterministically before the update (the reference only
+        *planned* this, Whitepaper:21)."""
         runner = self._authorized_runner(peer, msg)
         if isinstance(runner, dict):
             return runner
         master_step = int(msg["step"]) if "step" in msg else None
+        fence = int(msg.get("fence", 0))
+        if not runner.replica_peers:
+            applied = await asyncio.to_thread(runner.apply_step, master_step, fence)
+            return {"type": "STEPPED", "step": runner.step, "applied": applied}
+
+        snap = await asyncio.to_thread(runner.take_accum, master_step, fence)
+        if snap is None:  # duplicate/stale STEP_END
+            return {"type": "STEPPED", "step": runner.step, "applied": False}
+        own_g, own_n = snap
+
+        # push our contribution to every replica peer, then wait for
+        # theirs; the combined sum is ordered by node_id so every replica
+        # applies a bitwise-identical update
+        def pack_contrib():
+            if own_g is None:
+                return pack_arrays({}), own_n
+            return (
+                pack_arrays(
+                    tree_flatten_arrays(jax.tree.map(np.asarray, own_g))
+                ),
+                own_n,
+            )
+
+        blob, n = await asyncio.to_thread(pack_contrib)
+
+        async def push(info: dict):
+            p = self.peers.get(info["node_id"])
+            if p is None:
+                p = await self.connect(info["host"], int(info["port"]))
+            await self.request(
+                p,
+                {
+                    "type": "GRAD_SHARE",
+                    "job_id": runner.job_id,
+                    "stage": runner.stage_index,
+                    "step": master_step,
+                    "n": n,
+                    "data": blob,
+                },
+                timeout=30.0,
+            )
+
+        try:
+            await asyncio.gather(*(push(i) for i in runner.replica_peers))
+            expected = {i["node_id"] for i in runner.replica_peers}
+            deadline = asyncio.get_event_loop().time() + 30.0
+            while True:
+                have = {
+                    s
+                    for (j, st, sp, s) in self._grad_inbox
+                    if j == runner.job_id
+                    and st == runner.stage_index
+                    and sp == master_step
+                }
+                if expected <= have:
+                    break
+                if asyncio.get_event_loop().time() > deadline:
+                    return {"type": "ERROR", "error": "grad sync timeout"}
+                await asyncio.sleep(0.02)
+        except (ConnectionError, asyncio.TimeoutError):
+            return {"type": "ERROR", "error": "grad sync failed"}
+
+        contribs = {self.node_id: (own_g, own_n)}
+        for nid in expected:
+            key = (runner.job_id, runner.stage_index, master_step, nid)
+            contribs[nid] = self._grad_inbox.pop(key)
+        ordered = [contribs[nid] for nid in sorted(contribs)]
         applied = await asyncio.to_thread(
-            runner.apply_step, master_step, int(msg.get("fence", 0))
+            runner.apply_synced, master_step, ordered
         )
         return {"type": "STEPPED", "step": runner.step, "applied": applied}
+
+    async def _h_grad_share(self, node, peer, msg) -> dict:
+        """A replica peer's gradient contribution. Only accepted from the
+        stage's registered replica set."""
+        key = (str(msg["job_id"]), int(msg["stage"]))
+        runner = self.stages.get(key)
+        if runner is None:
+            return {"type": "ERROR", "error": f"no stage {key}"}
+        if peer.node_id not in {i["node_id"] for i in runner.replica_peers}:
+            peer.ghosts += 1
+            self._penalize(peer)
+            return {"type": "ERROR", "error": "not a replica peer"}
+
+        def unpack():
+            flat = unpack_arrays(msg["data"])
+            if not flat or set(flat) == {"//empty"}:
+                return None
+            return jax.tree.map(jnp.asarray, tree_unflatten_arrays(flat))
+
+        g = await asyncio.to_thread(unpack)
+        self._grad_inbox[
+            (runner.job_id, runner.stage_index, int(msg["step"]), peer.node_id)
+        ] = (g, int(msg["n"]))
+        return {"type": "GRAD_ACK", "step": msg["step"]}
 
     async def _h_abort_step(self, node, peer, msg) -> dict:
         """Discard partial grads/activations after a mid-step stage
