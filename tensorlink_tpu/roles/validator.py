@@ -252,6 +252,7 @@ class ValidatorNode(Node):
         in_shape: tuple[int, ...],
         seed: int = 0,
         rtol: float = 1e-4,
+        replica: int = 0,
     ) -> dict:
         """Proof-of-learning audit of one placed stage.
 
@@ -273,7 +274,24 @@ class ValidatorNode(Node):
         if job is None:
             raise KeyError(f"unknown job {job_id}")
         spec = job.stages[stage_index]
-        placement = job.workers[stage_index]
+        # look the slot up by (stage, replica) — indexing workers[] by
+        # stage_index is only right for replica 0 when dp_factor == 1
+        # (judge finding)
+        placement = next(
+            (
+                w
+                for w in (job.workers or [])
+                if w
+                and int(w.get("stage", -1)) == stage_index
+                and int(w.get("replica", 0)) == replica
+            ),
+            None,
+        )
+        if placement is None:
+            raise KeyError(
+                f"job {job_id} has no placement for stage {stage_index} "
+                f"replica {replica}"
+            )
         wid = placement["node_id"]
         peer = self.peers.get(wid)
         if peer is None:
@@ -339,14 +357,28 @@ class ValidatorNode(Node):
                 # an honest legacy worker that is actively TRAINING is
                 # inconclusive on every audit (the separate params fetch
                 # races the optimizer) — its reported step advances, so
-                # don't escalate. A worker whose step is stagnant across
-                # 3 inconclusive digest mismatches is not training and
-                # the mismatch cannot be a race: evasion (review finding)
+                # don't escalate immediately. A worker whose step is
+                # stagnant across 3 inconclusive digest mismatches is not
+                # training and the mismatch cannot be a race: evasion
+                # (review finding). And regardless of step churn, the
+                # validator asked for the atomic include_params reply
+                # EXPLICITLY every time — a worker that keeps choosing the
+                # legacy reply controls that choice, so a 'step' it merely
+                # claims to bump must not whitelist it forever: cap total
+                # consecutive legacy inconclusives (advisor finding,
+                # round 1: fabricated step bumps evaded audits
+                # indefinitely)
                 cur_step = proof.get("step")
                 advancing = any(a.get("step") != cur_step for a in streak)
                 if len(streak) >= 2 and not advancing:
                     record.update(
                         passed=False, reason="persistent inconclusive audits"
+                    )
+                elif len(streak) >= 4:
+                    record.update(
+                        passed=False,
+                        reason="refused atomic proof across "
+                        f"{len(streak) + 1} audits",
                     )
                 else:
                     record.update(

@@ -427,6 +427,92 @@ async def test_elastic_recovery_worker_death_mid_training():
 
 
 @pytest.mark.asyncio
+async def test_dp_factor_2_end_to_end():
+    """dp_factor=2 over 2 stages = 4 worker slots: replica placements
+    propagate into RemoteStage + MODULE_SPEC, micro-batches route
+    round-robin over the two chains, replicas exchange GRAD_SHARE on
+    STEP_END and stay BITWISE identical, and the per-replica audit path
+    finds the right slot (the reference only planned dp_factor,
+    src/roles/user.py:161; round-1 advisor found the user side collapsed
+    every slot into replica 0)."""
+    reg, validator, workers, user, v_peer = await _setup_network(4)
+    try:
+        m, p = _model()
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer,
+            max_stage_bytes=16 * 32 * 4 + 200,  # 2 stages
+            micro_batches=2,
+            dp_factor=2,
+            train={"optimizer": "sgd", "learning_rate": 0.05},
+        )
+        # 4 distinct slots, 2 chains of 2 stages
+        assert len(job.stages) == 4
+        assert len({st.peer.node_id for st in job.stages}) == 4
+        chains = job.chains
+        assert [len(c) for c in chains] == [2, 2]
+        assert {st.replica for st in chains[0]} == {0}
+        assert {st.replica for st in chains[1]} == {1}
+
+        # every worker runner knows its replica id and its sibling
+        jid = job.job.job_id
+        for st in job.stages:
+            w = next(w for w in workers if w.node_id == st.peer.node_id)
+            runner = w.stages[(jid, st.index)]
+            assert runner.replica == st.replica
+            assert len(runner.replica_peers) == 1  # the other replica
+            sibling = next(
+                s for s in job.stages
+                if s.index == st.index and s.replica != st.replica
+            )
+            assert runner.replica_peers[0]["node_id"] == sibling.peer.node_id
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 16)).astype(np.float32)
+        w_true = rng.normal(size=(16, 4))
+        y = np.argmax(x @ w_true, -1)
+
+        def loss_grad(logits, micro):
+            lj = jnp.asarray(logits)
+            yj = jnp.asarray(np.array_split(y, 2)[micro])
+
+            def f(l):
+                logz = jax.nn.logsumexp(l, axis=-1)
+                ll = jnp.take_along_axis(l, yj[:, None], axis=-1)[..., 0]
+                return jnp.mean(logz - ll)
+
+            val, g = jax.value_and_grad(f)(lj)
+            return float(val), np.asarray(g)
+
+        losses = [await job.train_step(x, loss_grad) for _ in range(10)]
+        assert losses[-1] < losses[0] * 0.8, losses
+
+        # replicas applied the SAME averaged gradient: params bitwise equal
+        for idx in (0, 1):
+            slots = [st for st in job.stages if st.index == idx]
+            runners = [
+                next(w for w in workers if w.node_id == st.peer.node_id)
+                .stages[(jid, idx)]
+                for st in slots
+            ]
+            a = jax.tree.leaves(runners[0].params)
+            b = jax.tree.leaves(runners[1].params)
+            for la, lb in zip(a, b):
+                np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+            # grad inbox fully drained (advisor finding: timed-out
+            # entries used to accumulate unboundedly)
+            for w in workers:
+                assert not w._grad_inbox
+
+        # audit addresses the (stage, replica) slot, not workers[stage]
+        rec0 = await validator.audit_stage(jid, 1, in_shape=(4, 32), replica=0)
+        rec1 = await validator.audit_stage(jid, 1, in_shape=(4, 32), replica=1)
+        assert rec0["passed"] is True and rec1["passed"] is True
+        assert rec0["worker"] != rec1["worker"]
+    finally:
+        await _teardown(user, validator, *workers)
+
+
+@pytest.mark.asyncio
 async def test_heartbeat_drops_silent_peer():
     """Lease-style liveness: a peer that stops answering PINGs is dropped
     and on_peer_lost fires."""
