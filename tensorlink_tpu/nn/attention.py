@@ -72,6 +72,26 @@ def apply_rope(x: jax.Array, positions: jax.Array, theta: float = 10000.0) -> ja
     return out.astype(x.dtype)
 
 
+def resolve_attn_impl(impl) -> Callable:
+    """Map an ``attn_impl`` name to its kernel. Strings keep the choice
+    serializable through ``Module.config()`` spec-shipping:
+
+    - "reference": the jnp einsum implementation above;
+    - "flash" / "auto": the Pallas flash kernel with automatic fallback
+      to the reference path off-TPU or on unsupported shapes/masks.
+    """
+    if callable(impl):
+        return impl
+    if impl == "reference":
+        return dot_product_attention
+    if impl in ("flash", "auto"):
+        # lazy: ops.flash imports this module
+        from tensorlink_tpu.ops.flash import flash_attention_impl
+
+        return flash_attention_impl
+    raise ValueError(f"unknown attn_impl {impl!r}")
+
+
 class MultiHeadAttention(Module):
     def __init__(
         self,
@@ -83,7 +103,7 @@ class MultiHeadAttention(Module):
         rope: bool = False,
         rope_theta: float = 10000.0,
         causal: bool = False,
-        attn_impl: Callable = dot_product_attention,
+        attn_impl: str | Callable = "auto",
     ):
         super().__init__()
         self.dim = dim
@@ -94,7 +114,13 @@ class MultiHeadAttention(Module):
         self.rope = rope
         self.rope_theta = rope_theta
         self.causal = causal
-        self._attn = attn_impl
+        if isinstance(attn_impl, str):
+            # only a string impl is recorded for config()/spec-shipping; a
+            # callable can't cross the wire, so the attribute is omitted
+            # and a rebuilt module falls back to the "auto" default
+            # (review finding: storing None broke module_from_config)
+            self.attn_impl = attn_impl
+        self._attn = resolve_attn_impl(attn_impl)
         qdim = self.num_heads * self.head_dim
         kvdim = self.num_kv_heads * self.head_dim
         self.child("q", Dense(dim, qdim, use_bias=use_bias, shard="col"))

@@ -82,6 +82,7 @@ class TransformerBlock(Module):
         rope: bool = False,
         rope_theta: float = 10000.0,
         dropout: float = 0.0,
+        attn_impl: str = "auto",
         moe_experts: int = 0,
         moe_top_k: int = 2,
         moe_capacity_factor: float = 1.25,
@@ -103,6 +104,7 @@ class TransformerBlock(Module):
         self.rope = rope
         self.rope_theta = rope_theta
         self.dropout = dropout
+        self.attn_impl = attn_impl
         self.moe_experts = moe_experts
         self.moe_top_k = moe_top_k
         self.moe_capacity_factor = moe_capacity_factor
@@ -119,6 +121,7 @@ class TransformerBlock(Module):
                 causal=causal,
                 rope=rope,
                 rope_theta=rope_theta,
+                attn_impl=attn_impl,
             ),
         )
         if moe_experts:

@@ -45,7 +45,7 @@ def test_flash_entry_grad():
     q, k, v = _qkv(T=64)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, True, True) ** 2)
+        return jnp.sum(flash_attention(q, k, v, None, True, True) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
@@ -54,6 +54,74 @@ def test_flash_entry_grad():
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_flash_padding_mask(causal):
+    """kv_mask [B, Tk] (BERT attention_mask shape) on the kernel path."""
+    q, k, v = _qkv(B=2, T=128, H=2, D=32)
+    lengths = jnp.array([100, 57])
+    kv_mask = (jnp.arange(128)[None, :] < lengths[:, None])
+    ref = dot_product_attention(
+        q, k, v, causal=causal, mask=kv_mask[:, None, None, :]
+    )
+    out = flash_attention(q, k, v, kv_mask, causal, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_flash_backward_kernel_parity(causal):
+    """Blockwise Pallas backward (dq/dk/dv) vs reference vjp, with a
+    padding mask, multi-block seq (interpret mode)."""
+    q, k, v = _qkv(B=1, T=256, H=2, D=32)
+    lengths = jnp.array([200])
+    kv_mask = (jnp.arange(256)[None, :] < lengths[:, None])
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, kv_mask, causal, True) ** 2)
+
+    def loss_ref(q, k, v):
+        out = dot_product_attention(
+            q, k, v, causal=causal, mask=kv_mask[:, None, None, :]
+        )
+        return jnp.sum(out ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_pallas_flash_backward_long_seq():
+    """Grad parity at seq 1024 in interpret mode (VERDICT next #6)."""
+    q, k, v = _qkv(B=1, T=1024, H=1, D=64)
+
+    def loss_flash(q, k, v):
+        return jnp.mean(flash_attention(q, k, v, None, True, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.mean(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_fully_masked_rows():
+    """A batch row whose keys are ALL masked: forward 0, grads finite."""
+    q, k, v = _qkv(B=2, T=8, H=1, D=16)
+    kv_mask = jnp.stack([jnp.zeros(8, bool), jnp.ones(8, bool)])
+
+    out = flash_attention(q, k, v, kv_mask, False, True)
+    assert np.allclose(np.asarray(out[0]), 0.0)
+
+    g = jax.grad(
+        lambda q, k, v: jnp.sum(flash_attention(q, k, v, kv_mask, False, True) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a in g:
+        assert np.all(np.isfinite(np.asarray(a)))
 
 
 def test_flash_bad_blocks_raises():
@@ -111,6 +179,80 @@ def test_attn_impl_pluggable():
         np.asarray(m_ref.apply(p, x, mask=mask)),
         np.asarray(m_flash.apply(p, x, mask=mask)),
         atol=1e-5,
+    )
+
+
+def test_flash_impl_padding_mask_routes_to_kernel():
+    """A [B,1,1,Tk] padding mask (what Bert.apply builds from
+    attention_mask) is extracted to the kernel's kv_mask, not the
+    fallback — parity against the reference masked path."""
+    from tensorlink_tpu.ops.flash import _as_kv_mask, flash_attention_impl
+
+    q, k, v = _qkv(B=2, T=128, H=2, D=32)
+    pad = (jnp.arange(128)[None, :] < 77)
+    mask4 = pad[:, None, None, :] & jnp.ones((2, 1, 1, 1), bool)
+    kv, ok = _as_kv_mask(mask4, 2, 128)
+    assert ok and kv.shape == (2, 128)
+    out = flash_attention_impl(q, k, v, mask=mask4, interpret=True)
+    ref = dot_product_attention(q, k, v, mask=mask4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_impl_batch1_mask_broadcast():
+    """A broadcastable [1,1,1,Tk] mask under B>1 must produce a [B,Tk]
+    kv_mask (review finding: out-of-bounds batch block index)."""
+    from tensorlink_tpu.ops.flash import _as_kv_mask, flash_attention_impl
+
+    q, k, v = _qkv(B=2, T=128, H=2, D=32)
+    mask4 = (jnp.arange(128) < 77)[None, None, None, :]
+    assert mask4.shape == (1, 1, 1, 128)
+    kv, ok = _as_kv_mask(mask4, 2, 128)
+    assert ok and kv.shape == (2, 128)
+    out = flash_attention_impl(q, k, v, mask=mask4, interpret=True)
+    ref = dot_product_attention(q, k, v, mask=mask4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_flash_impl_gqa_repeat():
+    """GQA (Hkv < H) is read in-kernel via the BlockSpec index map (no
+    jnp.repeat materialization); dk/dv sum back over each group."""
+    from tensorlink_tpu.ops.flash import flash_attention_impl
+
+    B, T, H, Hkv, D = 1, 64, 4, 2, 16
+    ks = jax.random.split(KEY, 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, Hkv, D))
+    v = jax.random.normal(ks[2], (B, T, Hkv, D))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention_impl(q, k, v, causal=True, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    np.testing.assert_allclose(
+        float(loss_flash(q, k, v)), float(loss_ref(q, k, v)), rtol=1e-5
+    )
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_attn_impl_config_roundtrip():
+    """attn_impl string survives Module.config() spec-shipping."""
+    from tensorlink_tpu.nn.module import module_from_config
+    from tensorlink_tpu.nn.transformer import TransformerBlock
+
+    blk = TransformerBlock(32, 4, causal=True, attn_impl="flash")
+    cfg = blk.config()
+    rebuilt = module_from_config(cfg)
+    assert rebuilt.attn_impl == "flash"
+    assert rebuilt.children["attn"].attn_impl == "flash"
+    p = blk.init(KEY)
+    x = jax.random.normal(KEY, (2, 64, 32))
+    np.testing.assert_allclose(
+        np.asarray(blk.apply(p, x)), np.asarray(rebuilt.apply(p, x)), atol=1e-6
     )
 
 
