@@ -27,6 +27,8 @@ def _node_cfg(args, role: str):
         port=args.port,
         key_dir=args.key_dir,
         http_status_port=args.http_port,
+        stage_tp_devices=getattr(args, "stage_tp_devices", 1),
+        dht_snapshot_path=args.dht_snapshot,
     )
 
 
@@ -42,6 +44,9 @@ def _add_node_args(p: argparse.ArgumentParser) -> None:
                    help="persistent identity dir (ephemeral when omitted)")
     p.add_argument("--bootstrap", default=None, metavar="HOST:PORT",
                    help="validator to join via")
+    p.add_argument("--dht-snapshot", default=None, metavar="PATH",
+                   help="persist DHT state to PATH periodically (and "
+                        "restore from it on start)")
 
 
 async def _run_role(role: str, args) -> None:
@@ -56,10 +61,18 @@ async def _run_role(role: str, args) -> None:
         kw["registry"] = InMemoryRegistry()
     node = cls(_node_cfg(args, role), **kw)
     await node.start()
+    validator_peer = None
     if args.bootstrap:
         host, port = args.bootstrap.rsplit(":", 1)
-        await node.connect(host, int(port))
+        validator_peer = await node.connect(host, int(port))
     node.start_heartbeat()
+    if role == "user" and getattr(args, "resume_dir", None):
+        if validator_peer is None:
+            raise SystemExit("--resume-dir requires --bootstrap validator")
+        job = await node.resume_job_from_checkpoint(
+            args.resume_dir, validator_peer
+        )
+        print(f"resumed job {job.job.job_id[:16]} at step {job.step}")
     print(f"{role} {node.node_id[:16]} listening on {args.host}:{node.port}"
           + (f", status :{node._http.bound_port}" if node._http else ""))
     try:
@@ -154,6 +167,18 @@ def main(argv: list[str] | None = None) -> int:
     for role in ("worker", "validator", "user"):
         sp = sub.add_parser(role, help=f"run a {role} node")
         _add_node_args(sp)
+        if role == "worker":
+            sp.add_argument(
+                "--stage-tp-devices", type=int, default=1,
+                dest="stage_tp_devices",
+                help="TP width for loaded stages (-1 = all local devices)",
+            )
+        if role == "user":
+            sp.add_argument(
+                "--resume-dir", default=None,
+                help="resume a job from a durable checkpoint directory "
+                     "(requires --bootstrap validator)",
+            )
     sub.add_parser("info", help="local devices and capacity")
     sub.add_parser("demo", help="in-process end-to-end training demo")
     sub.add_parser("bench", help="run the repo benchmark (prints one JSON line)")

@@ -70,6 +70,7 @@ class TrainConfig:
     seed: int = 0
     dtype: str = "bfloat16"  # compute dtype; params stay f32
     remat: bool = False  # jax.checkpoint each stage/block
+    pp_schedule: str = "gpipe"  # gpipe | 1f1b (bounded-memory interleave)
 
     @property
     def micro_batch_size(self) -> int:
@@ -105,6 +106,14 @@ class NodeConfig:
     off_chain: bool = True  # in-memory Registry instead of web3
     key_dir: str | None = None  # None = ephemeral in-memory identity
     http_status_port: int | None = None  # aiohttp status endpoint
+    # TP width for loaded stages: 1 = single device, -1 = all local
+    # devices, N>1 = first N local devices (every chip a worker, SURVEY
+    # §7.2 — the stage is sharded by the module's own PartitionSpecs)
+    stage_tp_devices: int = 1
+    # periodic DHT persistence (reference: save_dht_state every 600 s,
+    # src/p2p/smart_node.py:701-728); None disables
+    dht_snapshot_path: str | None = None
+    dht_snapshot_interval_s: float = 600.0
 
 
 @dataclass(frozen=True)

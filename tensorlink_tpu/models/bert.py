@@ -114,7 +114,9 @@ def bert_pipeline_parts(model: "Bert", params: dict, num_classes_head=None):
     stack = bert.children["encoder"]
     block = stack.blocks()[0]
 
-    def embed_fn(emb_params, batch):
+    emb_drop = bert.children["emb_drop"]
+
+    def embed_fn(emb_params, batch, rng=None):
         ids = batch["input_ids"]
         T = ids.shape[1]
         pos = jnp.arange(T)[None, :]
@@ -125,19 +127,25 @@ def bert_pipeline_parts(model: "Bert", params: dict, num_classes_head=None):
             + bert.children["pos_emb"].apply(emb_params["pos_emb"], pos)
             + bert.children["type_emb"].apply(emb_params["type_emb"], tt)
         )
-        return bert.children["emb_norm"].apply(emb_params["emb_norm"], x)
+        x = bert.children["emb_norm"].apply(emb_params["emb_norm"], x)
+        return emb_drop.apply({}, x, rng=rng, train=rng is not None)
 
     if num_classes_head is not None:
-        def head_fn(all_params, x, batch):
+        from tensorlink_tpu.nn.layers import Dropout
+
+        cls_drop = Dropout(bert.cfg_obj.dropout)
+
+        def head_fn(all_params, x, batch, rng=None):
             pooled = jnp.tanh(
                 bert.children["pooler"].apply(all_params["head"]["pooler"], x[:, 0])
             )
+            pooled = cls_drop.apply({}, pooled, rng=rng, train=rng is not None)
             hw = all_params["head"]["cls"]
             return pooled @ hw["w"].astype(pooled.dtype) + hw["b"].astype(pooled.dtype)
 
         head_params = {"pooler": bp["pooler"], "cls": params["head"]}
     else:
-        def head_fn(all_params, x, batch):
+        def head_fn(all_params, x, batch, rng=None):
             return x  # last_hidden_state
 
         # no pooler in the optimized tree: head_fn never uses it, and
@@ -149,7 +157,9 @@ def bert_pipeline_parts(model: "Bert", params: dict, num_classes_head=None):
         embed_fn=embed_fn,
         block=block,
         block_params=bp["encoder"],
-        block_fn=lambda blk_p, x: block.apply(blk_p, x),
+        block_fn=lambda blk_p, x, rng=None: block.apply(
+            blk_p, x, rng=rng, train=rng is not None
+        ),
         head_fn=head_fn,
         embed_params={
             "tok_emb": bp["tok_emb"],

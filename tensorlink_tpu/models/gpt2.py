@@ -21,6 +21,7 @@ class GPT2Config:
     max_len: int = 1024
     dropout: float = 0.1
     layer_norm_eps: float = 1e-5
+    attn_impl: str = "auto"  # auto | flash | reference | ring (seq-parallel)
 
     @classmethod
     def small(cls) -> "GPT2Config":
@@ -59,6 +60,7 @@ class GPT2(Module):
                 use_bias=True,
                 causal=True,
                 dropout=cfg.dropout,
+                attn_impl=cfg.attn_impl,
             ),
         )
         self.child("ln_f", LayerNorm(cfg.dim, eps=cfg.layer_norm_eps))
@@ -114,15 +116,17 @@ class GPT2(Module):
         wte, wpe = self.children["wte"], self.children["wpe"]
         ln_f = self.children["ln_f"]
 
-        def embed_fn(emb_params, batch):
+        drop = self.children["drop"]
+
+        def embed_fn(emb_params, batch, rng=None):
             ids = batch["input_ids"]
             T = ids.shape[1]
             pos = jnp.arange(T)[None, :]
-            return wte.apply(emb_params["wte"], ids) + wpe.apply(
-                emb_params["wpe"], pos
-            ).astype(wte.apply(emb_params["wte"], ids).dtype)
+            tok = wte.apply(emb_params["wte"], ids)
+            x = tok + wpe.apply(emb_params["wpe"], pos).astype(tok.dtype)
+            return drop.apply({}, x, rng=rng, train=rng is not None)
 
-        def head_fn(all_params, x, batch):
+        def head_fn(all_params, x, batch, rng=None):
             h = ln_f.apply(all_params["head"]["ln_f"], x)
             return wte.attend(all_params["embed"]["wte"], h)
 
@@ -130,7 +134,9 @@ class GPT2(Module):
             embed_fn=embed_fn,
             block=block,
             block_params=params["blocks"],
-            block_fn=lambda bp, x: block.apply(bp, x),
+            block_fn=lambda bp, x, rng=None: block.apply(
+                bp, x, rng=rng, train=rng is not None
+            ),
             head_fn=head_fn,
             embed_params={"wte": params["wte"], "wpe": params["wpe"]},
             head_params={"ln_f": params["ln_f"]},

@@ -32,6 +32,7 @@ class LlamaConfig:
     max_len: int = 8192
     rope_theta: float = 500000.0
     rms_eps: float = 1e-5
+    attn_impl: str = "auto"  # auto | flash | reference | ring (seq-parallel)
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
@@ -79,6 +80,7 @@ class Llama(Module):
                 rope=True,
                 rope_theta=cfg.rope_theta,
                 dropout=0.0,
+                attn_impl=cfg.attn_impl,
             ),
         )
         self.child("norm_f", RMSNorm(cfg.dim, eps=cfg.rms_eps))
@@ -128,10 +130,10 @@ class Llama(Module):
         tok_emb = self.children["tok_emb"]
         norm_f, lm_head = self.children["norm_f"], self.children["lm_head"]
 
-        def embed_fn(emb_params, batch):
+        def embed_fn(emb_params, batch, rng=None):
             return tok_emb.apply(emb_params["tok_emb"], batch["input_ids"])
 
-        def head_fn(all_params, x, batch):
+        def head_fn(all_params, x, batch, rng=None):
             h = norm_f.apply(all_params["head"]["norm_f"], x)
             return lm_head.apply(all_params["head"]["lm_head"], h)
 
@@ -139,7 +141,9 @@ class Llama(Module):
             embed_fn=embed_fn,
             block=block,
             block_params=params["blocks"],
-            block_fn=lambda bp, x: block.apply(bp, x),
+            block_fn=lambda bp, x, rng=None: block.apply(
+                bp, x, rng=rng, train=rng is not None
+            ),
             head_fn=head_fn,
             embed_params={"tok_emb": params["tok_emb"]},
             head_params={"norm_f": params["norm_f"], "lm_head": params["lm_head"]},

@@ -166,7 +166,9 @@ def vit_pipeline_parts(model: ViT, params: dict, num_classes_head=None):
     stack = vit.children["encoder"]
     block = stack.blocks()[0]
 
-    def embed_fn(emb_params, batch):
+    emb_drop = vit.children["emb_drop"]
+
+    def embed_fn(emb_params, batch, rng=None):
         images = batch["images"]
         B = images.shape[0]
         x = vit.children["patch"].apply(emb_params["patch"], images)
@@ -174,10 +176,11 @@ def vit_pipeline_parts(model: ViT, params: dict, num_classes_head=None):
             emb_params["cls_token"].astype(x.dtype), (B, 1, x.shape[-1])
         )
         x = jnp.concatenate([cls, x], axis=1)
-        return x + emb_params["pos_emb"].astype(x.dtype)
+        x = x + emb_params["pos_emb"].astype(x.dtype)
+        return emb_drop.apply({}, x, rng=rng, train=rng is not None)
 
     if num_classes_head is not None:
-        def head_fn(all_params, x, batch):
+        def head_fn(all_params, x, batch, rng=None):
             h = vit.children["final_norm"].apply(
                 all_params["head"]["final_norm"], x
             )
@@ -186,7 +189,7 @@ def vit_pipeline_parts(model: ViT, params: dict, num_classes_head=None):
 
         head_params = {"final_norm": vp["final_norm"], "cls": params["head"]}
     else:
-        def head_fn(all_params, x, batch):
+        def head_fn(all_params, x, batch, rng=None):
             return vit.children["final_norm"].apply(
                 all_params["head"]["final_norm"], x
             )
@@ -197,7 +200,9 @@ def vit_pipeline_parts(model: ViT, params: dict, num_classes_head=None):
         embed_fn=embed_fn,
         block=block,
         block_params=vp["encoder"],
-        block_fn=lambda blk_p, x: block.apply(blk_p, x),
+        block_fn=lambda blk_p, x, rng=None: block.apply(
+            blk_p, x, rng=rng, train=rng is not None
+        ),
         head_fn=head_fn,
         embed_params={
             "patch": vp["patch"],

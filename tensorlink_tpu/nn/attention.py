@@ -89,6 +89,12 @@ def resolve_attn_impl(impl) -> Callable:
         from tensorlink_tpu.ops.flash import flash_attention_impl
 
         return flash_attention_impl
+    if impl == "ring":
+        # sequence-parallel ring attention; valid only inside a shard_map
+        # binding the ``seq`` axis (engine Pipeline with mesh seq>1)
+        from tensorlink_tpu.parallel.sp import ring_attention_impl
+
+        return ring_attention_impl
     raise ValueError(f"unknown attn_impl {impl!r}")
 
 
@@ -150,6 +156,10 @@ class MultiHeadAttention(Module):
                 positions = cache["index"] + jnp.arange(T)[None, :]
         elif positions is None:
             positions = jnp.arange(T)[None, :]
+            if getattr(self, "attn_impl", None) == "ring":
+                # under sequence sharding T is the LOCAL shard length;
+                # RoPE needs global token positions
+                positions = positions + jax.lax.axis_index("seq") * T
 
         if self.rope:
             q = apply_rope(q, positions, self.rope_theta)

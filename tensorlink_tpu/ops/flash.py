@@ -60,6 +60,24 @@ def _kernel_path(q, k, interpret) -> bool:
     return _use_pallas(interpret) and _tile_ok(q.shape[1]) and _tile_ok(k.shape[1])
 
 
+def _fallback_attn(q, k, v, kv_mask, causal):
+    """jnp reference path, matched to the kernel's convention: a row
+    whose keys are ALL masked outputs exact zeros (softmax of an
+    all(-1e30) row would otherwise return mean(v) — review finding)."""
+    mask = None if kv_mask is None else (kv_mask[:, None, None, :] > 0)
+    out = dot_product_attention(q, k, v, causal=causal, mask=mask)
+    if kv_mask is not None:
+        kvf = kv_mask > 0
+        if causal and q.shape[1] == k.shape[1]:
+            # under causal masking row i sees keys [0, i]: valid iff any
+            # of those survives the padding mask
+            row_valid = jnp.cumsum(kvf, axis=-1) > 0  # [B, Tq]
+        else:
+            row_valid = jnp.any(kvf, axis=-1, keepdims=True)  # [B, 1]
+        out = out * row_valid[..., None, None].astype(out.dtype)
+    return out
+
+
 def _fwd(q, k, v, kv_mask, causal, interpret):
     if _kernel_path(q, k, interpret):
         qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))  # [B,H,T,D]
@@ -69,8 +87,7 @@ def _fwd(q, k, v, kv_mask, causal, interpret):
             interpret=interpret,
         )
         return out.swapaxes(1, 2), (q, k, v, kv_mask, out, lse)
-    mask = None if kv_mask is None else (kv_mask[:, None, None, :] > 0)
-    out = dot_product_attention(q, k, v, causal=causal, mask=mask)
+    out = _fallback_attn(q, k, v, kv_mask, causal)
     return out, (q, k, v, kv_mask, None, None)
 
 
@@ -86,11 +103,8 @@ def _bwd(causal, interpret, res, g):
         )
         dq, dk, dv = (x.swapaxes(1, 2) for x in (dq, dk, dv))
     else:
-        mask = None if kv_mask is None else (kv_mask[:, None, None, :] > 0)
         _, vjp = jax.vjp(
-            lambda q_, k_, v_: dot_product_attention(
-                q_, k_, v_, causal=causal, mask=mask
-            ),
+            lambda q_, k_, v_: _fallback_attn(q_, k_, v_, kv_mask, causal),
             q, k, v,  # dot_product_attention repeats GQA heads itself and
             # its vjp sums dk/dv back over the group
         )

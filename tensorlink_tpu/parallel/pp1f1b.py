@@ -1,0 +1,332 @@
+"""1F1B pipeline schedule: SPMD shard_map + ppermute, bounded activation
+memory.
+
+The GPipe schedule (parallel/pp.py) is autodiff-transposed: all M forward
+micro-batches run, then all M backwards — every stage holds M micro
+activations live (or recomputes under remat). 1F1B interleaves: after a
+warmup of (S - s) forwards, stage s alternates one-backward/one-forward,
+so at most S - s activations are ever in flight (SURVEY §7.3 item 3,
+§2.3 PP row; the reference has no schedule at all — thread timing plus a
+0.5 s stagger, src/ml/distributed.py:88-112).
+
+Because the backward of micro i starts while micro i+1 is still going
+forward, the whole fwd+bwd interleave must be ONE loop — jax.grad cannot
+express it. The schedule is therefore hand-scheduled: a static
+(slot x stage) action table drives a lax.scan where each slot every stage
+executes at most one block compute — a forward, or a backward as a local
+jax.vjp (recompute-from-stashed-input, the same cost model as
+remat-GPipe) — then hands activations right / cotangents left with one
+ppermute pair per slot over ICI.
+
+The last stage folds head+loss into its backward vjp (cotangent of a
+scalar is 1.0), which is what lets backwards start immediately — and is
+also where tied weights (GPT-2's lm-head = wte) get their head-side
+gradient contribution, returned in ``aux`` grads.
+
+Slot count: 2M + 2(S-1) one-compute slots vs GPipe's 2(M + S - 1):
+identical bubble fraction (S-1)/(M+S-1) in time, S/M-th the activation
+memory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+IDLE, FWD, BWD = 0, 1, 2
+
+
+def simulate_1f1b(num_stages: int, num_micro: int):
+    """Greedy lockstep simulation of the 1F1B schedule.
+
+    Returns (act [T, S] in {IDLE, FWD, BWD}, mic [T, S] micro index).
+    One compute per stage per slot; transfers land at the end of the
+    producing slot, so a consumer can run no earlier than the next slot.
+    """
+    S, M = num_stages, num_micro
+    nf, nb = [0] * S, [0] * S
+    fdone = [[None] * M for _ in range(S)]
+    bdone = [[None] * M for _ in range(S)]
+    act_rows, mic_rows = [], []
+    t = 0
+    while any(nb[s] < M for s in range(S)):
+        acts, mics = [], []
+        for s in range(S):
+            a, m = IDLE, 0
+            can_f = nf[s] < M and (
+                s == 0 or (fdone[s - 1][nf[s]] is not None and fdone[s - 1][nf[s]] < t)
+            )
+            can_b = (
+                nb[s] < M
+                and nb[s] < nf[s]
+                and (
+                    s == S - 1
+                    or (bdone[s + 1][nb[s]] is not None and bdone[s + 1][nb[s]] < t)
+                )
+            )
+            inflight = nf[s] - nb[s]
+            cap = S - s  # 1F1B in-flight bound for stage s
+            if can_b and (inflight >= cap or nf[s] == M):
+                a, m = BWD, nb[s]
+            elif can_f and inflight < cap:
+                a, m = FWD, nf[s]
+            elif can_b:
+                a, m = BWD, nb[s]
+            # no forward-past-the-cap fallback: exceeding S - s in-flight
+            # would break the 1F1B memory bound, and idling cannot
+            # deadlock (backward availability depends only on activations
+            # already sent downstream)
+            acts.append(a)
+            mics.append(m)
+        for s in range(S):
+            if acts[s] == FWD:
+                fdone[s][mics[s]] = t
+                nf[s] += 1
+            elif acts[s] == BWD:
+                bdone[s][mics[s]] = t
+                nb[s] += 1
+        act_rows.append(acts)
+        mic_rows.append(mics)
+        t += 1
+        if t > 4 * (M + S) + 8:
+            raise RuntimeError(f"1F1B schedule deadlock at S={S} M={M}")
+    return np.asarray(act_rows, np.int32), np.asarray(mic_rows, np.int32)
+
+
+def max_inflight(act: np.ndarray, mic: np.ndarray, stage: int = 0) -> int:
+    """Peak number of stashed activations at ``stage`` (memory bound)."""
+    infl = peak = 0
+    for t in range(act.shape[0]):
+        if act[t, stage] == FWD:
+            infl += 1
+            peak = max(peak, infl)
+        elif act[t, stage] == BWD:
+            infl -= 1
+    return peak
+
+
+@dataclasses.dataclass(eq=False)  # identity hash: instances are jit-stable
+class Pipeline1F1B:
+    """1F1B over the mesh's ``pipe`` axis, producing gradients directly.
+
+    block_fn(layer_params, x) applies ONE layer; layers_per_stage of them
+    per stage from the stacked [S, Lps, ...] params.
+
+    head_loss(aux_params, y, micro_batch, rng) -> scalar loss for one
+    micro-batch; ``aux_params`` (head + anything tied, e.g. embeddings)
+    is replicated across the pipe axis and its gradient psum'd.
+
+    Loss-reduction restriction: the total is the UNWEIGHTED mean of the
+    per-micro losses, which equals the full-batch loss only when
+    head_loss is a per-example mean over equal-sized micro-batches. A
+    loss normalized by a per-BATCH quantity (e.g. non-pad token count
+    across the whole batch) will silently differ from the GPipe path —
+    normalize per example (or per micro) instead.
+    """
+
+    mesh: Mesh
+    block_fn: Callable[[Any, jax.Array], jax.Array]
+    num_stages: int
+    layers_per_stage: int
+    head_loss: Callable[[Any, jax.Array, Any], jax.Array]
+    axis: str = "pipe"
+
+    def _stage_apply(self, stage_params, x, rng=None, layer0=0):
+        # shared with the GPipe Pipeline so the (micro, global-layer) rng
+        # folding — and thus dropout-mask schedule-independence and the
+        # backward's mask recompute — cannot silently diverge
+        from tensorlink_tpu.parallel.pp import stage_apply
+
+        return stage_apply(
+            self.block_fn, self.layers_per_stage, stage_params, x, rng, layer0
+        )
+
+    # -- per-device program --------------------------------------------
+    def _shmap_fn(self, stacked_params, aux_params, xs, micro_batches, rng):
+        """stacked_params leaves [1, Lps, ...] (this stage); aux_params,
+        xs [M, mb, ...], micro_batches (leaves [M, ...]) replicated."""
+        S = self.num_stages
+        axis = self.axis
+        idx = jax.lax.axis_index(axis)
+        sp = jax.tree.map(lambda a: a[0], stacked_params)
+        M = xs.shape[0]
+        K = S + 1  # ring-buffer capacity > max in-flight (= S at stage 0)
+        layer0 = idx * self.layers_per_stage
+
+        def micro_rng(mic_i):
+            return None if rng is None else jax.random.fold_in(rng, mic_i)
+
+        def head_rng(mic_i):
+            # distinct stream from the block folds (mic-first there,
+            # sentinel-first here) so head dropout masks are uncorrelated
+            # across micro-batches (review finding)
+            if rng is None:
+                return None
+            return jax.random.fold_in(jax.random.fold_in(rng, 0x1F1B), mic_i)
+
+        act_np, mic_np = simulate_1f1b(S, M)
+        act_tbl = jnp.asarray(act_np)  # [T, S]
+        mic_tbl = jnp.asarray(mic_np)
+        T = act_np.shape[0]
+
+        zero_x = jnp.zeros_like(xs[0])
+        buf = jnp.zeros((K,) + xs.shape[1:], xs.dtype)
+        carry = dict(
+            inq=buf,  # activations awaiting forward (keyed micro % K)
+            stash=buf,  # forwarded inputs awaiting backward
+            cotq=buf,  # cotangents awaiting backward
+            send_f=zero_x,  # produced this slot, permuted at slot end
+            send_b=zero_x,
+            gsp=jax.tree.map(jnp.zeros_like, sp),
+            gaux=jax.tree.map(jnp.zeros_like, aux_params),
+            dxs=jnp.zeros_like(xs),
+            loss=jnp.zeros((), jnp.float32),
+        )
+
+        perm_f = [(i, i + 1) for i in range(S - 1)]
+        perm_b = [(i + 1, i) for i in range(S - 1)]
+
+        def fwd_op(c, mic_i):
+            x = jnp.where(idx == 0, xs[mic_i], c["inq"][mic_i % K])
+            y = self._stage_apply(sp, x, micro_rng(mic_i), layer0)
+            c = dict(c)
+            c["stash"] = jax.lax.dynamic_update_index_in_dim(
+                c["stash"], x, mic_i % K, 0
+            )
+            c["send_f"] = y
+            return c
+
+        def bwd_op(c, mic_i):
+            x = c["stash"][mic_i % K]
+            gy = c["cotq"][mic_i % K]
+            mb = jax.tree.map(lambda a: a[mic_i], micro_batches)
+
+            def last_fn():
+                # head+loss folded into the last stage's vjp: the
+                # cotangent of a scalar loss is 1.0, so backward can start
+                # the moment this micro's forward lands — the property
+                # that makes 1F1B possible at all
+                def fx(sp_, aux_, x_):
+                    y = self._stage_apply(sp_, x_, micro_rng(mic_i), layer0)
+                    return self.head_loss(
+                        aux_, y, mb, head_rng(mic_i)
+                    ).astype(jnp.float32)
+
+                loss, vjp = jax.vjp(fx, sp, aux_params, x)
+                gsp_i, gaux_i, gx = vjp(jnp.ones((), jnp.float32))
+                return loss, gsp_i, gaux_i, gx
+
+            def mid_fn():
+                y, vjp = jax.vjp(
+                    lambda sp_, x_: self._stage_apply(
+                        sp_, x_, micro_rng(mic_i), layer0
+                    ),
+                    sp,
+                    x,
+                )
+                gsp_i, gx = vjp(gy)
+                return (
+                    jnp.zeros((), jnp.float32),
+                    gsp_i,
+                    jax.tree.map(jnp.zeros_like, aux_params),
+                    gx,
+                )
+
+            loss_i, gsp_i, gaux_i, gx = jax.lax.cond(idx == S - 1, last_fn, mid_fn)
+            c = dict(c)
+            c["gsp"] = jax.tree.map(jnp.add, c["gsp"], gsp_i)
+            c["gaux"] = jax.tree.map(jnp.add, c["gaux"], gaux_i)
+            c["loss"] = c["loss"] + loss_i
+            c["send_b"] = gx
+            c["dxs"] = jnp.where(
+                idx == 0,
+                jax.lax.dynamic_update_index_in_dim(c["dxs"], gx, mic_i, 0),
+                c["dxs"],
+            )
+            return c
+
+        def idle_op(c, mic_i):
+            return dict(c)
+
+        def slot(c, t):
+            a = act_tbl[t, idx]
+            mic_i = mic_tbl[t, idx]
+            c = dict(c)
+            c["send_f"] = zero_x  # stale sends must not be re-delivered
+            c["send_b"] = zero_x
+            c = jax.lax.switch(a, [idle_op, fwd_op, bwd_op], c, mic_i)
+
+            if S > 1:
+                recv_f = jax.lax.ppermute(c["send_f"], axis, perm_f)
+                recv_b = jax.lax.ppermute(c["send_b"], axis, perm_b)
+                # left neighbor's slot-t action decides whether recv_f is
+                # a real activation, and for which micro
+                l_idx = jnp.maximum(idx - 1, 0)
+                l_act = act_tbl[t, l_idx]
+                l_mic = mic_tbl[t, l_idx]
+                take_f = jnp.logical_and(idx > 0, l_act == FWD)
+                pos_f = l_mic % K
+                new_in = jnp.where(take_f, recv_f, c["inq"][pos_f])
+                c["inq"] = jax.lax.dynamic_update_index_in_dim(
+                    c["inq"], new_in, pos_f, 0
+                )
+                r_idx = jnp.minimum(idx + 1, S - 1)
+                r_act = act_tbl[t, r_idx]
+                r_mic = mic_tbl[t, r_idx]
+                take_b = jnp.logical_and(idx < S - 1, r_act == BWD)
+                pos_b = r_mic % K
+                new_cot = jnp.where(take_b, recv_b, c["cotq"][pos_b])
+                c["cotq"] = jax.lax.dynamic_update_index_in_dim(
+                    c["cotq"], new_cot, pos_b, 0
+                )
+            return c, None
+
+        carry, _ = jax.lax.scan(slot, carry, jnp.arange(T))
+
+        # reductions: loss/aux/dxs live on one stage each — psum fills in.
+        # Each micro's vjp used cotangent 1.0, so accumulated grads are of
+        # the SUM of micro losses; the reported loss is the MEAN — scale
+        # everything by 1/M to match.
+        inv_m = 1.0 / M
+        loss = jax.lax.psum(carry["loss"], axis) * inv_m
+        gaux = jax.lax.psum(
+            jax.tree.map(lambda g: g * inv_m, carry["gaux"]), axis
+        )
+        dxs = jax.lax.psum(
+            jnp.where(idx == 0, carry["dxs"] * inv_m, jnp.zeros_like(carry["dxs"])),
+            axis,
+        )
+        gsp = jax.tree.map(lambda g: g[None] * inv_m, carry["gsp"])  # [1, Lps, ...]
+        return loss, gsp, gaux, dxs
+
+    # -- public ----------------------------------------------------------
+    def train_grads(self, stacked_params, aux_params, xs, micro_batches, rng=None):
+        """xs: [M, mb, ...] embedded activations; micro_batches: pytree
+        with leading [M, ...] leaves; ``rng`` enables dropout in blocks.
+        -> (mean loss, stage grads [S, Lps, ...], aux grads,
+        dxs [M, mb, ...])."""
+        param_specs = jax.tree.map(lambda _: P(self.axis), stacked_params)
+        extra = () if rng is None else (rng,)
+        fn = jax.shard_map(
+            lambda a, b, c, d, *r: self._shmap_fn(
+                a, b, c, d, r[0] if r else None
+            ),
+            mesh=self.mesh,
+            in_specs=(param_specs, P(), P(), P()) + tuple(P() for _ in extra),
+            out_specs=(P(), param_specs, P(), P()),
+            axis_names=frozenset({self.axis}),
+            check_vma=False,
+        )
+        return fn(stacked_params, aux_params, xs, micro_batches, *extra)
+
+    @property
+    def bubble_fraction(self) -> Callable[[int], float]:
+        # slots = 2M + 2(S-1); useful = 2M — same fraction as GPipe,
+        # with S/M-th the activation memory
+        S = self.num_stages
+        return lambda m: (S - 1) / (m + S - 1)

@@ -105,6 +105,26 @@ def ring_attention_local(
     return out.astype(q.dtype)
 
 
+def ring_attention_impl(q, k, v, *, causal=False, mask=None, q_offset=0, **_):
+    """Drop-in ``attn_impl`` for MultiHeadAttention ("ring"), to be used
+    INSIDE a shard_map that binds the ``seq`` axis (the engine's Pipeline
+    with seq>1). q,k,v are the LOCAL [B, T/seq, H, D] shards; attention
+    runs over the full sequence by rotating K/V around the ring.
+
+    Padding masks and KV caches are not expressible on the ring path —
+    long-context LM training (causal, unpadded) is the target workload.
+    """
+    if mask is not None:
+        raise NotImplementedError("ring attention does not support masks")
+    if not (isinstance(q_offset, int) and q_offset == 0):
+        raise NotImplementedError("ring attention does not support caches")
+    H, Hkv = q.shape[2], k.shape[2]
+    if Hkv != H:  # GQA: repeat (ring rotates whole K/V shards)
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
+    return ring_attention_local(q, k, v, axis="seq", causal=causal)
+
+
 def ring_attention(
     q: jax.Array,  # [B, T, H, D] global
     k: jax.Array,

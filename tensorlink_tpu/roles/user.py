@@ -105,6 +105,33 @@ class DistributedJob:
         # messages from older epochs, so a straggler from an aborted
         # attempt can never double-count into a retried step
         self._fence = 0
+        # durable checkpointing (attach_durable_checkpointing): the
+        # in-memory recovery cache survives a master+validator loss only
+        # if it also lands on disk (VERDICT weak #8)
+        self._ckpt = None
+
+    def attach_durable_checkpointing(self, directory: str) -> None:
+        """Persist the recovery cache (stage params + job record) to disk
+        via orbax on every periodic checkpoint_stages() refresh. Resume
+        with UserNode.resume_job_from_checkpoint(directory, ...)."""
+        from tensorlink_tpu.runtime.checkpoint import CheckpointManager
+
+        self._ckpt = CheckpointManager(directory, async_save=False)
+
+    def _persist_checkpoint(self) -> None:
+        state = {"stages": {str(i): p for i, p in self._stage_params.items()}}
+        if self.obfuscate_key is not None:
+            state["obfuscate_key"] = jax.random.key_data(self.obfuscate_key)
+        self._ckpt.save(
+            self.step,
+            jax.tree.map(np.asarray, state),
+            metadata={
+                "job": self.job.to_wire(),
+                "master_step": self.step,
+                "obfuscated": self.plan is not None,
+            },
+            force=True,
+        )
 
     @property
     def chains(self) -> list[list[RemoteStage]]:
@@ -433,22 +460,17 @@ class DistributedJob:
         params = self._stage_params.get(index)
         if params is None:
             raise RuntimeError(f"no cached params for stage {index}")
-        flat = await asyncio.to_thread(
-            lambda: pack_arrays(tree_flatten_arrays(jax.tree.map(np.asarray, params)))
-        )
-        ack = await self.user.request(
+        ack = await self.user.ship_spec(
             st.peer,
             {
-                "type": "MODULE_SPEC",
                 "job_id": self.job.job_id,
                 "stage": index,
                 "replica": st.replica,
                 "replicas": self._replica_placements(index),
                 "module_config": self.job.stages[index].module_config,
-                "weights": flat,
                 "train": self.job.train,
             },
-            timeout=60.0,
+            params,
         )
         if ack.get("type") != "LOADED":
             raise RuntimeError(f"stage {index} reload failed: {ack}")
@@ -462,6 +484,8 @@ class DistributedJob:
         parts = await self.fetch_params(deobfuscate=False)
         for st, p in zip(chain0, parts):
             self._stage_params[st.index] = p
+        if self._ckpt is not None:
+            await asyncio.to_thread(self._persist_checkpoint)
         return self._stage_params
 
     async def fetch_params(self, deobfuscate: bool = True) -> list[dict]:
@@ -474,18 +498,40 @@ class DistributedJob:
         rotation is orthogonal)."""
         out = []
         for st in self.chains[0]:
-            resp = await self.user.request(
-                st.peer,
-                {
-                    "type": "PARAMS_REQUEST",
-                    "job_id": self.job.job_id,
-                    "stage": st.index,
-                },
-                timeout=60.0,
-            )
             from tensorlink_tpu.p2p.serialization import tree_unflatten_arrays
 
-            p = tree_unflatten_arrays(unpack_arrays(resp["weights"]))
+            want_stream = (
+                self.job.stages[st.index].param_bytes > STREAM_THRESHOLD_BYTES
+            )
+            fut = None
+            if want_stream:
+                fut = asyncio.get_running_loop().create_future()
+                self.user._param_streams[(self.job.job_id, st.index)] = (
+                    st.peer.node_id,
+                    fut,
+                )
+            try:
+                resp = await self.user.request(
+                    st.peer,
+                    {
+                        "type": "PARAMS_REQUEST",
+                        "job_id": self.job.job_id,
+                        "stage": st.index,
+                        "stream": want_stream,
+                    },
+                    timeout=60.0,
+                )
+                if resp.get("streaming"):
+                    flat = await asyncio.wait_for(
+                        fut, self.user.STREAM_TIMEOUT_S
+                    )
+                else:
+                    flat = unpack_arrays(resp["weights"])
+            finally:
+                self.user._param_streams.pop(
+                    (self.job.job_id, st.index), None
+                )
+            p = tree_unflatten_arrays(flat)
             if deobfuscate and self.plan is not None:
                 p = self.plan.unfold_stage(
                     st.index, self.stage_modules[st.index], p
@@ -505,10 +551,127 @@ class DistributedJob:
         )
 
 
+# payloads above this ride the chunked stream path (bounded memory per
+# hop) instead of one message; tests shrink it to force streaming
+STREAM_THRESHOLD_BYTES = 32 << 20
+
+
 class UserNode(Node):
     def __init__(self, cfg: NodeConfig | None = None, **kw):
         cfg = cfg or NodeConfig(role="user")
         super().__init__(cfg, **kw)
+        # (job_id, stage) -> (expected worker node_id, future) for the
+        # "parameters" stream reply. The expected-peer check matters:
+        # job_id and stage are known to every placement participant, so
+        # without it any connected peer could inject forged weights into
+        # a pending fetch (review finding; the old request/response path
+        # was guarded by its unguessable correlation uuid).
+        self._param_streams: dict[tuple, tuple[str, asyncio.Future]] = {}
+        self.register_stream_kind("parameters", self._stream_parameters)
+        self.on("PARAMS_STREAM_FAILED", self._h_params_stream_failed)
+
+    async def _h_params_stream_failed(self, node, peer, msg) -> None:
+        """Worker-side stream failure: fail the waiting fetch immediately
+        instead of riding out the stream timeout."""
+        key = (str(msg.get("job_id")), int(msg.get("stage", -1)))
+        entry = self._param_streams.get(key)
+        if entry is None or entry[0] != peer.node_id:
+            peer.ghosts += 1
+            self._penalize(peer)
+            return None
+        self._param_streams.pop(key, None)
+        fut = entry[1]
+        if not fut.done():
+            fut.set_exception(
+                RuntimeError(f"params stream failed: {msg.get('error')}")
+            )
+        return None
+
+    async def _stream_parameters(self, peer, meta, manifest):
+        """Receives a worker's streamed PARAMETERS reply (flat leaves)."""
+        key = (str(meta["job_id"]), int(meta["stage"]))
+        entry = self._param_streams.get(key)
+        if entry is None or entry[0] != peer.node_id:
+            peer.ghosts += 1
+            self._penalize(peer)
+            return {"type": "ERROR", "error": "unsolicited parameters stream"}
+        leaves: dict[str, Any] = {}
+
+        def sink(name, arr):
+            leaves[name] = arr
+
+        async def finish():
+            e = self._param_streams.pop(key, None)
+            if e is not None and not e[1].done():
+                e[1].set_result(leaves)
+            return {"type": "OK"}
+
+        return sink, finish
+
+    async def ship_spec(self, peer: Peer, meta: dict, params) -> dict:
+        """MODULE_SPEC to one worker: single message below
+        STREAM_THRESHOLD_BYTES, chunked stream above (a Llama-8B stage is
+        ~16 GB of weights — VERDICT missing #3)."""
+        flat = await asyncio.to_thread(
+            lambda: tree_flatten_arrays(jax.tree.map(np.asarray, params))
+        )
+        total = sum(a.nbytes for a in flat.values())
+        if total > STREAM_THRESHOLD_BYTES:
+            return await self.send_stream(
+                peer, "module_spec", meta, flat, timeout=self.STREAM_TIMEOUT_S
+            )
+        blob = await asyncio.to_thread(pack_arrays, flat)
+        return await self.request(
+            peer, {**meta, "type": "MODULE_SPEC", "weights": blob}, timeout=60.0
+        )
+
+    async def _place_and_ship(
+        self, job: JobRecord, resp: dict, params_for_stage
+    ) -> list[RemoteStage]:
+        """Shared by request_job and resume_job_from_checkpoint (review
+        finding: the recruit/connect/ship block had drifted into two
+        copies): connect every placement in the ACCEPT_JOB response, ship
+        each stage's spec + weights (``params_for_stage(index)``) to all
+        of its replica slots concurrently, await LOADED acks."""
+        remote: list[RemoteStage] = []
+        for placement in resp["workers"]:
+            nid = placement["node_id"]
+            peer = self.peers.get(nid)
+            if peer is None:
+                peer = await self.connect(
+                    placement["host"], int(placement["port"])
+                )
+            remote.append(
+                RemoteStage(
+                    index=int(placement["stage"]), peer=peer, info=placement,
+                    replica=int(placement.get("replica", 0)),
+                )
+            )
+        remote.sort(key=lambda s: (s.replica, s.index))
+        by_stage: dict[int, list[dict]] = {}
+        for st in remote:
+            by_stage.setdefault(st.index, []).append(
+                dict(st.info, stage=st.index, replica=st.replica)
+            )
+
+        async def ship(st: RemoteStage) -> None:
+            ack = await self.ship_spec(
+                st.peer,
+                {
+                    "job_id": job.job_id,
+                    "stage": st.index,
+                    "replica": st.replica,
+                    "replicas": by_stage[st.index],
+                    "module_config": job.stages[st.index].module_config,
+                    "train": job.train,
+                },
+                params_for_stage(st.index),
+            )
+            if ack.get("type") != "LOADED":
+                raise RuntimeError(f"stage {st.index} failed to load: {ack}")
+
+        await asyncio.gather(*(ship(st) for st in remote))
+        return remote
 
     async def request_job(
         self,
@@ -593,25 +756,6 @@ class UserNode(Node):
         if resp.get("type") != "ACCEPT_JOB":
             raise RuntimeError(f"job declined: {resp.get('reason')}")
 
-        remote: list[RemoteStage] = []
-        for placement in resp["workers"]:
-            nid = placement["node_id"]
-            peer = self.peers.get(nid)
-            if peer is None:
-                peer = await self.connect(placement["host"], int(placement["port"]))
-            remote.append(
-                RemoteStage(
-                    index=int(placement["stage"]), peer=peer, info=placement,
-                    replica=int(placement.get("replica", 0)),
-                )
-            )
-        remote.sort(key=lambda s: (s.replica, s.index))
-        by_stage: dict[int, list[dict]] = {}
-        for st in remote:
-            by_stage.setdefault(st.index, []).append(
-                dict(st.info, stage=st.index, replica=st.replica)
-            )
-
         # ship specs + weights to EVERY slot concurrently — stage i's
         # params go to each of its dp_factor replicas (round 1 zipped
         # dp x n slots against n stage_parts: wrong params on most slots,
@@ -619,27 +763,9 @@ class UserNode(Node):
         # ack path, distributed.py:434-461/§2.9.3 — here the ack is the
         # typed response, and setup latency is the max transfer, not the
         # sum)
-        async def ship(st: RemoteStage) -> None:
-            p = stage_parts[st.index][1]
-            flat = tree_flatten_arrays(jax.tree.map(np.asarray, p))
-            ack = await self.request(
-                st.peer,
-                {
-                    "type": "MODULE_SPEC",
-                    "job_id": job.job_id,
-                    "stage": st.index,
-                    "replica": st.replica,
-                    "replicas": by_stage[st.index],
-                    "module_config": job.stages[st.index].module_config,
-                    "weights": pack_arrays(flat),
-                    "train": job.train,
-                },
-                timeout=60.0,
-            )
-            if ack.get("type") != "LOADED":
-                raise RuntimeError(f"stage {st.index} failed to load: {ack}")
-
-        await asyncio.gather(*(ship(st) for st in remote))
+        remote = await self._place_and_ship(
+            job, resp, lambda i: stage_parts[i][1]
+        )
         dj = DistributedJob(
             self, job, remote, validator=validator, plan=plan,
             stage_modules=[seq for seq, _ in stage_parts],
@@ -656,6 +782,82 @@ class UserNode(Node):
                 "job.obfuscate_key — without it the trained weights cannot "
                 "be mapped back to the true basis after a master restart"
             )
+        return dj
+
+    async def resume_job_from_checkpoint(
+        self,
+        directory: str,
+        validator: Peer,
+    ) -> DistributedJob:
+        """Resume a job from a durable checkpoint after losing BOTH the
+        master and the validator (reattach_job needs the validator's live
+        record; this path needs only the disk state written by
+        DistributedJob.attach_durable_checkpointing — VERDICT weak #8).
+
+        A NEW job record is minted (fresh author/id — surviving workers
+        hold the dead master's stages under the old owner and would
+        reject a stranger), recruitment runs again, and the checkpointed
+        stage params ship to the new placement; training resumes at the
+        checkpointed master step."""
+        from tensorlink_tpu.runtime.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(directory, async_save=False)
+        meta = mgr.metadata()
+        if meta is None:
+            raise FileNotFoundError(f"no checkpoint metadata under {directory}")
+        state = mgr.restore()
+        old = JobRecord.from_wire(meta["job"])
+        stage_params = {
+            int(i): p for i, p in state["stages"].items()
+        }
+        key = None
+        if state.get("obfuscate_key") is not None:
+            key = jax.random.wrap_key_data(jnp.asarray(state["obfuscate_key"]))
+
+        job = JobRecord(
+            author=self.node_id,
+            stages=old.stages,
+            dp_factor=old.dp_factor,
+            micro_batches=old.micro_batches,
+            train=old.train,
+            capacity_bytes=old.capacity_bytes,
+            seed_validators=[validator.node_id],
+        )
+        resp = await self.request(
+            validator, {"type": "JOB_REQ", "job": job.to_wire()}, timeout=30.0
+        )
+        if resp.get("type") != "ACCEPT_JOB":
+            raise RuntimeError(f"resume placement declined: {resp.get('reason')}")
+        remote = await self._place_and_ship(
+            job, resp, lambda i: stage_params[i]
+        )
+        from tensorlink_tpu.nn.module import module_from_config
+
+        stage_modules = [
+            module_from_config(s.module_config) for s in job.stages
+        ]
+        plan = None
+        if meta.get("obfuscated"):
+            if key is None:
+                raise RuntimeError(
+                    "checkpoint says the job was obfuscated but carries no "
+                    "rotation key"
+                )
+            from tensorlink_tpu.roles.privacy import ObfuscationPlan
+
+            # the plan is a deterministic function of key + module shapes
+            # (same rebuild as reattach_job); params stay in wire basis
+            plan = ObfuscationPlan.build(
+                key, [(seq, {}) for seq in stage_modules]
+            )
+        dj = DistributedJob(
+            self, job, remote, validator=validator, plan=plan,
+            stage_modules=stage_modules,
+        )
+        dj._stage_params = dict(stage_params)
+        dj.obfuscate_key = key
+        dj.step = int(meta.get("master_step", 0))
+        dj.attach_durable_checkpointing(directory)
         return dj
 
     async def reattach_job(

