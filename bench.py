@@ -45,7 +45,10 @@ from tensorlink_tpu.train.trainer import TrainState, softmax_cross_entropy
 BATCH = int(os.environ.get("BENCH_BATCH", 32))
 SEQ = int(os.environ.get("BENCH_SEQ", 128))
 CLASSES = 3
-STEPS_PER_CALL = int(os.environ.get("BENCH_STEPS_PER_CALL", 10))
+# 50 steps per device call: the tunneled dispatch costs ~10-20 ms per
+# call, which at 10 steps/call was ~25% of the measurement (r3: 1016
+# samples/s at 10 steps vs 1420 at 50 — same program, same chip)
+STEPS_PER_CALL = int(os.environ.get("BENCH_STEPS_PER_CALL", 50))
 MEASURE_CALLS = int(os.environ.get("BENCH_MEASURE_CALLS", 3))
 _BERT = os.environ.get("BENCH_BERT", "base")  # "base" | "tiny" (smoke only)
 # secondary long-seq measurement (batch 8, seq 512); disable with =0

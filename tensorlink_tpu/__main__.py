@@ -29,6 +29,11 @@ def _node_cfg(args, role: str):
         http_status_port=args.http_port,
         stage_tp_devices=getattr(args, "stage_tp_devices", 1),
         dht_snapshot_path=args.dht_snapshot,
+        upnp=args.upnp,
+        off_chain=not getattr(args, "chain_url", None),
+        chain_url=getattr(args, "chain_url", None),
+        chain_contract=getattr(args, "chain_contract", None),
+        chain_sender=getattr(args, "chain_sender", None),
     )
 
 
@@ -37,7 +42,11 @@ def _add_node_args(p: argparse.ArgumentParser) -> None:
     # exposing it network-wide must be an explicit operator choice
     p.add_argument("--host", default="127.0.0.1",
                    help="bind address (0.0.0.0 to serve the network)")
-    p.add_argument("--port", type=int, default=0, help="0 = OS-assigned")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 = OS-assigned; -1 = scan upward from base port")
+    p.add_argument("--upnp", action="store_true",
+                   help="map the listen port through the home router (UPnP "
+                        "IGD) for NAT'd peers")
     p.add_argument("--http-port", type=int, default=None,
                    help="HTTP status endpoint port (off when omitted)")
     p.add_argument("--key-dir", default=None,
@@ -57,8 +66,10 @@ async def _run_role(role: str, args) -> None:
 
     cls = {"worker": WorkerNode, "validator": ValidatorNode, "user": UserNode}[role]
     kw = {}
-    if role == "validator":
+    if role == "validator" and not getattr(args, "chain_url", None):
         kw["registry"] = InMemoryRegistry()
+    # chain-backed registry is built by ValidatorNode from cfg.chain_* when
+    # off_chain=False (set in _node_cfg from --chain-url/--chain-contract)
     node = cls(_node_cfg(args, role), **kw)
     await node.start()
     validator_peer = None
@@ -113,6 +124,13 @@ async def _cmd_demo() -> int:
     def cfg(role):
         return NodeConfig(role=role, host="127.0.0.1", port=0)
 
+    # warm up jax BEFORE wiring nodes: the first device compile can block
+    # this single shared event loop long enough to expire the accept-side
+    # handshake timer of an in-flight connection (all roles share one loop
+    # here; separate processes in production)
+    m = MLP(MLPConfig(in_dim=16, hidden_dim=32, out_dim=4, num_layers=2))
+    p = m.init(jax.random.key(0))
+
     reg = InMemoryRegistry()
     validator = ValidatorNode(cfg("validator"), registry=reg)
     await validator.start()
@@ -126,8 +144,6 @@ async def _cmd_demo() -> int:
     await user.start()
     v_peer = await user.connect("127.0.0.1", validator.port)
 
-    m = MLP(MLPConfig(in_dim=16, hidden_dim=32, out_dim=4, num_layers=2))
-    p = m.init(jax.random.key(0))
     job = await user.request_job(
         m.seq, p["seq"], v_peer, max_stage_bytes=16 * 32 * 4 + 200,
         micro_batches=2, train={"optimizer": "sgd", "learning_rate": 0.05},
@@ -167,6 +183,13 @@ def main(argv: list[str] | None = None) -> int:
     for role in ("worker", "validator", "user"):
         sp = sub.add_parser(role, help=f"run a {role} node")
         _add_node_args(sp)
+        if role == "validator":
+            sp.add_argument("--chain-url", default=None,
+                            help="EVM JSON-RPC endpoint (chain-backed registry)")
+            sp.add_argument("--chain-contract", default=None,
+                            help="registry contract address (0x...)")
+            sp.add_argument("--chain-sender", default=None,
+                            help="from-address for node-managed transactions")
         if role == "worker":
             sp.add_argument(
                 "--stage-tp-devices", type=int, default=1,
@@ -182,8 +205,24 @@ def main(argv: list[str] | None = None) -> int:
     sub.add_parser("info", help="local devices and capacity")
     sub.add_parser("demo", help="in-process end-to-end training demo")
     sub.add_parser("bench", help="run the repo benchmark (prints one JSON line)")
+    kp = sub.add_parser(
+        "keygen",
+        help="pre-generate per-role RSA identities (the reference does this "
+             "in a pip-install hook, config/custom_install.py:6-14; here it "
+             "is an explicit command since PEP 517 builds can't run code)",
+    )
+    kp.add_argument("--key-dir", required=True, help="directory for the keys")
+    kp.add_argument("--roles", default="worker,validator,user",
+                    help="comma-separated roles to generate keys for")
     args = ap.parse_args(argv)
 
+    if args.cmd == "keygen":
+        from tensorlink_tpu.p2p.crypto import Identity
+
+        for role in args.roles.split(","):
+            ident = Identity.load_or_generate(args.key_dir, role.strip())
+            print(f"{role.strip()}: {ident.node_id}")
+        return 0
     if args.cmd == "info":
         return _cmd_info()
     if args.cmd == "demo":

@@ -1,0 +1,132 @@
+"""In-process mock EVM provider for hermetic chain tests.
+
+The reference's only escape from its contract dependency is skipping it
+(`off_chain_test=True`, src/p2p/smart_node.py:110,165). This mock instead
+keeps the full RPC → calldata → ABI round-trip live: a threaded HTTP server
+speaks JSON-RPC, and a Python object executes the registry contract's
+semantics against the same selectors and codec `Web3Registry` emits. Tests
+exercise the identical byte path a real node would, minus the EVM itself.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tensorlink_tpu.chain import abi
+from tensorlink_tpu.chain.keccak import keccak256, selector
+
+CONTRACT_ADDRESS = "0x" + "42" * 20
+
+
+class MockRegistryContract:
+    """Python-executed equivalent of the registry contract (see
+    chain/registry.py module docstring for the Solidity interface)."""
+
+    def __init__(self):
+        self._validators: dict[str, dict] = {}  # nodeId -> record, insertion-ordered
+        self._clock = 1_700_000_000  # deterministic "block time"
+
+    def execute(self, calldata: bytes) -> bytes:
+        sel, args = calldata[:4], calldata[4:]
+        if sel == selector("validatorCount()"):
+            return abi.encode(["uint256"], [len(self._validators)])
+        if sel == selector("validatorAt(uint256)"):
+            [i] = abi.decode(["uint256"], args)
+            rec = list(self._validators.values())[i]
+            return abi.encode(
+                ["string", "string", "uint256", "uint256", "uint256"],
+                [rec["node_id"], rec["host"], rec["port"],
+                 rec["reputation_milli"], rec["registered_at"]],
+            )
+        if sel == selector("isValidator(string)"):
+            [node_id] = abi.decode(["string"], args)
+            return abi.encode(["bool"], [node_id in self._validators])
+        if sel == selector("registerValidator(string,string,uint256)"):
+            node_id, host, port = abi.decode(["string", "string", "uint256"], args)
+            self._clock += 1
+            self._validators[node_id] = {
+                "node_id": node_id, "host": host, "port": port,
+                "reputation_milli": 1000, "registered_at": self._clock,
+            }
+            return b""
+        if sel == selector("deregisterValidator(string)"):
+            [node_id] = abi.decode(["string"], args)
+            self._validators.pop(node_id, None)
+            return b""
+        if sel == selector("setReputation(string,uint256)"):
+            node_id, rep = abi.decode(["string", "uint256"], args)
+            if node_id in self._validators:
+                self._validators[node_id]["reputation_milli"] = rep
+            return b""
+        raise ValueError(f"unknown selector {sel.hex()}")
+
+
+class MockChainServer:
+    """Threaded JSON-RPC endpoint serving one MockRegistryContract."""
+
+    def __init__(self, contract: MockRegistryContract | None = None):
+        self.contract = contract or MockRegistryContract()
+        self.calls: list[str] = []  # method log, for assertions
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence
+                pass
+
+            def do_POST(self):
+                body = json.loads(self.rfile.read(int(self.headers["Content-Length"])))
+                result, error = None, None
+                try:
+                    result = outer._dispatch(body["method"], body.get("params", []))
+                except Exception as e:  # surfaces as a JSON-RPC error
+                    error = {"code": -32000, "message": str(e)}
+                reply = {"jsonrpc": "2.0", "id": body.get("id")}
+                reply["error" if error else "result"] = error if error else result
+                data = json.dumps(reply).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+
+    def _dispatch(self, method: str, params: list):
+        self.calls.append(method)
+        if method == "eth_chainId":
+            return hex(31337)
+        if method == "eth_call":
+            calldata = bytes.fromhex(params[0]["data"][2:])
+            if params[0]["to"].lower() != CONTRACT_ADDRESS:
+                raise ValueError("unknown contract")
+            return "0x" + self.contract.execute(calldata).hex()
+        if method == "eth_sendTransaction":
+            tx = params[0]
+            calldata = bytes.fromhex(tx["data"][2:])
+            self.contract.execute(calldata)
+            return "0x" + keccak256(calldata).hex()
+        if method == "eth_getTransactionReceipt":
+            return {"status": "0x1", "transactionHash": params[0]}
+        raise ValueError(f"unsupported method {method}")
+
+    # ----------------------------------------------------------- lifecycle
+    @property
+    def url(self) -> str:
+        return f"http://127.0.0.1:{self._server.server_address[1]}"
+
+    def start(self) -> "MockChainServer":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

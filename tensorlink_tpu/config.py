@@ -96,6 +96,7 @@ class NodeConfig:
     base_port: int = 38751
     max_connections: int = 64
     handshake_timeout_s: float = 10.0
+    connect_timeout_s: float = 5.0  # per-candidate dial bound (alt_hosts)
     request_timeout_s: float = 5.0
     dht_replication: int = 3
     dht_buckets: int = 256
@@ -104,6 +105,12 @@ class NodeConfig:
     compression: str = "zstd"  # none | zlib | zstd
     compression_min_bytes: int = 4096
     off_chain: bool = True  # in-memory Registry instead of web3
+    # chain binding when off_chain=False (reference reads CONTRACT/CHAIN_URL
+    # from .env at import time, src/p2p/smart_node.py:20-30; here they are
+    # explicit typed config, no import-time side effects)
+    chain_url: str | None = None  # EVM JSON-RPC endpoint
+    chain_contract: str | None = None  # registry contract address
+    chain_sender: str | None = None  # from-address for node-managed txs
     key_dir: str | None = None  # None = ephemeral in-memory identity
     http_status_port: int | None = None  # aiohttp status endpoint
     # TP width for loaded stages: 1 = single device, -1 = all local
@@ -114,6 +121,21 @@ class NodeConfig:
     # src/p2p/smart_node.py:701-728); None disables
     dht_snapshot_path: str | None = None
     dht_snapshot_interval_s: float = 600.0
+    # NAT traversal (reference: miniupnpc IGD mapping + upward port scan,
+    # src/p2p/smart_node.py:787-816,949-967). Off by default: cluster and
+    # public-IP nodes need no mapping; port=-1 requests the base_port scan.
+    upnp: bool = False
+    upnp_lease_s: int = 0  # 0 = indefinite mapping
+    upnp_timeout_s: float = 3.0
+    upnp_ssdp_addr: tuple = ("239.255.255.250", 1900)  # overridable in tests
+    # cadence of the validator's cached-registry refresh (serves the
+    # non-blocking is_validator_local gate on the event loop)
+    registry_refresh_s: float = 30.0
+
+    def __post_init__(self):
+        # wire serialization (msgpack/json) round-trips tuples as lists;
+        # normalize so config equality survives to_dict/from_dict
+        object.__setattr__(self, "upnp_ssdp_addr", tuple(self.upnp_ssdp_addr))
 
 
 @dataclass(frozen=True)

@@ -88,6 +88,13 @@ def resolve_attn_impl(impl) -> Callable:
         # lazy: ops.flash imports this module
         from tensorlink_tpu.ops.flash import flash_attention_impl
 
+        if impl == "flash":
+            # explicit choice forces the kernel on every eligible shape;
+            # "auto" keeps the measured short-seq einsum win (ops/flash.py
+            # MIN_KERNEL_SEQ_AUTO)
+            import functools
+
+            return functools.partial(flash_attention_impl, min_kernel_seq=0)
         return flash_attention_impl
     if impl == "ring":
         # sequence-parallel ring attention; valid only inside a shard_map

@@ -31,14 +31,22 @@ class PeerInfo:
     host: str
     port: int
     last_seen: float = field(default_factory=time.time)
+    # fallback candidate addresses (poor-man's ICE): a NAT'd node advertises
+    # its UPnP external IP as `host` but hairpin NAT often fails for peers on
+    # the same LAN — alt_hosts carries the bind/observed addresses so a
+    # connector can try each in order
+    alt_hosts: list = field(default_factory=list)
 
     def to_wire(self) -> dict:
-        return {
+        d = {
             "node_id": self.node_id,
             "role": self.role,
             "host": self.host,
             "port": self.port,
         }
+        if self.alt_hosts:
+            d["alt_hosts"] = list(self.alt_hosts)
+        return d
 
     @classmethod
     def from_wire(cls, d: dict) -> "PeerInfo":
@@ -47,6 +55,7 @@ class PeerInfo:
             role=str(d["role"]),
             host=str(d["host"]),
             port=int(d["port"]),
+            alt_hosts=[str(h) for h in d.get("alt_hosts", [])],
         )
 
 

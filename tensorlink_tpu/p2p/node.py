@@ -15,7 +15,7 @@ import asyncio
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Any, Awaitable, Callable
+from typing import Any, Awaitable, Callable, Sequence
 
 from tensorlink_tpu.config import NodeConfig
 from tensorlink_tpu.p2p.connection import FramedStream
@@ -71,6 +71,9 @@ class Node:
         self._server: asyncio.AbstractServer | None = None
         self._tasks: set[asyncio.Task] = set()
         self.port: int | None = None
+        self.external_ip: str | None = None  # set by UPnP mapping
+        self._lan_ip: str | None = None  # routable local addr (UPnP/detected)
+        self._upnp_gateway = None
         self.started = asyncio.Event()
         self._stopping = False
         self._http = None
@@ -81,10 +84,39 @@ class Node:
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> None:
+        port = self.cfg.port
+        if port < 0:
+            # upward scan from base_port (reference smart_node.py:949-967);
+            # port=0 stays OS-assigned, the cleaner default
+            from tensorlink_tpu.p2p.nat import scan_bind_port
+
+            port = await asyncio.to_thread(
+                scan_bind_port, self.cfg.host, self.cfg.base_port
+            )
         self._server = await asyncio.start_server(
-            self._accept, self.cfg.host, self.cfg.port
+            self._accept, self.cfg.host, port
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        if self.cfg.host == "0.0.0.0" and self._lan_ip is None:
+            # wildcard bind: discover the routable source address so info
+            # never advertises 0.0.0.0 (reference's UDP trick,
+            # smart_node.py:120-123); no packet is actually sent
+            try:
+                from tensorlink_tpu.p2p.nat import _local_ip_toward
+
+                self._lan_ip = await asyncio.to_thread(
+                    _local_ip_toward, "8.8.8.8"
+                )
+            except OSError:
+                pass
+            if self._lan_ip is None:
+                self.log.warning(
+                    "bound 0.0.0.0 but could not detect a routable local "
+                    "address — this node will advertise 0.0.0.0, which "
+                    "remote peers cannot dial; set --host to the LAN address"
+                )
+        if self.cfg.upnp:
+            await self._init_upnp()
         if self.cfg.http_status_port is not None:
             from tensorlink_tpu.runtime.http_status import StatusServer
 
@@ -98,6 +130,52 @@ class Node:
             self._spawn(self._dht_snapshot_loop())
         self.started.set()
         self.log.info("listening on %s:%s", self.cfg.host, self.port)
+
+    # ------------------------------------------------------ NAT traversal
+    # (reference: miniupnpc IGD mapping at node start, smart_node.py:787-816)
+    async def _init_upnp(self) -> None:
+        from tensorlink_tpu.p2p.nat import UpnpError, UpnpGateway
+
+        try:
+            gw = await asyncio.to_thread(
+                UpnpGateway.discover, self.cfg.upnp_timeout_s,
+                self.cfg.upnp_ssdp_addr,
+            )
+            await asyncio.to_thread(
+                gw.add_port_mapping, self.port, self.port,
+                "TCP", f"tensorlink-tpu {self.role} {self.node_id[:8]}",
+                self.cfg.upnp_lease_s,
+            )
+            # the mapping exists NOW: remember the gateway immediately so a
+            # failure below still unmaps on stop (indefinite leases would
+            # otherwise outlive the node on the router)
+            self._upnp_gateway = gw
+            self.external_ip = await asyncio.to_thread(gw.external_ip)
+            self._lan_ip = gw.local_ip  # the address the router forwards to
+            if self.cfg.host.startswith("127.") or self.cfg.host == "localhost":
+                self.log.warning(
+                    "UPnP mapping forwards to %s but this node binds only "
+                    "%s — forwarded traffic will be refused; bind 0.0.0.0 "
+                    "or the LAN address", gw.local_ip, self.cfg.host,
+                )
+            self.log.info(
+                "UPnP mapped %s:%s -> %s:%s",
+                self.external_ip, self.port, gw.local_ip, self.port,
+            )
+        except Exception as e:  # noqa: BLE001 — best-effort by contract:
+            # a node on a cluster or public IP needs no mapping, and a
+            # malformed/hostile LAN responder must not kill node start
+            self.log.warning("UPnP unavailable (%s); continuing unmapped", e)
+
+    async def _teardown_upnp(self) -> None:
+        gw = getattr(self, "_upnp_gateway", None)
+        if gw is None:
+            return
+        self._upnp_gateway = None
+        try:
+            await asyncio.to_thread(gw.delete_port_mapping, self.port, "TCP")
+        except Exception as e:  # noqa: BLE001
+            self.log.warning("UPnP unmap failed: %s", e)
 
     # --------------------------------------------------- DHT persistence
     # (reference: save_dht_state every 600 s, smart_node.py:701-728 — the
@@ -136,6 +214,7 @@ class Node:
 
     async def stop(self) -> None:
         self._stopping = True
+        await self._teardown_upnp()
         if self.cfg.dht_snapshot_path:
             try:
                 self.save_dht_snapshot()  # final flush on clean shutdown
@@ -167,20 +246,94 @@ class Node:
 
     @property
     def info(self) -> PeerInfo:
+        # a NAT'd node advertises its UPnP-mapped external address — the
+        # private bind address is unroutable for remote peers — but keeps
+        # its routable LAN address (the one the router forwards to) as a
+        # fallback candidate: hairpin NAT routinely fails for peers inside
+        # the same LAN. The wildcard bind 0.0.0.0 is never advertised — a
+        # peer dialing it would reach its own loopback.
+        routable = [
+            h for h in (self._lan_ip, self.cfg.host)
+            if h and h != "0.0.0.0"
+        ]
+        if self.external_ip:
+            # loopback is meaningless beyond this machine — gossiping it
+            # network-wide makes remote peers dial THEMSELVES; same-host
+            # peers still reach us via the validator's observed-address
+            # candidate
+            host = self.external_ip
+            alts = [h for h in routable
+                    if not (h.startswith("127.") or h == "localhost")]
+        else:
+            host = routable[0] if routable else self.cfg.host
+            alts = routable[1:]
+        seen = {host}
         return PeerInfo(
             node_id=self.node_id,
             role=self.role,
-            host=self.cfg.host,
+            host=host,
             port=self.port or 0,
+            alt_hosts=[h for h in alts if not (h in seen or seen.add(h))],
         )
 
+    async def connect_candidates(
+        self,
+        host: str,
+        port: int,
+        alt_hosts: Sequence[str] = (),
+        expect_id: str | None = None,
+    ) -> Peer:
+        """Dial candidate addresses in order until a handshake succeeds.
+        The dial (not the handshake) is bounded by connect_timeout_s inside
+        connect(). With expect_id, a candidate that handshakes as a
+        DIFFERENT node is treated as a failed candidate — behind shared
+        NATs the same (ip, port) can route to an unrelated peer, and the
+        mutual-auth handshake only proves the peer owns *some* key, not
+        the one the placement names. Raises the LAST error when every
+        candidate fails."""
+        last: Exception | None = None
+        for h in [host, *alt_hosts]:
+            try:
+                peer = await self.connect(h, port, expect_id=expect_id)
+            except (OSError, ConnectionError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError) as e:
+                self.log.debug("candidate %s:%s failed: %s", h, port, e)
+                last = e
+                continue
+            return peer
+        raise ConnectionError(
+            f"all candidates failed for :{port} ({[host, *alt_hosts]})"
+        ) from last
+
     # ------------------------------------------------------------ handshake
-    async def connect(self, host: str, port: int) -> Peer:
-        """Dial + mutual signature handshake (initiator)."""
-        reader, writer = await asyncio.open_connection(host, port)
+    async def connect(
+        self, host: str, port: int, expect_id: str | None = None
+    ) -> Peer:
+        """Dial + mutual signature handshake (initiator). The TCP dial is
+        bounded by connect_timeout_s; the handshake keeps its own (longer)
+        handshake_timeout_s — a slow peer is not a failed dial. With
+        expect_id, an identity mismatch aborts BEFORE peer registration —
+        checking afterwards would let a mis-routed dial displace a healthy
+        existing connection to the mis-identified node (_register_peer
+        closes the old stream), failing its in-flight requests."""
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection(host, port), self.cfg.connect_timeout_s
+        )
         stream = FramedStream(
             reader, writer, self.cfg.compression, self.cfg.compression_min_bytes
         )
+        try:
+            return await self._connect_handshake(stream, host, port, expect_id)
+        except BaseException:
+            # cancellation (connect_candidates timeout) or any recv error
+            # must not leak the transport — retry loops would accumulate fds
+            stream.close()
+            raise
+
+    async def _connect_handshake(
+        self, stream: FramedStream, host: str, port: int,
+        expect_id: str | None = None,
+    ) -> Peer:
         nonce_a = new_nonce()
         await stream.send(
             encode_message(
@@ -204,6 +357,12 @@ class Node:
         if not Identity.verify(their_pub, ack["sig"], nonce_a + ack["nonce"]):
             stream.close()
             raise ConnectionError("peer failed signature challenge")
+        their_id = Identity.node_id_for(their_pub)
+        if expect_id is not None and their_id != expect_id:
+            raise ConnectionError(
+                f"{host}:{port} handshook as {their_id[:8]}, "
+                f"expected {expect_id[:8]}"
+            )
         await stream.send(
             encode_message(
                 {"type": "HELLO_FIN", "sig": self.identity.sign(ack["nonce"] + nonce_a)}
@@ -211,7 +370,7 @@ class Node:
         )
         stream.integrity = "crc" in ack.get("caps", [])
         info = PeerInfo(
-            node_id=Identity.node_id_for(their_pub),
+            node_id=their_id,
             role=str(ack["role"]),
             host=host,
             port=int(ack["listen_port"]) or port,

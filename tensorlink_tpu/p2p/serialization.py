@@ -22,13 +22,33 @@ import numpy as np
 
 try:
     import zstandard as _zstd
-
-    _ZC = _zstd.ZstdCompressor(level=3)
-    _ZD = _zstd.ZstdDecompressor()
 except ImportError:  # pragma: no cover
-    _ZC = _ZD = None
+    _zstd = None
 
+import threading
 import zlib
+
+# Zstd (de)compression contexts hold internal streaming state and are NOT
+# safe for concurrent use — two in-flight sends (e.g. chunked-stream frames
+# compressed via asyncio.to_thread while the event loop sends a control
+# message) raced on a shared module-level context and failed with
+# "Operation not authorized at current processing stage". One context per
+# thread: contexts are cheap and reused within each thread.
+_TLS = threading.local()
+
+
+def _zc():
+    c = getattr(_TLS, "zc", None)
+    if c is None:
+        c = _TLS.zc = _zstd.ZstdCompressor(level=3)
+    return c
+
+
+def _zd():
+    d = getattr(_TLS, "zd", None)
+    if d is None:
+        d = _TLS.zd = _zstd.ZstdDecompressor()
+    return d
 
 MAGIC = b"TLT1"
 
@@ -54,16 +74,16 @@ def decode_message(data: bytes) -> dict[str, Any]:
 
 
 def _compress(data: bytes, codec: str) -> bytes:
-    if codec == "zstd" and _ZC is not None:
-        return _ZC.compress(data)
+    if codec == "zstd" and _zstd is not None:
+        return _zc().compress(data)
     if codec == "zlib":
         return zlib.compress(data, 6)
     return data
 
 
 def _decompress(data: bytes, codec: str) -> bytes:
-    if codec == "zstd" and _ZD is not None:
-        return _ZD.decompress(data)
+    if codec == "zstd" and _zstd is not None:
+        return _zd().decompress(data)
     if codec == "zlib":
         return zlib.decompress(data)
     return data
@@ -82,7 +102,7 @@ def pack_arrays(
     """
     from tensorlink_tpu.native import gather
 
-    if codec == "zstd" and _ZC is None:
+    if codec == "zstd" and _zstd is None:
         codec = "zlib"
     manifest: dict[str, Any] = {"codec": codec, "tensors": {}}
     views: list[np.ndarray] = []

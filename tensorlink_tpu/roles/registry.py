@@ -38,6 +38,18 @@ class Registry(abc.ABC):
     @abc.abstractmethod
     def is_validator(self, node_id: str) -> bool: ...
 
+    def is_validator_local(self, node_id: str) -> bool:
+        """Non-blocking variant for event-loop call sites (the DHT store
+        gate runs inline in the message handler). Chain-backed registries
+        override this to consult only their cached view — possibly stale,
+        never an RPC. Default: same as is_validator, which is already
+        memory-only for in-process registries."""
+        return self.is_validator(node_id)
+
+    def refresh(self) -> None:
+        """Re-fetch any cached view. Blocking I/O allowed — callers on the
+        event loop wrap this in asyncio.to_thread. Default: no-op."""
+
     def sample_validators(self, k: int = 6) -> list[ValidatorEntry]:
         """Bootstrap sampling (reference: <=6 random contract validators,
         smart_node.py:539-585)."""

@@ -428,7 +428,11 @@ class DistributedJob:
         placement = resp["worker"]
         peer = self.user.peers.get(placement["node_id"])
         if peer is None:
-            peer = await self.user.connect(placement["host"], int(placement["port"]))
+            peer = await self.user.connect_candidates(
+                placement["host"], int(placement["port"]),
+                placement.get("alt_hosts", ()),
+                expect_id=placement["node_id"],
+            )
         st = RemoteStage(
             index=index, peer=peer, info=placement,
             replica=int(placement.get("replica", replica)),
@@ -638,8 +642,10 @@ class UserNode(Node):
             nid = placement["node_id"]
             peer = self.peers.get(nid)
             if peer is None:
-                peer = await self.connect(
-                    placement["host"], int(placement["port"])
+                peer = await self.connect_candidates(
+                    placement["host"], int(placement["port"]),
+                    placement.get("alt_hosts", ()),
+                    expect_id=nid,
                 )
             remote.append(
                 RemoteStage(
@@ -750,9 +756,21 @@ class UserNode(Node):
             capacity_bytes=sum(s.param_bytes for s in specs),
             seed_validators=[validator.node_id],
         )
-        resp = await self.request(
-            validator, {"type": "JOB_REQ", "job": job.to_wire()}, timeout=30.0
-        )
+        job_msg = {"type": "JOB_REQ", "job": job.to_wire()}
+        try:
+            resp = await self.request(validator, job_msg, timeout=30.0)
+        except ConnectionError:
+            # the validator connection can die between connect and JOB_REQ
+            # (e.g. our own process blocked the loop through the accept-side
+            # handshake window, or a transient network drop). The reference
+            # re-sends JOB-REQ after a timeout (user.py:309-314); here we
+            # redial the same validator once and retry.
+            self.log.warning("validator connection lost; redialing for JOB_REQ")
+            validator = await self.connect_candidates(
+                validator.info.host, validator.info.port,
+                validator.info.alt_hosts, expect_id=validator.node_id,
+            )
+            resp = await self.request(validator, job_msg, timeout=30.0)
         if resp.get("type") != "ACCEPT_JOB":
             raise RuntimeError(f"job declined: {resp.get('reason')}")
 
@@ -898,8 +916,10 @@ class UserNode(Node):
         for placement in job.workers:
             peer = self.peers.get(placement["node_id"])
             if peer is None:
-                peer = await self.connect(
-                    placement["host"], int(placement["port"])
+                peer = await self.connect_candidates(
+                    placement["host"], int(placement["port"]),
+                    placement.get("alt_hosts", ()),
+                    expect_id=placement["node_id"],
                 )
             remote.append(
                 RemoteStage(index=int(placement["stage"]), peer=peer,

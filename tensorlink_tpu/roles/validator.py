@@ -30,6 +30,18 @@ class ValidatorNode(Node):
     ):
         cfg = cfg or NodeConfig(role="validator")
         super().__init__(cfg, **kw)
+        if registry is None and not cfg.off_chain:
+            # chain-backed deployment configured entirely through NodeConfig
+            # (reference: .env CONTRACT/CHAIN_URL, smart_node.py:20-30)
+            if not (cfg.chain_url and cfg.chain_contract):
+                raise ValueError(
+                    "off_chain=False requires chain_url and chain_contract"
+                )
+            from tensorlink_tpu.chain import Web3Registry
+
+            registry = Web3Registry(
+                cfg.chain_url, cfg.chain_contract, sender=cfg.chain_sender
+            )
         self.registry = registry
         self.jobs: dict[str, JobRecord] = {}
         self.job_state: dict[str, dict] = {}  # job_id -> {loss, accuracy,...}
@@ -37,7 +49,20 @@ class ValidatorNode(Node):
     async def start(self) -> None:
         await super().start()
         if self.registry is not None:
-            self.registry.register_validator(self.info)
+            # registry I/O may be chain RPC — never on the event loop
+            await asyncio.to_thread(self.registry.register_validator, self.info)
+            await asyncio.to_thread(self.registry.refresh)
+            self._spawn(self._registry_refresh_loop())
+
+    async def _registry_refresh_loop(self) -> None:
+        """Keeps the cached validator view fresh so the DHT store gate
+        (is_validator_local) can answer without blocking the loop."""
+        while not self._stopping:
+            await asyncio.sleep(self.cfg.registry_refresh_s)
+            try:
+                await asyncio.to_thread(self.registry.refresh)
+            except Exception as e:  # noqa: BLE001
+                self.log.warning("registry refresh failed: %s", e)
 
     # ---------------------------------------------------------- handlers
     def register_handlers(self) -> None:
@@ -62,7 +87,9 @@ class ValidatorNode(Node):
             return False
         if key.startswith("job:"):
             if self.registry is not None:
-                return self.registry.is_validator(peer.node_id)
+                # this gate runs inline in the message handler: cache-only
+                # check, refreshed by _registry_refresh_loop
+                return self.registry.is_validator_local(peer.node_id)
             return peer.role == "validator"  # off-chain dev mode only
         return True
 
@@ -125,7 +152,17 @@ class ValidatorNode(Node):
                 continue
             if resp.get("type") == "ACCEPT_JOB":
                 taken.add(nid)
-                return dict(resp["info"], stage=stage_index, replica=replica)
+                placement = dict(resp["info"], stage=stage_index, replica=replica)
+                # append the address this validator actually reaches the
+                # worker at (observed peername) as a dial candidate — for
+                # a NAT'd worker the advertised external IP may not
+                # hairpin for same-LAN peers
+                dial_candidates = [
+                    placement["host"], *placement.get("alt_hosts", [])
+                ]
+                if peer.info.host not in dial_candidates:
+                    placement.setdefault("alt_hosts", []).append(peer.info.host)
+                return placement
         return None
 
     async def _h_job_req(self, node, peer, msg) -> dict:
@@ -295,7 +332,11 @@ class ValidatorNode(Node):
         wid = placement["node_id"]
         peer = self.peers.get(wid)
         if peer is None:
-            peer = await self.connect(placement["host"], int(placement["port"]))
+            peer = await self.connect_candidates(
+                placement["host"], int(placement["port"]),
+                placement.get("alt_hosts", ()),
+                expect_id=wid,
+            )
 
         base = {"job_id": job_id, "stage": stage_index}
         # include_params: the worker snapshots one immutable param tree and
@@ -405,7 +446,18 @@ class ValidatorNode(Node):
         if record.get("passed") is False:
             self.dht.put_local(f"rep:{wid}", 0.0)
             if self.registry is not None:
-                self.registry.set_reputation(wid, 0.0)
+                # reputation write may be a chain transaction — off-loop,
+                # and a failure must be visible, not a GC-time warning
+                async def _slash(reg=self.registry, wid=wid):
+                    try:
+                        await asyncio.to_thread(reg.set_reputation, wid, 0.0)
+                    except Exception as e:  # noqa: BLE001
+                        self.log.warning(
+                            "on-chain reputation slash for %s failed: %s",
+                            wid[:8], e,
+                        )
+
+                self._spawn(_slash())
             if peer is not None:
                 peer.reputation = 0.0
         return record

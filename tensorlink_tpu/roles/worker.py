@@ -545,7 +545,9 @@ class WorkerNode(Node):
         if p is not None:
             return p
         if self.node_id < nid:
-            return await self.connect(info["host"], int(info["port"]))
+            return await self.connect_candidates(
+                info["host"], int(info["port"]), info.get("alt_hosts", ()),
+                expect_id=nid)
         loop = asyncio.get_event_loop()
         deadline = loop.time() + wait_s
         while loop.time() < deadline:
@@ -554,13 +556,18 @@ class WorkerNode(Node):
                 return p
             await asyncio.sleep(0.05)
         # sibling never dialed (it may be older code): dial as fallback
-        return await self.connect(info["host"], int(info["port"]))
+        return await self.connect_candidates(
+            info["host"], int(info["port"]), info.get("alt_hosts", ()),
+            expect_id=nid)
 
     async def _connect_replicas(self, runner: StageRunner) -> None:
         for info in runner.replica_peers:
             if self.node_id < info["node_id"] and info["node_id"] not in self.peers:
                 try:
-                    await self.connect(info["host"], int(info["port"]))
+                    await self.connect_candidates(
+                        info["host"], int(info["port"]),
+                        info.get("alt_hosts", ()),
+                        expect_id=info["node_id"])
                 except (ConnectionError, OSError) as e:
                     self.log.warning(
                         "replica pre-connect to %s failed: %s",

@@ -204,7 +204,8 @@ def test_flash_impl_padding_mask_routes_to_kernel():
     mask4 = pad[:, None, None, :] & jnp.ones((2, 1, 1, 1), bool)
     kv, ok = _as_kv_mask(mask4, 2, 128)
     assert ok and kv.shape == (2, 128)
-    out = flash_attention_impl(q, k, v, mask=mask4, interpret=True)
+    out = flash_attention_impl(q, k, v, mask=mask4, interpret=True,
+                               min_kernel_seq=0)
     ref = dot_product_attention(q, k, v, mask=mask4)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
@@ -219,7 +220,8 @@ def test_flash_impl_batch1_mask_broadcast():
     assert mask4.shape == (1, 1, 1, 128)
     kv, ok = _as_kv_mask(mask4, 2, 128)
     assert ok and kv.shape == (2, 128)
-    out = flash_attention_impl(q, k, v, mask=mask4, interpret=True)
+    out = flash_attention_impl(q, k, v, mask=mask4, interpret=True,
+                               min_kernel_seq=0)
     ref = dot_product_attention(q, k, v, mask=mask4)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
@@ -236,7 +238,8 @@ def test_flash_impl_gqa_repeat():
     v = jax.random.normal(ks[2], (B, T, Hkv, D))
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention_impl(q, k, v, causal=True, interpret=True) ** 2)
+        return jnp.sum(flash_attention_impl(
+            q, k, v, causal=True, interpret=True, min_kernel_seq=0) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
@@ -248,6 +251,25 @@ def test_flash_impl_gqa_repeat():
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_auto_threshold_routes_short_seq_to_reference():
+    """'auto' keeps the einsum below MIN_KERNEL_SEQ_AUTO (measured faster
+    on v5e at short seq); explicit 'flash' forces the kernel. Verified by
+    probing which inner path runs, not just output parity."""
+    from unittest import mock
+
+    from tensorlink_tpu.nn.attention import resolve_attn_impl
+    from tensorlink_tpu.ops import flash as flash_mod
+
+    q, k, v = _qkv(B=2, T=128, H=2, D=32)
+    with mock.patch.object(
+        flash_mod, "flash_attention", wraps=flash_mod.flash_attention
+    ) as kern:
+        resolve_attn_impl("auto")(q, k, v, interpret=True)
+        assert kern.call_count == 0  # short seq: reference path
+        resolve_attn_impl("flash")(q, k, v, interpret=True)
+        assert kern.call_count == 1  # explicit flash: kernel forced
 
 
 def test_attn_impl_config_roundtrip():

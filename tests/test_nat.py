@@ -1,0 +1,350 @@
+"""NAT traversal: port scan + UPnP IGD against an in-process fake gateway.
+
+The fake IGD speaks the real protocol end-to-end — SSDP M-SEARCH over UDP
+(unicast to localhost instead of multicast), the device-description XML
+over HTTP, and the WANIPConnection SOAP control endpoint — so these tests
+cover the same byte path a consumer router sees (reference capability:
+miniupnpc mapping at node start, src/p2p/smart_node.py:787-816).
+"""
+
+import asyncio
+import socket
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from tensorlink_tpu.config import NodeConfig
+from tensorlink_tpu.p2p.nat import UpnpError, UpnpGateway, scan_bind_port
+
+_DESC_XML = """<?xml version="1.0"?>
+<root xmlns="urn:schemas-upnp-org:device-1-0">
+ <device>
+  <deviceType>urn:schemas-upnp-org:device:InternetGatewayDevice:1</deviceType>
+  <deviceList><device>
+   <serviceList><service>
+    <serviceType>urn:schemas-upnp-org:service:WANIPConnection:1</serviceType>
+    <controlURL>/ctl/IPConn</controlURL>
+   </service></serviceList>
+  </device></deviceList>
+ </device>
+</root>"""
+
+
+class FakeIGD:
+    """SSDP responder + HTTP description/control server on localhost."""
+
+    def __init__(self):
+        self.mappings: dict[tuple[int, str], dict] = {}
+        self.external = "203.0.113.7"
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code: int, body: bytes,
+                       ctype: str = "text/xml"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._reply(200, _DESC_XML.encode())
+
+            def do_POST(self):
+                body = self.rfile.read(
+                    int(self.headers["Content-Length"])).decode()
+                action = (self.headers.get("SOAPAction") or "").split("#")[-1].strip('"')
+
+                def arg(name):
+                    import re
+                    m = re.search(rf"<{name}>([^<]*)</{name}>", body)
+                    return m.group(1) if m else ""
+
+                def envelope(inner: str) -> bytes:
+                    return (
+                        '<s:Envelope xmlns:s="http://schemas.xmlsoap.org/'
+                        'soap/envelope/"><s:Body>' + inner +
+                        "</s:Body></s:Envelope>"
+                    ).encode()
+
+                svc = "urn:schemas-upnp-org:service:WANIPConnection:1"
+                if action == "AddPortMapping":
+                    key = (int(arg("NewExternalPort")), arg("NewProtocol"))
+                    outer.mappings[key] = {
+                        "internal": (arg("NewInternalClient"),
+                                     int(arg("NewInternalPort"))),
+                        "desc": arg("NewPortMappingDescription"),
+                        "lease": int(arg("NewLeaseDuration")),
+                    }
+                    self._reply(200, envelope(
+                        f'<u:AddPortMappingResponse xmlns:u="{svc}"/>'))
+                elif action == "DeletePortMapping":
+                    key = (int(arg("NewExternalPort")), arg("NewProtocol"))
+                    if key not in outer.mappings:
+                        self._reply(500, b"<err>NoSuchEntryInArray</err>")
+                        return
+                    del outer.mappings[key]
+                    self._reply(200, envelope(
+                        f'<u:DeletePortMappingResponse xmlns:u="{svc}"/>'))
+                elif action == "GetExternalIPAddress":
+                    self._reply(200, envelope(
+                        f'<u:GetExternalIPAddressResponse xmlns:u="{svc}">'
+                        f"<NewExternalIPAddress>{outer.external}"
+                        "</NewExternalIPAddress>"
+                        "</u:GetExternalIPAddressResponse>"))
+                else:
+                    self._reply(500, b"<err>unknown action</err>")
+
+        self._http = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, daemon=True)
+        self._ssdp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._ssdp.bind(("127.0.0.1", 0))
+        self._ssdp_thread = threading.Thread(target=self._ssdp_loop, daemon=True)
+        self._stop = False
+
+    def _ssdp_loop(self):
+        self._ssdp.settimeout(0.2)
+        location = (f"http://127.0.0.1:{self._http.server_address[1]}"
+                    "/rootDesc.xml")
+        while not self._stop:
+            try:
+                data, addr = self._ssdp.recvfrom(2048)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if b"M-SEARCH" in data:
+                reply = ("HTTP/1.1 200 OK\r\n"
+                         f"LOCATION: {location}\r\n"
+                         "ST: urn:schemas-upnp-org:device:"
+                         "InternetGatewayDevice:1\r\n\r\n").encode()
+                self._ssdp.sendto(reply, addr)
+
+    @property
+    def ssdp_addr(self):
+        return ("127.0.0.1", self._ssdp.getsockname()[1])
+
+    def start(self):
+        self._http_thread.start()
+        self._ssdp_thread.start()
+        return self
+
+    def stop(self):
+        self._stop = True
+        self._http.shutdown()
+        self._http.server_close()
+        self._ssdp.close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+@pytest.fixture()
+def igd():
+    with FakeIGD() as f:
+        yield f
+
+
+# ---------------------------------------------------------------- port scan
+def test_scan_bind_port_skips_taken_ports():
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    base = blocker.getsockname()[1]
+    try:
+        port = scan_bind_port("127.0.0.1", base, max_tries=10)
+        assert port > base  # base is taken by the blocker
+    finally:
+        blocker.close()
+
+
+def test_scan_bind_port_exhausted():
+    holders = []
+    base = None
+    try:
+        for i in range(3):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0 if base is None else base + i))
+            if base is None:
+                base = s.getsockname()[1]
+            holders.append(s)
+        with pytest.raises(OSError):
+            scan_bind_port("127.0.0.1", base, max_tries=3)
+    except OSError:
+        pytest.skip("consecutive ports unavailable on this host")
+    finally:
+        for s in holders:
+            s.close()
+
+
+# -------------------------------------------------------------------- UPnP
+def test_discover_and_map(igd):
+    gw = UpnpGateway.discover(timeout=2.0, ssdp_addr=igd.ssdp_addr)
+    assert gw.service_type.endswith("WANIPConnection:1")
+    assert gw.external_ip() == "203.0.113.7"
+    gw.add_port_mapping(38751, 38751, description="test-node", lease_s=3600)
+    assert igd.mappings[(38751, "TCP")]["desc"] == "test-node"
+    assert igd.mappings[(38751, "TCP")]["lease"] == 3600
+    gw.delete_port_mapping(38751)
+    assert (38751, "TCP") not in igd.mappings
+
+
+def test_delete_unknown_mapping_raises(igd):
+    gw = UpnpGateway.discover(timeout=2.0, ssdp_addr=igd.ssdp_addr)
+    with pytest.raises(UpnpError):
+        gw.delete_port_mapping(40000)
+
+
+def test_discover_timeout_no_gateway():
+    # a bound-but-silent UDP port: discovery must time out, not hang
+    silent = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    silent.bind(("127.0.0.1", 0))
+    try:
+        with pytest.raises(UpnpError):
+            UpnpGateway.discover(timeout=0.5,
+                                 ssdp_addr=silent.getsockname())
+    finally:
+        silent.close()
+
+
+# ------------------------------------------------------------ node wiring
+@pytest.mark.asyncio
+async def test_node_maps_and_unmaps_on_lifecycle(igd):
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    cfg = NodeConfig(role="worker", port=0, upnp=True,
+                     upnp_ssdp_addr=igd.ssdp_addr, upnp_lease_s=7200)
+    node = WorkerNode(cfg)
+    await node.start()
+    try:
+        key = (node.port, "TCP")
+        assert key in igd.mappings
+        assert igd.mappings[key]["internal"][1] == node.port
+        assert node.external_ip == "203.0.113.7"
+    finally:
+        await node.stop()
+    assert (node.port, "TCP") not in igd.mappings
+
+
+@pytest.mark.asyncio
+async def test_node_survives_missing_gateway():
+    """upnp=True on a network with no IGD must degrade, not fail."""
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    silent = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    silent.bind(("127.0.0.1", 0))
+    try:
+        cfg = NodeConfig(role="worker", port=0, upnp=True, upnp_timeout_s=0.3,
+                         upnp_ssdp_addr=silent.getsockname())
+        node = WorkerNode(cfg)
+        await node.start()
+        assert node.port  # listening despite the failed mapping
+        await node.stop()
+    finally:
+        silent.close()
+
+
+@pytest.mark.asyncio
+async def test_natted_worker_reachable_via_alt_host(igd):
+    """Hairpin-NAT regression: a NAT'd worker advertises its external IP,
+    which same-LAN peers cannot dial; recruitment must carry the observed
+    address as a fallback candidate so the user still reaches the worker."""
+    import jax
+    import numpy as np
+
+    from tensorlink_tpu.models.mlp import MLP, MLPConfig
+    from tensorlink_tpu.roles.registry import InMemoryRegistry
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    validator = ValidatorNode(
+        NodeConfig(role="validator", port=0), registry=InMemoryRegistry())
+    await validator.start()
+    worker = WorkerNode(NodeConfig(
+        role="worker", port=0, upnp=True, upnp_ssdp_addr=igd.ssdp_addr))
+    await worker.start()
+    assert worker.info.host == "203.0.113.7"  # advertises the external IP
+    # loopback is never gossiped network-wide; the dial fallback comes from
+    # the validator appending its OBSERVED address for the worker below
+    assert worker.info.alt_hosts == []
+    await worker.connect("127.0.0.1", validator.port)
+    # fail fast on the unroutable advertised address
+    user = UserNode(NodeConfig(role="user", port=0, connect_timeout_s=1.0))
+    await user.start()
+    v_peer = await user.connect("127.0.0.1", validator.port)
+    try:
+        m = MLP(MLPConfig(in_dim=8, hidden_dim=8, out_dim=4, num_layers=1))
+        p = m.init(jax.random.key(0))
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer, max_stage_bytes=1 << 30,
+            micro_batches=1,
+            train={"optimizer": "sgd", "learning_rate": 0.1},
+        )
+        assert [st.peer.node_id for st in job.stages] == [worker.node_id]
+
+        def loss_grad(logits, micro):
+            g = np.asarray(logits, dtype=np.float32)
+            return float(np.mean(g**2)), 2 * g / g.size
+
+        loss = await job.train_step(
+            np.ones((4, 8), dtype=np.float32), loss_grad)
+        assert np.isfinite(loss)
+    finally:
+        for n in (user, worker, validator):
+            await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_expect_id_mismatch_preserves_existing_connection():
+    """A mis-routed candidate dial that handshakes as the WRONG node must
+    fail that candidate without displacing a healthy existing connection
+    to the mis-identified node (behind shared NATs the same ip:port can
+    route to an unrelated peer)."""
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    a = WorkerNode(NodeConfig(role="worker", port=0))
+    b = WorkerNode(NodeConfig(role="worker", port=0))
+    await a.start()
+    await b.start()
+    try:
+        healthy = await a.connect("127.0.0.1", b.port)
+        assert b.node_id in a.peers
+        # dialing b's address while expecting some OTHER node must raise
+        # and must NOT drop the healthy a<->b connection
+        with pytest.raises(ConnectionError):
+            await a.connect_candidates(
+                "127.0.0.1", b.port, expect_id="f" * 64)
+        assert a.peers.get(b.node_id) is healthy
+        pong = await a.request(healthy, {"type": "PING"})
+        assert pong.get("type") == "PONG"
+    finally:
+        await a.stop()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_base_port_scan_binding():
+    """port=-1 scans upward from base_port (reference smart_node.py:949-967)."""
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    blocker = socket.socket()
+    blocker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    base = blocker.getsockname()[1]
+    try:
+        cfg = NodeConfig(role="worker", port=-1, base_port=base)
+        node = WorkerNode(cfg)
+        await node.start()
+        assert node.port > base
+        await node.stop()
+    finally:
+        blocker.close()
