@@ -69,6 +69,7 @@ class Node:
         self._pending: dict[str, asyncio.Future] = {}
         self._pending_peer: dict[str, str] = {}  # msg id -> peer node_id
         self._server: asyncio.AbstractServer | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
         self._tasks: set[asyncio.Task] = set()
         self.port: int | None = None
         self.external_ip: str | None = None  # set by UPnP mapping
@@ -84,6 +85,7 @@ class Node:
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
         port = self.cfg.port
         if port < 0:
             # upward scan from base_port (reference smart_node.py:949-967);
@@ -238,8 +240,22 @@ class Node:
                 pass
         await asyncio.sleep(0)  # let cancelled tasks unwind
 
-    def _spawn(self, coro) -> asyncio.Task:
-        t = asyncio.create_task(coro)
+    def _spawn(self, coro):
+        """Track a background task. Safe from worker threads too (stage
+        install runs under asyncio.to_thread and spawns pre-connects):
+        off-loop it schedules onto the node's loop thread-safely."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            if self._loop is None:
+                raise
+            # hop onto the node's loop and spawn THERE, so the task gets
+            # the same tracking/cancellation as any other (a raw
+            # run_coroutine_threadsafe future would escape stop() and
+            # swallow exceptions)
+            self._loop.call_soon_threadsafe(self._spawn, coro)
+            return None
+        t = loop.create_task(coro)
         self._tasks.add(t)
         t.add_done_callback(self._tasks.discard)
         return t

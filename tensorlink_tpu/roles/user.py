@@ -70,6 +70,12 @@ class RemoteStage:
     replica: int = 0
 
 
+def placement_wire(st: RemoteStage) -> dict:
+    """The one wire shape for a stage placement (recruitment info + slot
+    coordinates) — used for replica sets, relay chains, and relay routes."""
+    return dict(st.info, stage=st.index, replica=st.replica)
+
+
 class DistributedJob:
     """Master-side handle to a placed job — the TPU-era DistributedModel.
 
@@ -85,12 +91,20 @@ class DistributedJob:
         validator: Peer | None = None,
         plan=None,  # ObfuscationPlan: master-side secret rotations
         stage_modules: "list[Sequential] | None" = None,
+        relay: bool | None = None,
     ):
         self.user = user
         self.job = job
         self.stages = stages  # ALL stage slots (every replica)
         self.validator = validator  # for elastic re-recruitment
         self.plan = plan
+        # worker-to-worker activation relay (SURVEY §2.4 stage-to-stage
+        # transfer): default ON for clear jobs with a real chain; the
+        # obfuscated path must stay hub-and-spoke — the plan's secret
+        # rotations between stages are applied by the MASTER only.
+        self.relay = (plan is None) if relay is None else relay
+        if self.relay and plan is not None:
+            raise ValueError("relay transfer is incompatible with obfuscation")
         self.stage_modules = stage_modules
         self.obfuscate_key = None  # set by request_job/reattach_job
         self.step = 0
@@ -150,8 +164,52 @@ class DistributedJob:
             for r in sorted(by_replica)
         ]
 
+    async def _relay_micro(
+        self, step: int, micro: int, arr: np.ndarray, *, backward: bool
+    ) -> np.ndarray:
+        """One micro-batch through the chain via worker-to-worker relay:
+        one request to the entry stage carrying the remaining route; the
+        exit stage sends the result straight back to us. vs the hub path:
+        half the master traffic, hops ride worker links."""
+        chain = self.chains[micro % len(self.chains)]
+        order = list(reversed(chain)) if backward else chain
+        entry, exit_st = order[0], order[-1]
+        kind = "grad" if backward else "act"
+        arr_key = "g" if backward else "x"
+        key = (self.job.job_id, step, micro, kind, self._fence)
+        fut = self.user.relay_waiter(
+            key, expected=exit_st.peer.node_id,
+            members={st.peer.node_id for st in chain},
+        )
+        try:
+            ack = await self.user.request(
+                entry.peer,
+                {
+                    "type": "RELAY_BACKWARD" if backward else "RELAY_FORWARD",
+                    "job_id": self.job.job_id,
+                    "stage": entry.index,
+                    "step": step,
+                    "micro": micro,
+                    "fence": self._fence,
+                    "origin": self.user.node_id,
+                    "route": [placement_wire(st) for st in order[1:]],
+                    "data": pack_arrays({arr_key: np.asarray(arr)}),
+                },
+                timeout=60.0,
+            )
+            if ack.get("type") != "RELAY_ACCEPTED":
+                raise RuntimeError(
+                    f"stage {entry.index} relay rejected: {ack}"
+                )
+            blob = await asyncio.wait_for(fut, timeout=60.0 * len(chain))
+            return unpack_arrays(blob)[arr_key]
+        finally:
+            self.user.drop_relay_waiter(key)
+
     async def _micro_forward(self, step: int, micro: int, x: np.ndarray) -> np.ndarray:
         chain = self.chains[micro % len(self.chains)]
+        if self.relay and len(chain) > 1:
+            return await self._relay_micro(step, micro, x, backward=False)
         for st in chain:
             if self.plan is not None:
                 x = self.plan.forward_in(st.index, x)
@@ -177,6 +235,8 @@ class DistributedJob:
 
     async def _micro_backward(self, step: int, micro: int, g: np.ndarray) -> np.ndarray:
         chain = self.chains[micro % len(self.chains)]
+        if self.relay and len(chain) > 1:
+            return await self._relay_micro(step, micro, g, backward=True)
         for st in reversed(chain):
             if self.plan is not None:
                 g = self.plan.backward_in(st.index, g)
@@ -448,13 +508,22 @@ class DistributedJob:
             await self._ship_stage(st)
         return st
 
+    def _chain_placements(self, replica: int) -> list[dict]:
+        """Wire info of replica ``replica``'s full stage chain, in stage
+        order — shipped to every member for relay routing/authorization."""
+        return [
+            placement_wire(s)
+            for s in sorted(
+                (s for s in self.stages if s.replica == replica),
+                key=lambda s: s.index,
+            )
+        ]
+
     def _replica_placements(self, index: int) -> list[dict]:
         """Wire info of every live slot of stage ``index`` (the worker
         filters itself out and uses the rest as its GRAD_SHARE set)."""
         return [
-            dict(s.info, stage=s.index, replica=s.replica)
-            for s in self.stages
-            if s.index == index
+            placement_wire(s) for s in self.stages if s.index == index
         ]
 
     async def _ship_stage(self, st: RemoteStage) -> None:
@@ -471,6 +540,7 @@ class DistributedJob:
                 "stage": index,
                 "replica": st.replica,
                 "replicas": self._replica_placements(index),
+                "chain": self._chain_placements(st.replica),
                 "module_config": self.job.stages[index].module_config,
                 "train": self.job.train,
             },
@@ -573,6 +643,48 @@ class UserNode(Node):
         self._param_streams: dict[tuple, tuple[str, asyncio.Future]] = {}
         self.register_stream_kind("parameters", self._stream_parameters)
         self.on("PARAMS_STREAM_FAILED", self._h_params_stream_failed)
+        # (job_id, step, micro, kind, fence) -> (exit sender, chain member
+        # ids, future): results of worker-to-worker relay chains land here.
+        # The peer checks keep a handshaken stranger from injecting
+        # activations/gradients (exit-only) or spurious errors (chain
+        # members only) into a pending step.
+        self._relay_waiters: dict[tuple, tuple[str, set, asyncio.Future]] = {}
+        self.on("RELAY_RESULT", self._h_relay_result)
+        self.on("RELAY_ERROR", self._h_relay_result)
+
+    # ------------------------------------------------- relay result intake
+    def relay_waiter(self, key: tuple, expected: str, members: set) -> asyncio.Future:
+        fut = asyncio.get_event_loop().create_future()
+        self._relay_waiters[key] = (expected, set(members), fut)
+        return fut
+
+    def drop_relay_waiter(self, key: tuple) -> None:
+        self._relay_waiters.pop(key, None)
+
+    async def _h_relay_result(self, node, peer, msg) -> None:
+        key = (
+            str(msg.get("job_id")), int(msg.get("step", -1)),
+            int(msg.get("micro", -1)), str(msg.get("kind", "act")),
+            int(msg.get("fence", 0)),
+        )
+        entry = self._relay_waiters.get(key)
+        if entry is None:
+            return  # stale straggler from an aborted/timed-out attempt
+        expected, members, fut = entry
+        is_error = msg.get("type") == "RELAY_ERROR"
+        allowed = members if is_error else {expected}
+        if peer.node_id not in allowed:
+            peer.ghosts += 1
+            self._penalize(peer)
+            return
+        if fut.done():
+            return
+        if is_error:
+            fut.set_exception(RuntimeError(
+                f"relay failed: {msg.get('error', 'unknown')}"
+            ))
+        else:
+            fut.set_result(msg["data"])
 
     async def _h_params_stream_failed(self, node, peer, msg) -> None:
         """Worker-side stream failure: fail the waiting fetch immediately
@@ -655,10 +767,12 @@ class UserNode(Node):
             )
         remote.sort(key=lambda s: (s.replica, s.index))
         by_stage: dict[int, list[dict]] = {}
+        by_replica: dict[int, list[dict]] = {}
         for st in remote:
-            by_stage.setdefault(st.index, []).append(
-                dict(st.info, stage=st.index, replica=st.replica)
-            )
+            by_stage.setdefault(st.index, []).append(placement_wire(st))
+            by_replica.setdefault(st.replica, []).append(placement_wire(st))
+        for chain in by_replica.values():
+            chain.sort(key=lambda p: p["stage"])
 
         async def ship(st: RemoteStage) -> None:
             ack = await self.ship_spec(
@@ -668,6 +782,7 @@ class UserNode(Node):
                     "stage": st.index,
                     "replica": st.replica,
                     "replicas": by_stage[st.index],
+                    "chain": by_replica[st.replica],
                     "module_config": job.stages[st.index].module_config,
                     "train": job.train,
                 },
@@ -691,6 +806,7 @@ class UserNode(Node):
         train: dict | None = None,
         obfuscate: bool = False,
         obfuscate_key: jax.Array | None = None,
+        relay: bool | None = None,
     ) -> DistributedJob:
         """Partition -> JOB_REQ -> connect workers -> ship specs+weights ->
         LOADED acks -> DistributedJob (reference call stack §3.1).
@@ -706,6 +822,11 @@ class UserNode(Node):
         commutes with the update); adaptive elementwise optimizers (adam,
         adamw) train in the rotated basis with slightly different
         dynamics — a warning is logged."""
+        if relay and obfuscate:
+            # validate BEFORE recruitment: failing in DistributedJob after
+            # the specs shipped would leave loaded stages + reservations
+            # orphaned on every worker (review finding)
+            raise ValueError("relay transfer is incompatible with obfuscation")
         stage_parts = partition_sequential(model, params, max_stage_bytes)
         plan = None
         key = None
@@ -786,7 +907,7 @@ class UserNode(Node):
         )
         dj = DistributedJob(
             self, job, remote, validator=validator, plan=plan,
-            stage_modules=[seq for seq, _ in stage_parts],
+            stage_modules=[seq for seq, _ in stage_parts], relay=relay,
         )
         dj._stage_params = {i: p for i, (_, p) in enumerate(stage_parts)}
         # the rotation key is the ONLY way back to the true basis: expose

@@ -88,6 +88,9 @@ class StageRunner:
     # averaging, Whitepaper:21 / src/roles/user.py:161 — implemented):
     replica: int = 0
     replica_peers: list = field(default_factory=list)  # [{node_id,host,port}]
+    # this replica's full stage chain (placement dicts), for worker-to-
+    # worker relay routing + sender authorization; refreshed on recovery
+    chain: list = field(default_factory=list)
     _snapped_step: int = -1  # guards double-snapshot on STEP_END retry
     devices: Any = None  # >1 jax devices -> local TP mesh over "model"
 
@@ -368,6 +371,8 @@ class WorkerNode(Node):
         self.on("MODULE_SPEC", self._h_module_spec)
         self.on("FORWARD", self._h_forward)
         self.on("BACKWARD", self._h_backward)
+        self.on("RELAY_FORWARD", self._h_relay_forward)
+        self.on("RELAY_BACKWARD", self._h_relay_backward)
         self.on("STEP_END", self._h_step_end)
         self.on("GRAD_SHARE", self._h_grad_share)
         self.on("ABORT_STEP", self._h_abort_step)
@@ -466,6 +471,7 @@ class WorkerNode(Node):
                 for p in meta.get("replicas", [])
                 if p.get("node_id") != self.node_id
             ],
+            chain=[dict(p) for p in meta.get("chain", [])],
         )
         self.stages[(runner.job_id, runner.stage_index)] = runner
         self.training = True
@@ -473,6 +479,15 @@ class WorkerNode(Node):
             # pre-dial the replica set (initiator = lower node_id) so the
             # first STEP_END's GRAD_SHARE finds live connections
             self._spawn(self._connect_replicas(runner))
+        neighbors = [
+            p for p in runner.chain
+            if abs(int(p.get("stage", -9)) - runner.stage_index) == 1
+            and p.get("node_id") != self.node_id
+        ]
+        if neighbors:
+            # pre-dial chain neighbors so the first relay hop finds a live
+            # connection (same initiator election as replicas)
+            self._spawn(self._preconnect(neighbors))
         return {
             "type": "LOADED",
             "job_id": runner.job_id,
@@ -561,7 +576,12 @@ class WorkerNode(Node):
             expect_id=nid)
 
     async def _connect_replicas(self, runner: StageRunner) -> None:
-        for info in runner.replica_peers:
+        await self._preconnect(runner.replica_peers)
+
+    async def _preconnect(self, infos: list) -> None:
+        """Pre-dial a peer set with initiator election (lower node_id
+        dials) so the first data-plane message finds a live connection."""
+        for info in infos:
             if self.node_id < info["node_id"] and info["node_id"] not in self.peers:
                 try:
                     await self.connect_candidates(
@@ -570,7 +590,7 @@ class WorkerNode(Node):
                         expect_id=info["node_id"])
                 except (ConnectionError, OSError) as e:
                     self.log.warning(
-                        "replica pre-connect to %s failed: %s",
+                        "peer pre-connect to %s failed: %s",
                         info["node_id"][:8], e,
                     )
 
@@ -647,6 +667,137 @@ class WorkerNode(Node):
             "micro": msg["micro"],
             "data": pack_arrays({"g": gx}),
         }
+
+    # ------------------------------------------------- worker->worker relay
+    # Stage-to-stage activation transfer (SURVEY §2.4 "stage-to-stage
+    # transfer"; VERDICT weak #7: the hub-and-spoke master relayed every
+    # activation master->worker->master, 2x the DCN traffic and the master
+    # NIC as the bottleneck). The master sends the micro-batch to the FIRST
+    # stage with the remaining route; each worker computes and forwards
+    # DIRECTLY to the next stage's worker; the last hop returns the result
+    # to the origin (master) as a RELAY_RESULT. Backward mirrors in
+    # reverse. Fencing/idempotency are identical to the hub path — every
+    # hop carries (job, stage, step, micro, fence).
+
+    def _relay_sender_ok(self, runner: StageRunner, peer: Peer, *, backward: bool) -> bool:
+        """A relay hop may come from the job owner (first hop) or from the
+        ADJACENT stage worker of this replica's chain (shipped in the
+        MODULE_SPEC, refreshed on every recovery re-ship). Anything else
+        is ghosted — a handshaken stranger must not drive the stage."""
+        if peer.node_id == runner.owner:
+            return True
+        want = runner.stage_index + (1 if backward else -1)
+        return any(
+            int(p.get("stage", -1)) == want
+            and int(p.get("replica", 0)) == runner.replica
+            and p.get("node_id") == peer.node_id
+            for p in runner.chain
+        )
+
+    async def _relay_to_origin(self, msg: dict, payload: dict) -> None:
+        origin = self.peers.get(str(msg.get("origin", "")))
+        if origin is None:
+            # master connection gone: nothing to reply to — the master's
+            # waiter times out and its elastic recovery takes over
+            self.log.warning(
+                "relay result for step %s micro %s has no origin connection",
+                msg.get("step"), msg.get("micro"),
+            )
+            return
+        await self.send(origin, {
+            **payload,
+            "job_id": msg["job_id"],
+            "step": msg["step"],
+            "micro": msg["micro"],
+            "fence": msg.get("fence", 0),
+        })
+
+    async def _relay_error(self, msg: dict, error: str) -> None:
+        await self._relay_to_origin(
+            msg, {"type": "RELAY_ERROR", "kind": msg.get("kind", "act"),
+                  "error": error},
+        )
+
+    async def _relay_run(self, runner: StageRunner, msg: dict, *, backward: bool) -> None:
+        """Compute this hop off-loop, then forward along the route or
+        return the final result to the origin."""
+        arr_key = "g" if backward else "x"
+        kind = "grad" if backward else "act"
+        try:
+            # unpack inside the try: a malformed hop payload must flow to
+            # the master as RELAY_ERROR, not stall its waiter to timeout
+            data = unpack_arrays(msg["data"])[arr_key]
+            fn = runner.backward if backward else runner.forward
+            out = await asyncio.to_thread(
+                fn, int(msg["step"]), int(msg["micro"]), data,
+                int(msg.get("fence", 0)),
+            )
+        except StaleFenceError:
+            return  # aborted step attempt: drop silently
+        except Exception as e:  # noqa: BLE001 — surfaced to the master
+            await self._relay_error(dict(msg, kind=kind), f"stage {runner.stage_index}: {e}")
+            return
+        route = list(msg.get("route") or [])
+        blob = pack_arrays({arr_key: np.asarray(out)})
+        if route:
+            nxt = route[0]
+            try:
+                p = await self._replica_peer(nxt)
+                await self.send(p, {
+                    "type": "RELAY_BACKWARD" if backward else "RELAY_FORWARD",
+                    "job_id": msg["job_id"],
+                    "stage": int(nxt["stage"]),
+                    "step": msg["step"],
+                    "micro": msg["micro"],
+                    "fence": msg.get("fence", 0),
+                    "origin": msg.get("origin"),
+                    "route": route[1:],
+                    "data": blob,
+                })
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                await self._relay_error(
+                    dict(msg, kind=kind),
+                    f"hop stage {runner.stage_index}->{nxt.get('stage')}: {e}",
+                )
+        else:
+            await self._relay_to_origin(
+                msg, {"type": "RELAY_RESULT", "kind": kind, "data": blob},
+            )
+
+    async def _h_relay(self, peer: Peer, msg: dict, *, backward: bool) -> dict | None:
+        key = (str(msg["job_id"]), int(msg["stage"]))
+        runner = self.stages.get(key)
+        first_hop = peer.node_id == str(msg.get("origin", ""))
+        kind = "grad" if backward else "act"
+
+        async def fail(error: str) -> dict | None:
+            if first_hop:
+                return {"type": "ERROR", "error": error}
+            await self._relay_error(dict(msg, kind=kind), error)
+            return None
+
+        if runner is None:
+            return await fail(f"no stage {key}")
+        if not self._relay_sender_ok(runner, peer, backward=backward):
+            peer.ghosts += 1
+            self._penalize(peer)
+            return await fail("unauthorized relay sender")
+        if int(msg.get("fence", 0)) < runner.fence:
+            if first_hop:
+                return {"type": "ERROR", "error": "stale fence (aborted step)"}
+            return None  # stale straggler hop: drop
+        # ack immediately (first hop is a master request); compute+forward
+        # proceed in the background, errors flow to the origin
+        self._spawn(self._relay_run(runner, msg, backward=backward))
+        if first_hop:
+            return {"type": "RELAY_ACCEPTED", "stage": runner.stage_index}
+        return None
+
+    async def _h_relay_forward(self, node, peer, msg) -> dict | None:
+        return await self._h_relay(peer, msg, backward=False)
+
+    async def _h_relay_backward(self, node, peer, msg) -> dict | None:
+        return await self._h_relay(peer, msg, backward=True)
 
     async def _h_step_end(self, node, peer, msg) -> dict:
         """All micro-grads in: optimizer step (correctly: step, no

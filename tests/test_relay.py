@@ -1,0 +1,215 @@
+"""Worker-to-worker activation relay (SURVEY §2.4 stage-to-stage transfer).
+
+The hub-and-spoke path relays every activation master->worker->master;
+relay mode sends the micro-batch to the entry stage with a route, workers
+forward directly to the next stage, and the exit stage returns the result
+to the master — half the master traffic. These tests pin parity between
+the two data planes, routing authorization, DP chains, and that elastic
+recovery still works when the data plane is worker-to-worker.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.config import NodeConfig
+from tensorlink_tpu.models.mlp import MLP, MLPConfig
+from tensorlink_tpu.p2p.serialization import pack_arrays
+from tensorlink_tpu.roles.registry import InMemoryRegistry
+from tensorlink_tpu.roles.user import UserNode
+from tensorlink_tpu.roles.validator import ValidatorNode
+from tensorlink_tpu.roles.worker import WorkerNode
+
+KEY = jax.random.key(0)
+
+
+def _cfg(role):
+    return NodeConfig(role=role, host="127.0.0.1", port=0)
+
+
+def _model():
+    m = MLP(MLPConfig(in_dim=16, hidden_dim=32, out_dim=4, num_layers=2))
+    p = m.init(KEY)
+    return m, p
+
+
+async def _setup(n_workers=2):
+    reg = InMemoryRegistry()
+    validator = ValidatorNode(_cfg("validator"), registry=reg)
+    await validator.start()
+    workers = []
+    for _ in range(n_workers):
+        w = WorkerNode(_cfg("worker"))
+        await w.start()
+        await w.connect("127.0.0.1", validator.port)
+        workers.append(w)
+    user = UserNode(_cfg("user"))
+    await user.start()
+    v_peer = await user.connect("127.0.0.1", validator.port)
+    return validator, workers, user, v_peer
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 16)).astype(np.float32)
+    y = np.argmax(x @ rng.normal(size=(16, 4)), -1)
+    return x, y
+
+
+def _loss_grad(y, n_micro):
+    def fn(logits, micro):
+        lj = jnp.asarray(logits)
+        yj = jnp.asarray(np.array_split(y, n_micro)[micro])
+
+        def f(l):
+            logz = jax.nn.logsumexp(l, axis=-1)
+            ll = jnp.take_along_axis(l, yj[:, None], axis=-1)[..., 0]
+            return jnp.mean(logz - ll)
+
+        val, g = jax.value_and_grad(f)(lj)
+        return float(val), np.asarray(g)
+
+    return fn
+
+
+async def _train(user, v_peer, *, relay, steps=8, dp_factor=1,
+                 n_micro=2) -> list[float]:
+    m, p = _model()
+    job = await user.request_job(
+        m.seq, p["seq"], v_peer, max_stage_bytes=16 * 32 * 4 + 200,
+        micro_batches=n_micro, dp_factor=dp_factor, relay=relay,
+        train={"optimizer": "sgd", "learning_rate": 0.05},
+    )
+    assert job.relay is relay
+    n_chains = len(job.chains)
+    assert n_chains == dp_factor
+    x, y = _data()
+    lg = _loss_grad(y, n_micro)
+    return [await job.train_step(x, lg) for _ in range(steps)]
+
+
+@pytest.mark.asyncio
+async def test_relay_parity_with_hub_path():
+    """Identical seeds + data: the relay data plane must produce the
+    exact same training trajectory as hub-and-spoke."""
+    validator, workers, user, v_peer = await _setup(2)
+    try:
+        hub = await _train(user, v_peer, relay=False)
+        rel = await _train(user, v_peer, relay=True)
+        np.testing.assert_allclose(hub, rel, rtol=1e-5)
+        assert rel[-1] < rel[0] * 0.8  # and it actually trains
+    finally:
+        for n in (user, validator, *workers):
+            await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_relay_dp2_chains():
+    """dp_factor=2 with relay: each replica's chain relays independently;
+    loss decreases and replicas stay in lockstep (GRAD_SHARE unchanged)."""
+    validator, workers, user, v_peer = await _setup(4)
+    try:
+        losses = await _train(user, v_peer, relay=True, dp_factor=2,
+                              n_micro=2)
+        assert losses[-1] < losses[0] * 0.8, losses
+    finally:
+        for n in (user, validator, *workers):
+            await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_relay_unauthorized_hop_ghosted():
+    """A handshaken stranger injecting a RELAY_FORWARD into a worker must
+    be rejected and ghost-counted — only the owner or the adjacent chain
+    stage may drive a relay hop."""
+    validator, workers, user, v_peer = await _setup(2)
+    stranger = WorkerNode(_cfg("worker"))
+    await stranger.start()
+    try:
+        m, p = _model()
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer, max_stage_bytes=16 * 32 * 4 + 200,
+            micro_batches=1, relay=True,
+            train={"optimizer": "sgd", "learning_rate": 0.05},
+        )
+        target_stage = job.chains[0][1]  # stage 1: strangers aren't prev
+        victim = next(
+            w for w in workers if w.node_id == target_stage.peer.node_id
+        )
+        s_peer = await stranger.connect("127.0.0.1", victim.port)
+        resp = await stranger.request(s_peer, {
+            "type": "RELAY_FORWARD",
+            "job_id": job.job.job_id,
+            "stage": target_stage.index,
+            "step": 0, "micro": 0, "fence": 0,
+            "origin": stranger.node_id,  # claims to be the master
+            "route": [],
+            "data": pack_arrays({"x": np.zeros((4, 32), np.float32)}),
+        })
+        assert resp.get("type") == "ERROR"
+        assert victim.peers[stranger.node_id].ghosts >= 1
+    finally:
+        for n in (user, validator, stranger, *workers):
+            await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_relay_elastic_recovery_worker_death():
+    """Kill a mid-chain worker during relay training: the step times out
+    or errors, the master aborts + re-recruits, and training resumes —
+    the elastic machinery is data-plane-agnostic."""
+    validator, workers, user, v_peer = await _setup(3)
+    try:
+        m, p = _model()
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer, max_stage_bytes=16 * 32 * 4 + 200,
+            micro_batches=2, relay=True,
+            train={"optimizer": "sgd", "learning_rate": 0.05},
+        )
+        x, y = _data()
+        lg = _loss_grad(y, 2)
+        losses = [await job.train_step(x, lg) for _ in range(3)]
+        # kill the worker holding stage 1 (the relay exit stage)
+        dead = job.chains[0][1].peer.node_id
+        victim = next(w for w in workers if w.node_id == dead)
+        await victim.stop()
+        for _ in range(4):
+            losses.append(await job.train_step(x, lg))
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] <= losses[0], losses
+        # the replacement slot is a different node and relay still works
+        assert job.chains[0][1].peer.node_id != dead
+    finally:
+        for n in (user, validator, *workers):
+            try:
+                await n.stop()
+            except Exception:
+                pass
+
+
+@pytest.mark.asyncio
+async def test_relay_rejects_obfuscated_jobs():
+    """The obfuscated path must stay hub-and-spoke: the plan's secret
+    rotations between stages are applied by the master only."""
+    validator, workers, user, v_peer = await _setup(2)
+    try:
+        m, p = _model()
+        with pytest.raises(ValueError, match="relay.*obfuscation"):
+            await user.request_job(
+                m.seq, p["seq"], v_peer, max_stage_bytes=16 * 32 * 4 + 200,
+                obfuscate=True, relay=True,
+                train={"optimizer": "sgd", "learning_rate": 0.05},
+            )
+        # and obfuscate WITHOUT explicit relay silently keeps the hub path
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer, max_stage_bytes=16 * 32 * 4 + 200,
+            obfuscate=True,
+            train={"optimizer": "sgd", "learning_rate": 0.05},
+        )
+        assert job.relay is False
+    finally:
+        for n in (user, validator, *workers):
+            await n.stop()
