@@ -33,10 +33,29 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     rms_eps: float = 1e-5
     attn_impl: str = "auto"  # auto | flash | reference | ring (seq-parallel)
+    # Mixture-of-Experts FFN (Mixtral-style): 0 = dense. Experts shard
+    # over the mesh 'model' axis (nn/moe.py — expert parallelism as
+    # tensor sharding; dispatch/combine lower to all_to_all).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
         return cls()
+
+    @classmethod
+    def mixtral_8x7b(cls) -> "LlamaConfig":
+        """Mixtral-8x7B shape: Llama-2-ish trunk, 8 experts, top-2."""
+        return cls(vocab_size=32000, dim=4096, num_layers=32, num_heads=32,
+                   num_kv_heads=8, hidden_dim=14336, max_len=32768,
+                   rope_theta=1e6, moe_experts=8, moe_top_k=2)
+
+    @classmethod
+    def moe_tiny(cls) -> "LlamaConfig":
+        return cls(vocab_size=128, dim=32, num_layers=2, num_heads=4,
+                   num_kv_heads=2, hidden_dim=64, max_len=64,
+                   rope_theta=10000.0, moe_experts=4, moe_top_k=2)
 
     @classmethod
     def llama3_70b(cls) -> "LlamaConfig":
@@ -81,6 +100,9 @@ class Llama(Module):
                 rope_theta=cfg.rope_theta,
                 dropout=0.0,
                 attn_impl=cfg.attn_impl,
+                moe_experts=cfg.moe_experts,
+                moe_top_k=cfg.moe_top_k,
+                moe_capacity_factor=cfg.moe_capacity_factor,
             ),
         )
         self.child("norm_f", RMSNorm(cfg.dim, eps=cfg.rms_eps))
@@ -122,8 +144,37 @@ class Llama(Module):
             return out, new_caches
         return out
 
+    def apply_with_aux(
+        self, params, input_ids, *, positions=None, mask=None, rng=None,
+        train=False, **_,
+    ):
+        """-> (logits, aux): the summed MoE router load-balancing loss
+        across blocks (0.0 for dense configs). Mixtral-style training
+        adds ``aux_weight * aux`` to the task loss."""
+        x = self.children["tok_emb"].apply(params["tok_emb"], input_ids)
+        x, aux = self.children["blocks"].apply_with_aux(
+            params["blocks"], x, mask=mask, positions=positions,
+            rng=rng, train=train,
+        )
+        x = self.children["norm_f"].apply(params["norm_f"], x)
+        return self.children["lm_head"].apply(params["lm_head"], x), aux
+
     def as_pipeline_parts(self, params):
         from tensorlink_tpu.parallel.engine import PipelineParts
+
+        if self.cfg_obj.moe_experts:
+            # the pipeline schedules run MoE blocks via block.apply, which
+            # discards the router's load-balancing aux loss — training
+            # works but the router is unregularized. Threading aux through
+            # the stage vjp (gpipe + 1f1b) is future work; single-host
+            # training gets it via apply_with_aux.
+            import logging
+
+            logging.getLogger("tensorlink_tpu.models").warning(
+                "MoE pipeline training drops the router aux loss; "
+                "use apply_with_aux on the single-host path for "
+                "load-balanced routing"
+            )
 
         stack = self.children["blocks"]
         block = stack.blocks()[0]

@@ -41,6 +41,11 @@ from tensorlink_tpu.train.optim import apply_updates, make_optimizer
 from tensorlink_tpu.utils.trees import tree_bytes
 
 
+def _prog_total(m: dict) -> int:
+    """Live device bytes of one compiled program (args + temps + outs)."""
+    return m["temp_bytes"] + m["argument_bytes"] + m["output_bytes"]
+
+
 class StaleFenceError(RuntimeError):
     """A data-plane op from an aborted step attempt reached the runner
     after its fence advanced; the result must be discarded, not
@@ -152,6 +157,13 @@ class StageRunner:
         import threading
 
         self._lock = threading.Lock()
+        self._compile_lock = threading.Lock()
+        # AOT executables keyed by activation shape/dtype; memory_analysis
+        # of each compiled program feeds the capacity model (SURVEY §7.2:
+        # replace the reference's 4x-param-bytes heuristic,
+        # model_analyzer.py:51-58, with XLA compile-time memory analysis)
+        self._exec: dict = {}
+        self._memory: dict[str, dict] = {}
         mod = self.module
         self._x_sharding = None
         if self.devices is not None and len(self.devices) > 1:
@@ -177,6 +189,52 @@ class StageRunner:
 
         self._pol = jax.jit(pol_run)
 
+    def _aot(self, tag: str, jitted, *args):
+        """Compile-once-per-shape AOT executable. Same compile count as
+        the lazy jit path, but the Lowered->Compiled route exposes
+        ``memory_analysis()`` — the real per-program device footprint the
+        stats report and offer admission use."""
+        key = (tag,) + tuple(
+            (getattr(a, "shape", None), str(getattr(a, "dtype", "")))
+            for a in args
+        )
+        c = self._exec.get(key)
+        if c is None:
+            with self._compile_lock:
+                c = self._exec.get(key)
+                if c is None:
+                    c = jitted.lower(self.params, *args).compile()
+                    try:
+                        m = c.memory_analysis()
+                        rec = {
+                            "temp_bytes": int(m.temp_size_in_bytes),
+                            "argument_bytes": int(m.argument_size_in_bytes),
+                            "output_bytes": int(m.output_size_in_bytes),
+                            "code_bytes": int(m.generated_code_size_in_bytes),
+                        }
+                        # keep the LARGEST footprint per program across
+                        # compiled shapes — the capacity model must see the
+                        # peak, not whichever shape compiled last
+                        old = self._memory.get(tag)
+                        if old is None or _prog_total(rec) > _prog_total(old):
+                            self._memory[tag] = rec
+                    except Exception:  # noqa: BLE001 — backend-optional
+                        pass
+                    self._exec[key] = c
+        return c
+
+    def memory_stats(self) -> dict:
+        """XLA-measured footprint of the compiled stage programs (filled
+        in after first execution per shape; param bytes always known)."""
+        with self._compile_lock:  # _aot inserts from to_thread workers
+            programs = {k: dict(v) for k, v in self._memory.items()}
+        peak = max((_prog_total(m) for m in programs.values()), default=0)
+        return {
+            "param_bytes": tree_bytes(self.params),
+            "programs": programs,
+            "peak_program_bytes": peak,
+        }
+
     def forward(self, step: int, micro: int, x: np.ndarray, fence: int = 0) -> np.ndarray:
         # TP path: one host->mesh transfer straight from the numpy buffer
         # (asarray-then-device_put would copy via device 0 first)
@@ -189,7 +247,7 @@ class StageRunner:
             if fence < self.fence:
                 raise StaleFenceError(f"fence {fence} < {self.fence}")
             self.inputs[(step, micro)] = xj
-        return np.asarray(self._fwd(self.params, xj))
+        return np.asarray(self._aot("fwd", self._fwd, xj)(self.params, xj))
 
     def backward(self, step: int, micro: int, g: np.ndarray, fence: int = 0) -> np.ndarray:
         with self._lock:
@@ -201,7 +259,7 @@ class StageRunner:
             if self._x_sharding is None
             else jax.device_put(g, self._x_sharding)
         )
-        gp, gx = self._bwd(self.params, xj, gj)
+        gp, gx = self._aot("bwd", self._bwd, xj, gj)(self.params, xj, gj)
         with self._lock:
             # re-check under the lock: ABORT_STEP may have advanced the
             # fence and cleared grad_accum while the vjp ran in this
@@ -399,6 +457,14 @@ class WorkerNode(Node):
             "devices": local_device_info(),
             "training": self.training,
             "stages_loaded": len(self.stages),
+            # XLA-measured per-stage footprint (SURVEY §7.2 capacity
+            # model: compile-time memory analysis, not the reference's
+            # 4x-params guess) — param bytes immediately, program peaks
+            # once each shape has compiled
+            "stage_memory": {
+                f"{jid[:16]}:{idx}": r.memory_stats()
+                for (jid, idx), r in self.stages.items()
+            },
         }
 
     async def _h_job_offer(self, node, peer, msg) -> dict:

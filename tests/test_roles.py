@@ -643,3 +643,39 @@ async def test_job_reattach_after_master_restart():
         user = user2
     finally:
         await _teardown(user, validator, *workers)
+
+
+@pytest.mark.asyncio
+async def test_stats_report_xla_memory_analysis():
+    """Worker stats carry the XLA-measured footprint of each compiled
+    stage program (SURVEY §7.2: compile-time memory analysis replaces the
+    reference's 4x-param-bytes heuristic, model_analyzer.py:51-58)."""
+    reg, validator, workers, user, v_peer = await _setup_network(2)
+    try:
+        m, p = _model()
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer, max_stage_bytes=16 * 32 * 4 + 200,
+            micro_batches=1,
+            train={"optimizer": "sgd", "learning_rate": 0.05},
+        )
+        x = np.zeros((8, 16), np.float32)
+
+        def lg(logits, micro):
+            g = np.asarray(logits, dtype=np.float32)
+            return float(np.mean(g * g)), 2 * g / g.size
+
+        await job.train_step(x, lg)  # forces fwd+bwd compiles
+        w = workers[0]
+        stats = await validator.request(
+            validator.peers[w.node_id], {"type": "STATS_REQUEST"}
+        )
+        mem = stats["stage_memory"]
+        assert len(mem) == 1
+        entry = next(iter(mem.values()))
+        assert entry["param_bytes"] > 0
+        # fwd and bwd programs both measured, with real argument bytes
+        assert set(entry["programs"]) >= {"fwd", "bwd"}
+        assert entry["programs"]["fwd"]["argument_bytes"] > 0
+        assert entry["peak_program_bytes"] >= entry["programs"]["bwd"]["argument_bytes"]
+    finally:
+        await _teardown(user, validator, *workers)
