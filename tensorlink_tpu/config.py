@@ -71,6 +71,9 @@ class TrainConfig:
     dtype: str = "bfloat16"  # compute dtype; params stay f32
     remat: bool = False  # jax.checkpoint each stage/block
     pp_schedule: str = "gpipe"  # gpipe | 1f1b (bounded-memory interleave)
+    # weight of the MoE router load-balancing loss added to the task loss
+    # (0 = off; requires PipelineParts.block_fn_aux and pp_schedule=gpipe)
+    moe_aux_weight: float = 0.0
 
     @property
     def micro_batch_size(self) -> int:

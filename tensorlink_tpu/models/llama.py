@@ -162,20 +162,6 @@ class Llama(Module):
     def as_pipeline_parts(self, params):
         from tensorlink_tpu.parallel.engine import PipelineParts
 
-        if self.cfg_obj.moe_experts:
-            # the pipeline schedules run MoE blocks via block.apply, which
-            # discards the router's load-balancing aux loss — training
-            # works but the router is unregularized. Threading aux through
-            # the stage vjp (gpipe + 1f1b) is future work; single-host
-            # training gets it via apply_with_aux.
-            import logging
-
-            logging.getLogger("tensorlink_tpu.models").warning(
-                "MoE pipeline training drops the router aux loss; "
-                "use apply_with_aux on the single-host path for "
-                "load-balanced routing"
-            )
-
         stack = self.children["blocks"]
         block = stack.blocks()[0]
         tok_emb = self.children["tok_emb"]
@@ -198,6 +184,13 @@ class Llama(Module):
             head_fn=head_fn,
             embed_params={"tok_emb": params["tok_emb"]},
             head_params={"norm_f": params["norm_f"], "lm_head": params["lm_head"]},
+            # MoE configs: the router's load-balancing loss rides the
+            # pipeline when TrainConfig.moe_aux_weight > 0 (gpipe)
+            block_fn_aux=(
+                (lambda bp, x, rng=None: block.apply_with_aux(
+                    bp, x, rng=rng, train=rng is not None))
+                if self.cfg_obj.moe_experts else None
+            ),
         )
 
     def init_caches(self, batch: int, max_len: int, dtype=jnp.bfloat16):

@@ -55,6 +55,10 @@ class PipelineParts:
     head_fn: Callable[[Any, jax.Array, Any], jax.Array]
     embed_params: Any
     head_params: Any
+    # blocks with an auxiliary loss (MoE router load balancing):
+    # block_fn_aux(lp, x[, rng]) -> (x, aux). Used when
+    # TrainConfig.moe_aux_weight > 0 (gpipe schedule only).
+    block_fn_aux: Callable[..., Any] | None = None
 
 
 def _stacked_spec(block: Module, num_stages: int, model_axis="model"):
@@ -91,11 +95,33 @@ class ShardedTrainer:
         if cfg.pp_schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown pp_schedule {cfg.pp_schedule!r}")
         block_fn = parts.block_fn
+        block_fn_aux = parts.block_fn_aux
+        self.aux_weight = float(getattr(cfg, "moe_aux_weight", 0.0) or 0.0)
+        if self.aux_weight:
+            if block_fn_aux is None:
+                raise ValueError(
+                    "moe_aux_weight > 0 requires PipelineParts.block_fn_aux"
+                )
+            if cfg.pp_schedule != "gpipe":
+                raise NotImplementedError(
+                    "moe_aux_weight requires pp_schedule='gpipe' (the 1F1B "
+                    "hand-scheduled vjp has no router-aux channel yet)"
+                )
+        elif block_fn_aux is not None:
+            import logging
+
+            logging.getLogger("tensorlink_tpu.engine").warning(
+                "model carries an MoE aux loss but moe_aux_weight=0: the "
+                "router trains unregularized"
+            )
         # 1F1B recomputes each stage forward inside its per-micro vjp, so
         # it is remat-by-construction; checkpoint only helps GPipe
         if cfg.remat and cfg.pp_schedule == "gpipe":
             block_fn = jax.checkpoint(block_fn)
+            if block_fn_aux is not None:
+                block_fn_aux = jax.checkpoint(block_fn_aux)
         self.block_fn = block_fn
+        self.block_fn_aux = block_fn_aux
         self.seq = mesh.shape.get("seq", 1)
         ring = getattr(parts.block, "attn_impl", None) == "ring"
         if ring and cfg.pp_schedule != "gpipe":
@@ -120,6 +146,7 @@ class ShardedTrainer:
             # ring models bind the seq axis even at seq=1 so axis_index /
             # axis_size inside ring_attention_local are always in scope
             seq_axis="seq" if ring else None,
+            block_fn_aux=block_fn_aux,
         )
         sched = make_schedule(
             cfg.schedule, cfg.learning_rate, cfg.warmup_steps, cfg.total_steps
@@ -199,10 +226,15 @@ class ShardedTrainer:
         if B % m:
             raise ValueError(f"batch {B} not divisible by micro_batches {m}")
         xs = x.reshape(m, B // m, *x.shape[1:])
-        ys = self.pipeline(cast["stages"], xs, rng=r_pipe)
+        if self.aux_weight:
+            ys, aux = self.pipeline.apply_with_aux(
+                cast["stages"], xs, rng=r_pipe
+            )
+        else:
+            ys, aux = self.pipeline(cast["stages"], xs, rng=r_pipe), 0.0
         y = ys.reshape(B, *ys.shape[2:])
         out = self.parts.head_fn(cast, y, batch, rng=r_head)
-        return self.loss_fn(out, batch)
+        return self.loss_fn(out, batch) + self.aux_weight * aux
 
     def _loss_and_grads_1f1b(self, params, batch, rng):
         """Manual-gradient path: the 1F1B interleave cannot be expressed

@@ -42,14 +42,32 @@ def stage_apply(block_fn, layers_per_stage: int, stage_params, x, rng=None, laye
     layer index (layer0 + l) so dropout masks are unique per
     (micro, layer) and bitwise-reproducible across schedules — the GPipe
     Pipeline and Pipeline1F1B share THIS function so the guarantee (and
-    1F1B's backward mask-recompute) cannot silently diverge."""
+    1F1B's backward mask-recompute) cannot silently diverge.
+
+    Implemented on the aux loop with a zero aux so the two variants
+    cannot drift (XLA removes the dead accumulator)."""
+    wrapped = lambda lp, xx, *r: (block_fn(lp, xx, *r), 0.0)  # noqa: E731
+    return stage_apply_aux(
+        wrapped, layers_per_stage, stage_params, x, rng, layer0
+    )[0]
+
+
+def stage_apply_aux(
+    block_fn_aux, layers_per_stage: int, stage_params, x, rng=None, layer0=0
+):
+    """stage_apply variant for blocks with an auxiliary loss (MoE router
+    load balancing): block_fn_aux(lp, x[, rng]) -> (x, aux). Returns
+    (x, summed aux across this stage's layers). Same per-(micro, global
+    layer) rng folding as stage_apply."""
+    aux = jnp.zeros(())
     for l in range(layers_per_stage):
         lp = jax.tree.map(lambda a: a[l], stage_params)
         if rng is None:
-            x = block_fn(lp, x)
+            x, a = block_fn_aux(lp, x)
         else:
-            x = block_fn(lp, x, jax.random.fold_in(rng, layer0 + l))
-    return x
+            x, a = block_fn_aux(lp, x, jax.random.fold_in(rng, layer0 + l))
+        aux = aux + a
+    return x, aux
 
 
 def stack_stage_params(layer_params: dict, num_stages: int):
@@ -94,6 +112,9 @@ class Pipeline:
     num_stages: int
     layers_per_stage: int
     axis: str = "pipe"
+    # blocks with an auxiliary loss (MoE): block_fn_aux(lp, x[, rng]) ->
+    # (x, aux). Enables apply_with_aux; plain __call__ ignores it.
+    block_fn_aux: Callable[..., Any] | None = None
     # when set, the shard_map additionally binds this axis manually and
     # shards the activations' token dim (xs dim 2) over it — blocks then
     # run on [mb, T/seq, ...] shards and attention must be the ring impl
@@ -110,7 +131,7 @@ class Pipeline:
             self.block_fn, self.layers_per_stage, stage_params, x, rng, layer0
         )
 
-    def _shmap_fn(self, stacked_params, xs, rng):
+    def _shmap_fn(self, stacked_params, xs, rng, with_aux: bool = False):
         """Runs per pipe-shard. stacked_params leaves [1, Lps, ...];
         xs [M, mb, ...] and rng (or None) replicated over pipe."""
         S = self.num_stages
@@ -131,7 +152,7 @@ class Pipeline:
             )
 
         def tick(carry, t):
-            state, outputs = carry
+            state, outputs, aux = carry
             recv = jax.lax.ppermute(state, axis, perm) if S > 1 else state
             feed = jax.lax.dynamic_index_in_dim(
                 xs, jnp.clip(t, 0, M - 1), 0, keepdims=False
@@ -139,31 +160,45 @@ class Pipeline:
             inp = jnp.where(idx == 0, feed, recv)
             mic = jnp.clip(t - idx, 0, M - 1)  # micro processed this tick
             r = None if rng is None else jax.random.fold_in(rng, mic)
-            out = self._stage_apply(sp, inp, r, layer0)
+            if with_aux:
+                out, a = stage_apply_aux(
+                    self.block_fn_aux, self.layers_per_stage, sp, inp, r,
+                    layer0,
+                )
+                # warmup/drain ticks compute on garbage or duplicate
+                # micros — their aux must not count
+                valid = jnp.logical_and(t >= idx, t - idx <= M - 1)
+                aux = aux + jnp.where(valid, a, 0.0)
+            else:
+                out = self._stage_apply(sp, inp, r, layer0)
             out_idx = jnp.clip(t - (S - 1), 0, M - 1)
             upd = jax.lax.dynamic_update_index_in_dim(outputs, out, out_idx, 0)
             write = jnp.logical_and(t >= S - 1, idx == S - 1)
             outputs = jnp.where(write, upd, outputs)
-            return (out, outputs), None
+            return (out, outputs, aux), None
 
-        (_, outputs), _ = jax.lax.scan(
-            tick, (state, outputs), jnp.arange(M + S - 1)
+        (_, outputs, aux), _ = jax.lax.scan(
+            tick, (state, outputs, jnp.zeros(())), jnp.arange(M + S - 1)
         )
         # Only the last stage holds real outputs; broadcast over the pipe
         # axis so every shard returns the same (replicated) value.
         outputs = jax.lax.psum(
             jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs)), axis
         )
-        return outputs
+        if not with_aux:
+            return outputs
+        # every stage contributed M micro-aux terms: sum across stages,
+        # average over micros (aux is a per-batch mean-style loss); with a
+        # seq axis each shard routed a token slice — average those too
+        aux = jax.lax.psum(aux, axis) / M
+        if self.seq_axis is not None:
+            aux = jax.lax.pmean(aux, self.seq_axis)
+        return outputs, aux
 
     # -- public ----------------------------------------------------------
-    def __call__(self, stacked_params, xs, rng=None):
-        """xs: [M, micro_batch, ...] -> outputs [M, micro_batch, ...].
-
-        Differentiable; wrap in jax.jit (+ value_and_grad) at the call
-        site. Not jitted here so it can be traced inside larger programs.
-        ``rng`` enables dropout inside blocks (block_fn must then accept a
-        third rng argument)."""
+    def _run(self, stacked_params, xs, rng, with_aux: bool):
+        """Shared shard_map builder for __call__ / apply_with_aux — one
+        place for specs and axis binding so the two paths cannot drift."""
         param_specs = jax.tree.map(lambda _: P(self.axis), stacked_params)
         extra = () if rng is None else (rng,)
         axes = {self.axis}
@@ -172,14 +207,34 @@ class Pipeline:
             axes.add(self.seq_axis)
             xs_spec = P(None, None, self.seq_axis)  # [M, mb, T, ...]
         fn = jax.shard_map(
-            lambda sp_, x_, *r: self._shmap_fn(sp_, x_, r[0] if r else None),
+            lambda sp_, x_, *r: self._shmap_fn(
+                sp_, x_, r[0] if r else None, with_aux=with_aux
+            ),
             mesh=self.mesh,
             in_specs=(param_specs, xs_spec) + tuple(P() for _ in extra),
-            out_specs=xs_spec,
+            out_specs=(xs_spec, P()) if with_aux else xs_spec,
             axis_names=frozenset(axes),
             check_vma=False,
         )
         return fn(stacked_params, xs, *extra)
+
+    def __call__(self, stacked_params, xs, rng=None):
+        """xs: [M, micro_batch, ...] -> outputs [M, micro_batch, ...].
+
+        Differentiable; wrap in jax.jit (+ value_and_grad) at the call
+        site. Not jitted here so it can be traced inside larger programs.
+        ``rng`` enables dropout inside blocks (block_fn must then accept a
+        third rng argument)."""
+        return self._run(stacked_params, xs, rng, with_aux=False)
+
+    def apply_with_aux(self, stacked_params, xs, rng=None):
+        """Like __call__ but also returns the summed auxiliary loss of all
+        valid (stage, micro) applications — requires ``block_fn_aux``.
+        Differentiable: jax.grad through (outputs, aux) trains the MoE
+        router's load-balancing term inside the pipeline schedule."""
+        if self.block_fn_aux is None:
+            raise ValueError("apply_with_aux requires block_fn_aux")
+        return self._run(stacked_params, xs, rng, with_aux=True)
 
 
 def pipeline_sharding(mesh: Mesh, axis: str = "pipe") -> NamedSharding:

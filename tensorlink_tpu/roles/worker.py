@@ -158,6 +158,7 @@ class StageRunner:
 
         self._lock = threading.Lock()
         self._compile_lock = threading.Lock()
+        self._mem_lock = threading.Lock()  # guards _memory only (short)
         # AOT executables keyed by activation shape/dtype; memory_analysis
         # of each compiled program feeds the capacity model (SURVEY §7.2:
         # replace the reference's 4x-param-bytes heuristic,
@@ -192,8 +193,9 @@ class StageRunner:
     def _aot(self, tag: str, jitted, *args):
         """Compile-once-per-shape AOT executable. Same compile count as
         the lazy jit path, but the Lowered->Compiled route exposes
-        ``memory_analysis()`` — the real per-program device footprint the
-        stats report and offer admission use."""
+        ``memory_analysis()`` — the real per-program device footprint
+        surfaced through the STATS_RESPONSE report (offer admission still
+        pre-filters on param bytes: offers arrive before any compile)."""
         key = (tag,) + tuple(
             (getattr(a, "shape", None), str(getattr(a, "dtype", "")))
             for a in args
@@ -215,9 +217,10 @@ class StageRunner:
                         # keep the LARGEST footprint per program across
                         # compiled shapes — the capacity model must see the
                         # peak, not whichever shape compiled last
-                        old = self._memory.get(tag)
-                        if old is None or _prog_total(rec) > _prog_total(old):
-                            self._memory[tag] = rec
+                        with self._mem_lock:
+                            old = self._memory.get(tag)
+                            if old is None or _prog_total(rec) > _prog_total(old):
+                                self._memory[tag] = rec
                     except Exception:  # noqa: BLE001 — backend-optional
                         pass
                     self._exec[key] = c
@@ -226,7 +229,10 @@ class StageRunner:
     def memory_stats(self) -> dict:
         """XLA-measured footprint of the compiled stage programs (filled
         in after first execution per shape; param bytes always known)."""
-        with self._compile_lock:  # _aot inserts from to_thread workers
+        # _mem_lock, NOT _compile_lock: stats must never wait out an
+        # in-flight XLA compile (the async stats handler runs on the event
+        # loop; blocking it freezes heartbeats for the whole compile)
+        with self._mem_lock:
             programs = {k: dict(v) for k, v in self._memory.items()}
         peak = max((_prog_total(m) for m in programs.values()), default=0)
         return {
@@ -460,7 +466,9 @@ class WorkerNode(Node):
             # XLA-measured per-stage footprint (SURVEY §7.2 capacity
             # model: compile-time memory analysis, not the reference's
             # 4x-params guess) — param bytes immediately, program peaks
-            # once each shape has compiled
+            # once each shape has compiled. Reporting only: offer
+            # admission pre-filters on param bytes since offers precede
+            # any compile.
             "stage_memory": {
                 f"{jid[:16]}:{idx}": r.memory_stats()
                 for (jid, idx), r in self.stages.items()

@@ -164,3 +164,63 @@ def test_mixtral_style_llama_family():
     # the 8x7B config is the published Mixtral shape
     mx = LlamaConfig.mixtral_8x7b()
     assert (mx.moe_experts, mx.moe_top_k, mx.hidden_dim) == (8, 2, 14336)
+
+
+def test_moe_aux_loss_through_pipeline_engine(devices):
+    """The router load-balancing loss rides the GPipe schedule: engine
+    loss includes aux_weight * aux, aux is differentiable (router grads
+    change with the weight), and warmup/drain ticks don't inflate it."""
+    import numpy as np
+
+    from tensorlink_tpu.config import MeshConfig, TrainConfig
+    from tensorlink_tpu.models.llama import Llama, LlamaConfig
+    from tensorlink_tpu.parallel.engine import ShardedTrainer
+    from tensorlink_tpu.runtime.mesh import make_mesh
+    from tensorlink_tpu.train.trainer import softmax_cross_entropy
+
+    mesh = make_mesh(MeshConfig(pipe=2))
+    model = Llama(LlamaConfig.moe_tiny())
+    params = model.init(jax.random.key(0))
+    ids = np.random.default_rng(0).integers(0, 128, (4, 17))
+    batch = {
+        "input_ids": jnp.asarray(ids[:, :-1]),
+        "labels": jnp.asarray(ids[:, 1:]),
+    }
+
+    def loss_fn(lg, b):
+        return softmax_cross_entropy(lg, b["labels"])
+
+    losses = {}
+    for w in (0.0, 0.5):
+        parts = model.as_pipeline_parts(model.init(jax.random.key(0)))
+        assert parts.block_fn_aux is not None
+        cfg = TrainConfig(batch_size=4, micro_batches=2, learning_rate=0.0,
+                          optimizer="sgd", dtype="float32",
+                          moe_aux_weight=w)
+        tr = ShardedTrainer(mesh, cfg, parts, loss_fn)
+        state = tr.init_state()
+        _, metrics = tr.train_step(state, batch)
+        losses[w] = float(metrics["loss"])
+    # aux term is live: weighted loss strictly larger (aux > 0)
+    assert losses[0.5] > losses[0.0]
+    aux_value = (losses[0.5] - losses[0.0]) / 0.5
+    # aux is a per-batch mean over (stage, micro) router losses — same
+    # order as the single-host apply_with_aux value, not M or S times it
+    _, aux_ref = model.apply_with_aux(
+        model.init(jax.random.key(0)), batch["input_ids"], train=True,
+        rng=jax.random.key(1),
+    )
+    assert 0.2 * float(aux_ref) < aux_value < 5.0 * float(aux_ref)
+
+    # aux weight must be rejected on the 1f1b schedule (no aux channel)
+    import pytest as _pytest
+
+    parts = model.as_pipeline_parts(model.init(jax.random.key(0)))
+    with _pytest.raises(NotImplementedError, match="1F1B|1f1b"):
+        ShardedTrainer(
+            mesh,
+            TrainConfig(batch_size=4, micro_batches=2, optimizer="sgd",
+                        dtype="float32", moe_aux_weight=0.5,
+                        pp_schedule="1f1b"),
+            parts, loss_fn,
+        )
