@@ -102,6 +102,12 @@ def resolve_attn_impl(impl) -> Callable:
         from tensorlink_tpu.parallel.sp import ring_attention_impl
 
         return ring_attention_impl
+    if impl == "ulysses":
+        # sequence-parallel all_to_all head/seq swap; same shard_map
+        # requirement as "ring", but padding masks are supported
+        from tensorlink_tpu.parallel.sp import ulysses_attention_impl
+
+        return ulysses_attention_impl
     raise ValueError(f"unknown attn_impl {impl!r}")
 
 
@@ -163,7 +169,7 @@ class MultiHeadAttention(Module):
                 positions = cache["index"] + jnp.arange(T)[None, :]
         elif positions is None:
             positions = jnp.arange(T)[None, :]
-            if getattr(self, "attn_impl", None) == "ring":
+            if getattr(self, "attn_impl", None) in ("ring", "ulysses"):
                 # under sequence sharding T is the LOCAL shard length;
                 # RoPE needs global token positions
                 positions = positions + jax.lax.axis_index("seq") * T

@@ -123,20 +123,22 @@ class ShardedTrainer:
         self.block_fn = block_fn
         self.block_fn_aux = block_fn_aux
         self.seq = mesh.shape.get("seq", 1)
-        ring = getattr(parts.block, "attn_impl", None) == "ring"
+        seq_impl = getattr(parts.block, "attn_impl", None)
+        ring = seq_impl in ("ring", "ulysses")  # both need the seq axis bound
         if ring and cfg.pp_schedule != "gpipe":
-            # Pipeline1F1B binds only the pipe axis, so ring attention's
-            # axis_size("seq") would be unbound even at seq=1
+            # Pipeline1F1B binds only the pipe axis, so the seq-parallel
+            # impls' axis_size("seq") would be unbound even at seq=1
             raise NotImplementedError(
-                "attn_impl='ring' currently requires pp_schedule='gpipe' "
-                "(1F1B's shard_map does not bind the seq axis)"
+                f"attn_impl={seq_impl!r} currently requires "
+                "pp_schedule='gpipe' (1F1B's shard_map does not bind the "
+                "seq axis)"
             )
         if self.seq > 1:
             if not ring:
                 raise ValueError(
                     "mesh seq>1 shards the token dim inside the pipeline; "
-                    "build the model with attn_impl='ring' so attention "
-                    "runs the ring over the seq axis"
+                    "build the model with attn_impl='ring' or 'ulysses' "
+                    "so attention spans the full sequence over the seq axis"
                 )
         self.pipeline = Pipeline(
             mesh,
@@ -343,6 +345,67 @@ class ShardedTrainer:
     @property
     def bubble_fraction(self) -> float:
         return pipeline_bubble_fraction(self.num_stages, self.cfg.micro_batches)
+
+    def measure_bubble(self, state, batch, repeats: int = 3) -> dict:
+        """MEASURED pipeline bubble, not the closed form: time the GPipe
+        pipeline forward (the engine's forward path regardless of the
+        training schedule — 1F1B's interleave lives in its own grads-only
+        program) at M and 2M micro-batches (same per-micro shape),
+        fit ticks = a*M + b — the intercept b is the measured warmup/drain
+        overhead in tick units (ideally S-1), and
+        bubble = b / (M + b). The intercept also absorbs any fixed
+        per-call dispatch overhead, so the measured fraction is an UPPER
+        bound on the true schedule bubble (tight when tick time dominates
+        dispatch, i.e. real stages on real chips). Wall-clock is
+        synchronized with a device->host read (block_until_ready does not
+        drain the dispatch queue on tunneled runtimes)."""
+        import time as _time
+
+        m = self.cfg.micro_batches
+        cast = self._cast(state.params)
+        x = self.parts.embed_fn(cast["embed"], batch, rng=None)
+        B = x.shape[0]
+        xs1 = x.reshape(m, B // m, *x.shape[1:])
+        xs2 = jnp.concatenate([xs1, xs1], axis=0)  # 2M micros, same shape
+
+        if getattr(self, "_bubble_fn", None) is None:
+            # cached like _step_fn: a fresh jit closure per call would
+            # recompile the pipeline twice per invocation
+            self._bubble_fn = jax.jit(lambda sp, xs: self.pipeline(sp, xs))
+        run = self._bubble_fn
+
+        def timed(xs):
+            out = run(cast["stages"], xs)
+            float(jnp.sum(out[-1]).astype(jnp.float32))  # sync
+            t0 = _time.perf_counter()
+            for _ in range(repeats):
+                out = run(cast["stages"], xs)
+            float(jnp.sum(out[-1]).astype(jnp.float32))
+            return (_time.perf_counter() - t0) / repeats
+
+        t1, t2 = timed(xs1), timed(xs2)
+        # ticks(M) = M + extra; t(M) = tick_s * ticks(M). t2 <= t1 means
+        # timing noise swamped the slope — flag instead of reporting a
+        # garbage near-1.0 fraction
+        valid = t2 > t1 * 1.001
+        tick_s = (t2 - t1) / m if valid else float("nan")
+        extra_ticks = (t1 / tick_s - m) if valid else float("nan")
+        measured = (
+            extra_ticks / (m + extra_ticks)
+            if valid and extra_ticks > 0 else (0.0 if valid else float("nan"))
+        )
+        return {
+            "valid": bool(valid),
+            "schedule_timed": "gpipe",  # self.pipeline IS the GPipe path
+            "t_call_m_s": t1,
+            "t_call_2m_s": t2,
+            "tick_s": tick_s,
+            "measured_extra_ticks": extra_ticks,
+            "measured_bubble_fraction": measured,
+            "closed_form_bubble_fraction": self.bubble_fraction,
+            "num_stages": self.num_stages,
+            "micro_batches": m,
+        }
 
     def describe(self) -> dict:
         return {

@@ -145,3 +145,108 @@ def ring_attention(
         check_vma=False,
     )
     return fn(q, k, v)
+
+
+# --------------------------------------------------------------- Ulysses
+# DeepSpeed-Ulysses-style sequence parallelism (SURVEY §2.3 SP row):
+# instead of rotating K/V around a ring, TWO all_to_all collectives swap
+# the sharded dimension — tokens in, heads out — so each device computes
+# FULL-sequence attention for H/S of the heads with any off-the-shelf
+# kernel. Trade-offs vs the ring: supports padding masks (every device
+# sees all tokens), one dense collective instead of S-1 overlapped hops,
+# requires num_heads divisible by the axis size, and peak activation
+# memory is the full sequence for its head slice.
+
+
+def ulysses_attention_local(
+    q: jax.Array,  # [B, T/S, H, D] local shard
+    k: jax.Array,  # [B, T/S, Hkv, D] — GQA kept narrow when Hkv % S == 0
+    v: jax.Array,
+    *,
+    axis: str = "seq",
+    causal: bool = False,
+    mask=None,  # [B, 1, 1, T] GLOBAL (replicated) key-padding mask
+) -> jax.Array:
+    """Call INSIDE shard_map over ``axis``. all_to_all head/sequence swap,
+    full-sequence attention locally, swap back.
+
+    ``mask``, when given, must be replicated and global-length (the
+    standalone ``ulysses_attention`` entry does this); a token-sharded
+    mask shard would not broadcast against the post-swap [.., T, T]
+    logits. K/V swap at their OWN head count when it divides the axis
+    (post-swap contiguous head blocks align with GQA grouping), so GQA
+    ships Hkv/H-th the collective bytes of a pre-repeat."""
+    S = jax.lax.axis_size(axis)
+    H, Hkv = q.shape[2], k.shape[2]
+    if H % S:
+        raise ValueError(f"num_heads {H} not divisible by seq axis size {S}")
+    if Hkv != H and Hkv % S:
+        # uneven kv-head split: fall back to shipping repeated K/V
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
+
+    def swap_in(x):  # [B, T/S, h, D] -> [B, T, h/S, D]
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def swap_out(x):  # [B, T, H/S, D] -> [B, T/S, H, D]
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    if mask is None:
+        # flash path (falls back to the einsum off-TPU / short seq): the
+        # whole point of Ulysses is long context, where materializing
+        # [B, H/S, T, T] logits is exactly the blowup to avoid
+        from tensorlink_tpu.ops.flash import flash_attention_impl as attn
+    else:
+        from tensorlink_tpu.nn.attention import dot_product_attention as attn
+    out = attn(swap_in(q), swap_in(k), swap_in(v), causal=causal, mask=mask)
+    return swap_out(out)
+
+
+def ulysses_attention_impl(q, k, v, *, causal=False, mask=None, q_offset=0, **_):
+    """Drop-in ``attn_impl`` ("ulysses") for MultiHeadAttention inside a
+    shard_map binding the ``seq`` axis. KV caches are not supported
+    (decode runs unsharded), and neither are masks on THIS in-pipeline
+    path — a per-token mask arriving here would be a local shard, which
+    cannot be applied to the post-swap full-sequence logits. Global
+    padding masks work through the standalone ``ulysses_attention`` entry,
+    which replicates the mask across the axis."""
+    if not (isinstance(q_offset, int) and q_offset == 0):
+        raise NotImplementedError("ulysses attention does not support caches")
+    if mask is not None:
+        raise NotImplementedError(
+            "in-pipeline ulysses attention cannot apply a token-sharded "
+            "mask; use the standalone ulysses_attention entry"
+        )
+    return ulysses_attention_local(q, k, v, axis="seq", causal=causal)
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, T, H, D] global
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "seq",
+    causal: bool = False,
+    mask=None,
+):
+    """Global entry: shards the T dim over ``axis`` and runs the
+    all_to_all swap. The (optional) key-padding mask is replicated — every
+    device applies it over the full sequence after the swap.
+    Differentiable; jit at the call site."""
+    has_mask = mask is not None
+    seq_spec = P(None, axis)
+    fn = jax.shard_map(
+        lambda q_, k_, v_, *m_: ulysses_attention_local(
+            q_, k_, v_, axis=axis, causal=causal,
+            mask=m_[0] if m_ else None,
+        ),
+        mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec) + ((P(),) if has_mask else ()),
+        out_specs=seq_spec,
+        axis_names=frozenset({axis}),
+        check_vma=False,
+    )
+    return fn(q, k, v, *((mask,) if has_mask else ()))
