@@ -72,7 +72,8 @@ class TrainConfig:
     remat: bool = False  # jax.checkpoint each stage/block
     pp_schedule: str = "gpipe"  # gpipe | 1f1b (bounded-memory interleave)
     # weight of the MoE router load-balancing loss added to the task loss
-    # (0 = off; requires PipelineParts.block_fn_aux and pp_schedule=gpipe)
+    # (0 = off; requires PipelineParts.block_fn_aux; works under both
+    # pipeline schedules)
     moe_aux_weight: float = 0.0
 
     @property

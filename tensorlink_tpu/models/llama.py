@@ -185,7 +185,7 @@ class Llama(Module):
             embed_params={"tok_emb": params["tok_emb"]},
             head_params={"norm_f": params["norm_f"], "lm_head": params["lm_head"]},
             # MoE configs: the router's load-balancing loss rides the
-            # pipeline when TrainConfig.moe_aux_weight > 0 (gpipe)
+            # pipeline when TrainConfig.moe_aux_weight > 0 (both schedules)
             block_fn_aux=(
                 (lambda bp, x, rng=None: block.apply_with_aux(
                     bp, x, rng=rng, train=rng is not None))

@@ -57,7 +57,7 @@ class PipelineParts:
     head_params: Any
     # blocks with an auxiliary loss (MoE router load balancing):
     # block_fn_aux(lp, x[, rng]) -> (x, aux). Used when
-    # TrainConfig.moe_aux_weight > 0 (gpipe schedule only).
+    # TrainConfig.moe_aux_weight > 0; both pipeline schedules carry it.
     block_fn_aux: Callable[..., Any] | None = None
 
 
@@ -101,11 +101,6 @@ class ShardedTrainer:
             if block_fn_aux is None:
                 raise ValueError(
                     "moe_aux_weight > 0 requires PipelineParts.block_fn_aux"
-                )
-            if cfg.pp_schedule != "gpipe":
-                raise NotImplementedError(
-                    "moe_aux_weight requires pp_schedule='gpipe' (the 1F1B "
-                    "hand-scheduled vjp has no router-aux channel yet)"
                 )
         elif block_fn_aux is not None:
             import logging
@@ -286,6 +281,8 @@ class ShardedTrainer:
             self.num_stages,
             self.layers_per_stage,
             head_loss,
+            block_fn_aux=self.block_fn_aux,
+            aux_weight=self.aux_weight,
         )
         loss, gsp, gaux, dxs = pipe.train_grads(
             cast_stages, cast_aux, xs, micro_batches, rng=r_pipe

@@ -135,6 +135,11 @@ class Pipeline1F1B:
     layers_per_stage: int
     head_loss: Callable[[Any, jax.Array, Any], jax.Array]
     axis: str = "pipe"
+    # MoE router aux loss: each stage's aux contribution is LOCAL to its
+    # per-micro vjp — the aux output simply gets cotangent aux_weight, so
+    # the hand-scheduled interleave needs no extra channel at all
+    block_fn_aux: Callable[..., Any] | None = None
+    aux_weight: float = 0.0
 
     def _stage_apply(self, stage_params, x, rng=None, layer0=0):
         # shared with the GPipe Pipeline so the (micro, global-layer) rng
@@ -145,6 +150,18 @@ class Pipeline1F1B:
         return stage_apply(
             self.block_fn, self.layers_per_stage, stage_params, x, rng, layer0
         )
+
+    def _stage_apply_aux(self, stage_params, x, rng=None, layer0=0):
+        from tensorlink_tpu.parallel.pp import stage_apply_aux
+
+        return stage_apply_aux(
+            self.block_fn_aux, self.layers_per_stage, stage_params, x, rng,
+            layer0,
+        )
+
+    @property
+    def _use_aux(self) -> bool:
+        return self.block_fn_aux is not None and bool(self.aux_weight)
 
     # -- per-device program --------------------------------------------
     def _shmap_fn(self, stacked_params, aux_params, xs, micro_batches, rng):
@@ -210,28 +227,57 @@ class Pipeline1F1B:
                 # head+loss folded into the last stage's vjp: the
                 # cotangent of a scalar loss is 1.0, so backward can start
                 # the moment this micro's forward lands — the property
-                # that makes 1F1B possible at all
+                # that makes 1F1B possible at all. With MoE aux, the
+                # stage's router loss folds into the same scalar.
                 def fx(sp_, aux_, x_):
-                    y = self._stage_apply(sp_, x_, micro_rng(mic_i), layer0)
+                    if self._use_aux:
+                        y, a = self._stage_apply_aux(
+                            sp_, x_, micro_rng(mic_i), layer0
+                        )
+                        extra = jnp.float32(self.aux_weight) * a.astype(
+                            jnp.float32
+                        )
+                    else:
+                        y = self._stage_apply(sp_, x_, micro_rng(mic_i), layer0)
+                        extra = jnp.zeros((), jnp.float32)
                     return self.head_loss(
                         aux_, y, mb, head_rng(mic_i)
-                    ).astype(jnp.float32)
+                    ).astype(jnp.float32) + extra
 
                 loss, vjp = jax.vjp(fx, sp, aux_params, x)
                 gsp_i, gaux_i, gx = vjp(jnp.ones((), jnp.float32))
                 return loss, gsp_i, gaux_i, gx
 
             def mid_fn():
-                y, vjp = jax.vjp(
-                    lambda sp_, x_: self._stage_apply(
-                        sp_, x_, micro_rng(mic_i), layer0
-                    ),
-                    sp,
-                    x,
-                )
-                gsp_i, gx = vjp(gy)
+                if self._use_aux:
+                    # vjp through (y, aux) with cotangents (gy, aux_weight):
+                    # the router-loss gradient of THIS stage's layers rides
+                    # the same local recompute, no cross-stage traffic
+                    (y, a), vjp = jax.vjp(
+                        lambda sp_, x_: self._stage_apply_aux(
+                            sp_, x_, micro_rng(mic_i), layer0
+                        ),
+                        sp,
+                        x,
+                    )
+                    gsp_i, gx = vjp(
+                        (gy, jnp.asarray(self.aux_weight, a.dtype))
+                    )
+                    loss_i = (
+                        jnp.float32(self.aux_weight) * a.astype(jnp.float32)
+                    )
+                else:
+                    y, vjp = jax.vjp(
+                        lambda sp_, x_: self._stage_apply(
+                            sp_, x_, micro_rng(mic_i), layer0
+                        ),
+                        sp,
+                        x,
+                    )
+                    gsp_i, gx = vjp(gy)
+                    loss_i = jnp.zeros((), jnp.float32)
                 return (
-                    jnp.zeros((), jnp.float32),
+                    loss_i,
                     gsp_i,
                     jax.tree.map(jnp.zeros_like, aux_params),
                     gx,

