@@ -76,15 +76,48 @@ def peak_tflops_for(device_kind: str) -> float | None:
     return None
 
 
+def _backend_probe(timeout_s: float = 120.0) -> tuple[bool, str]:
+    """Touch the backend in a SUBPROCESS with a timeout: a degraded
+    tunnel can make jax.devices() (or the first device op) block forever
+    in a C call that no in-process retry can interrupt — observed r3, a
+    ~40 min tunnel outage hung the bench with 0 CPU. The probe is
+    disposable; only a responsive backend lets the real run proceed."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "jax.devices();"
+             "print(float(jnp.sum(jnp.ones((8, 8)))))"],
+            timeout=timeout_s, capture_output=True,
+        )
+        if r.returncode == 0:
+            return True, ""
+        # surface the child's actual error — 'tunnel down' must not mask
+        # a broken install / held device / OOM
+        return False, (r.stderr or b"").decode(errors="replace")[-300:]
+    except subprocess.TimeoutExpired:
+        return False, f"probe timed out after {timeout_s:.0f}s"
+
+
 def backend_with_retry(attempts: int = 4, delay_s: float = 10.0):
     """Initialize the accelerator backend, retrying transient tunnel
-    failures ('Unable to initialize backend'); returns jax.devices().
+    failures ('Unable to initialize backend') AND hangs (subprocess
+    probe); returns jax.devices().
 
     The round-1 bench died rc=1 on a single flaky backend init
     (BENCH_r01.json). Bounded retry, then a clear JSON error.
     """
     last = None
     for i in range(attempts):
+        final = i == attempts - 1
+        ok, why = _backend_probe()
+        if not ok:
+            last = RuntimeError(f"backend probe failed: {why}")
+            if not final:  # no point sleeping into the error exit
+                time.sleep(delay_s * (i + 1))
+            continue
         try:
             return jax.devices()
         except RuntimeError as e:  # jax raises RuntimeError on backend init
@@ -97,7 +130,8 @@ def backend_with_retry(attempts: int = 4, delay_s: float = 10.0):
                 _jeb.clear_backends()
             except Exception:
                 pass
-            time.sleep(delay_s * (i + 1))
+            if not final:
+                time.sleep(delay_s * (i + 1))
     print(
         json.dumps(
             {
@@ -260,6 +294,50 @@ def main() -> None:
         fl2 = xla2 if xla2 else analytic_step_flops(st2.params, cfg2, b512, s512)
         out["seq512_samples_per_sec_per_chip"] = round(b512 * sps2, 2)
         out["seq512_mfu"] = round(fl2 * sps2 / 1e12 / peak, 4) if peak else None
+
+    # -- secondary: KV-cache decode throughput (BASELINE.json names
+    # sharded inference as a north-star config; this is the single-chip
+    # engine measurement). Failure-tolerant: a decode-path problem must
+    # not sink the headline metric.
+    if os.environ.get("BENCH_DECODE", "1") == "1" and _BERT == "base":
+        try:
+            from tensorlink_tpu.config import MeshConfig
+            from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config
+            from tensorlink_tpu.parallel.inference import (
+                GenerationConfig,
+                InferenceEngine,
+            )
+            from tensorlink_tpu.runtime.mesh import make_mesh
+
+            B, P, N = 8, 32, 64
+            gcfg = GPT2Config()  # small (124M)
+            gmodel = GPT2(gcfg)
+            # engine casts params to bf16 itself; max_len sized to the
+            # workload — the default 2048 would attend over (and allocate)
+            # 20x the cache slots actually used, measuring mask overhead
+            # instead of decode throughput
+            eng = InferenceEngine(
+                make_mesh(MeshConfig()), gmodel,
+                gmodel.init(jax.random.key(0)), max_len=P + N,
+            )
+            r = np.random.default_rng(0)
+            pids = jnp.asarray(r.integers(0, gcfg.vocab_size, (B, P)))
+            gen = GenerationConfig(max_new_tokens=N)
+            toks = eng.generate(pids, gen)
+            int(np.asarray(toks)[0, -1])  # sync (compile + first call)
+            t0 = time.perf_counter()
+            reps = 3
+            for _ in range(reps):
+                toks = eng.generate(pids, gen)
+            int(np.asarray(toks)[0, -1])
+            dt = (time.perf_counter() - t0) / reps
+            out["decode_tokens_per_sec"] = round(B * N / dt, 1)
+            out["decode_config"] = (
+                f"GPT-2 small bf16 KV-cache, batch {B}, prompt {P}, "
+                f"{N} new tokens"
+            )
+        except Exception as e:  # noqa: BLE001
+            out["decode_error"] = str(e)[:200]
 
     base = read_recorded_baseline()
     out["vs_baseline"] = round(samples_per_sec_per_chip / base, 3) if base else 1.0
