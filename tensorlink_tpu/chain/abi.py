@@ -98,17 +98,44 @@ def _decode_static(typ: str, word: bytes):
 
 def _decode_one(typ: str, data: bytes, at: int):
     """Decode one dynamic value whose data begins at `at`."""
+    if at + _WORD > len(data):
+        raise ValueError(
+            f"truncated returndata: dynamic {typ} head at {at} past "
+            f"{len(data)} bytes"
+        )
     if typ.endswith("[]"):
         elem = typ[:-2]
         n = int.from_bytes(data[at:at + _WORD], "big")
-        return decode([elem] * n, data[at + _WORD:])
+        body = data[at + _WORD:]
+        # check n against the remaining bytes BEFORE [elem] * n — a
+        # garbage count would otherwise allocate a 2**256-entry list
+        if n * _WORD > len(body):
+            raise ValueError(
+                f"truncated returndata: {typ} declares {n} elements, "
+                f"{len(body)} bytes remain"
+            )
+        return decode([elem] * n, body)
     length = int.from_bytes(data[at:at + _WORD], "big")
     raw = data[at + _WORD:at + _WORD + length]
+    if len(raw) < length:
+        raise ValueError(
+            f"truncated returndata: {typ} declares {length} bytes, "
+            f"{len(raw)} present"
+        )
     return raw.decode("utf-8") if typ == "string" else raw
 
 
 def decode(types: list[str], data: bytes) -> list:
-    """ABI-decode a flat result list (the inverse of `encode`)."""
+    """ABI-decode a flat result list (the inverse of `encode`).
+
+    Length-checked: a wrong contract returning short/garbage non-empty
+    data must raise, not silently decode to zeros (advisor finding r3 —
+    int.from_bytes of a short slice yields a bogus value)."""
+    if len(data) < _WORD * len(types):
+        raise ValueError(
+            f"truncated returndata: {len(types)} head words need "
+            f"{_WORD * len(types)} bytes, got {len(data)}"
+        )
     out = []
     for i, typ in enumerate(types):
         word = data[_WORD * i:_WORD * (i + 1)]

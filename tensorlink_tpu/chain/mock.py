@@ -105,6 +105,10 @@ class MockChainServer:
             return "0x" + self.contract.execute(calldata).hex()
         if method == "eth_sendTransaction":
             tx = params[0]
+            # same unknown-contract check as eth_call: a misconfigured
+            # --chain-contract must fail on the write path too (advisor r3)
+            if tx["to"].lower() != CONTRACT_ADDRESS:
+                raise ValueError("unknown contract")
             calldata = bytes.fromhex(tx["data"][2:])
             self.contract.execute(calldata)
             return "0x" + keccak256(calldata).hex()

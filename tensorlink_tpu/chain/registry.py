@@ -82,6 +82,19 @@ class Web3Registry(Registry):
             )
         return out
 
+    def _read(self, name: str, ret_types: list[str], types: list[str],
+              values: list) -> list:
+        """eth_call + decode; decode failures (truncated/garbage
+        returndata from a wrong contract) surface as ChainError so both
+        symptoms of a misconfigured address share one exception type."""
+        out = self._call(name, types, values)
+        try:
+            return abi.decode(ret_types, out)
+        except ValueError as e:
+            raise ChainError(
+                f"{name}: undecodable returndata from {self.contract}: {e}"
+            ) from e
+
     def _transact(self, name: str, types: list[str], values: list) -> str:
         # mark the cached view stale (next read refetches) but KEEP it for
         # is_validator_local — nulling it would fail-close the event-loop
@@ -103,7 +116,7 @@ class Web3Registry(Registry):
         self._transact("deregisterValidator", ["string"], [node_id])
 
     def validator_count(self) -> int:
-        [count] = abi.decode(["uint256"], self._call("validatorCount", [], []))
+        [count] = self._read("validatorCount", ["uint256"], [], [])
         return count
 
     def list_validators(self) -> list[ValidatorEntry]:
@@ -112,8 +125,8 @@ class Web3Registry(Registry):
             return list(self._cache)
         entries = []
         for i in range(self.validator_count()):
-            node_id, host, port, rep_milli, registered_at = abi.decode(
-                _VALIDATOR_AT_RETURNS, self._call("validatorAt", ["uint256"], [i])
+            node_id, host, port, rep_milli, registered_at = self._read(
+                "validatorAt", _VALIDATOR_AT_RETURNS, ["uint256"], [i]
             )
             entries.append(
                 ValidatorEntry(
@@ -131,9 +144,7 @@ class Web3Registry(Registry):
         if cached is not None and time.monotonic() - self._cache_at < self.cache_ttl:
             if any(e.info.node_id == node_id for e in cached):
                 return True
-        [ok] = abi.decode(
-            ["bool"], self._call("isValidator", ["string"], [node_id])
-        )
+        [ok] = self._read("isValidator", ["bool"], ["string"], [node_id])
         return ok
 
     def is_validator_local(self, node_id: str) -> bool:

@@ -152,14 +152,20 @@ class Node:
             # failure below still unmaps on stop (indefinite leases would
             # otherwise outlive the node on the router)
             self._upnp_gateway = gw
-            self.external_ip = await asyncio.to_thread(gw.external_ip)
-            self._lan_ip = gw.local_ip  # the address the router forwards to
+            # the address the router forwards to — set BEFORE the
+            # external-IP query so a partial failure (mapping active,
+            # external IP unknown) still advertises a dialable LAN address
+            self._lan_ip = gw.local_ip
+            # warn about a loopback bind BEFORE the external-IP query: the
+            # mapping is live either way, and this is the diagnostic that
+            # matters when forwarded traffic gets refused
             if self.cfg.host.startswith("127.") or self.cfg.host == "localhost":
                 self.log.warning(
                     "UPnP mapping forwards to %s but this node binds only "
                     "%s — forwarded traffic will be refused; bind 0.0.0.0 "
                     "or the LAN address", gw.local_ip, self.cfg.host,
                 )
+            self.external_ip = await asyncio.to_thread(gw.external_ip)
             self.log.info(
                 "UPnP mapped %s:%s -> %s:%s",
                 self.external_ip, self.port, gw.local_ip, self.port,
@@ -167,7 +173,19 @@ class Node:
         except Exception as e:  # noqa: BLE001 — best-effort by contract:
             # a node on a cluster or public IP needs no mapping, and a
             # malformed/hostile LAN responder must not kill node start
-            self.log.warning("UPnP unavailable (%s); continuing unmapped", e)
+            if getattr(self, "_upnp_gateway", None) is not None:
+                # AddPortMapping succeeded, only the external-IP query
+                # failed: the router mapping IS active (and will be torn
+                # down on stop) — saying "unmapped" would mislead an
+                # operator debugging reachability (advisor r3)
+                self.log.warning(
+                    "UPnP mapping active but external-IP query failed "
+                    "(%s); external address unknown", e,
+                )
+            else:
+                self.log.warning(
+                    "UPnP unavailable (%s); continuing unmapped", e
+                )
 
     async def _teardown_upnp(self) -> None:
         gw = getattr(self, "_upnp_gateway", None)

@@ -99,9 +99,10 @@ class DistributedJob:
         self.validator = validator  # for elastic re-recruitment
         self.plan = plan
         # worker-to-worker activation relay (SURVEY §2.4 stage-to-stage
-        # transfer): default ON for clear jobs with a real chain; the
-        # obfuscated path must stay hub-and-spoke — the plan's secret
-        # rotations between stages are applied by the MASTER only.
+        # transfer): default ON for every clear (non-obfuscated) job,
+        # chain-backed or not; the obfuscated path must stay
+        # hub-and-spoke — the plan's secret rotations between stages are
+        # applied by the MASTER only.
         self.relay = (plan is None) if relay is None else relay
         if self.relay and plan is not None:
             raise ValueError("relay transfer is incompatible with obfuscation")

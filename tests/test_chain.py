@@ -56,6 +56,24 @@ def test_abi_address_validation():
         abi.encode(["address"], ["0x1234"])  # not 20 bytes
 
 
+def test_abi_decode_truncated_raises():
+    """Short/garbage returndata must raise, not decode to zeros (a wrong
+    contract would otherwise yield bogus validator entries silently)."""
+    types = ["uint256", "string"]
+    enc = abi.encode(types, [7, "hello nodes"])
+    with pytest.raises(ValueError):  # head region cut short
+        abi.decode(types, enc[:40])
+    with pytest.raises(ValueError):  # tail (string body) cut short
+        abi.decode(types, enc[:100])  # 4 of the 11 string bytes remain
+    with pytest.raises(ValueError):  # dynamic head offset past the data
+        abi.decode(["string"], (2**20).to_bytes(32, "big"))
+    # garbage array count must raise before allocating a 2**256 list
+    bad = abi.encode(["uint256[]"], [[1, 2]])
+    bad = bad[:32] + (2**200).to_bytes(32, "big") + bad[64:]
+    with pytest.raises(ValueError):
+        abi.decode(["uint256[]"], bad)
+
+
 # ------------------------------------------------------------- mock JSON-RPC
 @pytest.fixture()
 def chain():
@@ -150,6 +168,15 @@ def test_web3_registry_empty_returndata_is_error(chain):
     reg = Web3Registry(chain.url, "0x" + "00" * 20, cache_ttl=0.0)
     with pytest.raises(ChainError):
         reg.validator_count()
+
+
+def test_web3_registry_wrong_contract_write_is_error(chain):
+    """The WRITE path (eth_sendTransaction) must reject an unknown
+    contract address just like eth_call (advisor r3: a misconfigured
+    address executed on the mock contract anyway)."""
+    reg = Web3Registry(chain.url, "0x" + "00" * 20, cache_ttl=0.0)
+    with pytest.raises(ChainError):
+        reg.register_validator(_info(0))
 
 
 def test_web3_registry_local_check_is_cache_only(chain):
