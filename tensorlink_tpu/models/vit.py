@@ -204,6 +204,9 @@ def vit_pipeline_parts(model: ViT, params: dict, num_classes_head=None):
             blk_p, x, rng=rng, train=rng is not None
         ),
         head_fn=head_fn,
+        # the classifier head pools the CLS patch — position-selective,
+        # not a uniform token reduction (same as BERT's CLS pooling)
+        head_per_token=False if num_classes_head is not None else None,
         embed_params={
             "patch": vp["patch"],
             "cls_token": vp["cls_token"],

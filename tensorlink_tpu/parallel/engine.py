@@ -59,6 +59,19 @@ class PipelineParts:
     # block_fn_aux(lp, x[, rng]) -> (x, aux). Used when
     # TrainConfig.moe_aux_weight > 0; both pipeline schedules carry it.
     block_fn_aux: Callable[..., Any] | None = None
+    # per-batch auxiliary inputs for the blocks (e.g. the attention
+    # padding mask): extras_fn(batch) -> pytree with leading [B, ...]
+    # leaves, or None. The engine reslices it per micro and hands it to
+    # every stage REPLICATED (under seq sharding the mask stays global,
+    # which is what lets ring/ulysses apply padding); block_fn /
+    # block_fn_aux must then accept a fourth argument.
+    extras_fn: Callable[[Any], Any] | None = None
+    # whether head_fn + loss reduce UNIFORMLY over token positions
+    # (e.g. causal-LM mean CE). Required True for 1F1B at mesh seq>1,
+    # where head_loss runs per token shard and results are pmean'd — a
+    # position-selective head (BERT's CLS pooling) would silently pool
+    # the wrong token on shards > 0. None = unknown = rejected there.
+    head_per_token: bool | None = None
 
 
 def _stacked_spec(block: Module, num_stages: int, model_axis="model"):
@@ -82,11 +95,37 @@ class ShardedTrainer:
         loss_fn: Callable[[jax.Array, Any], jax.Array],
         embed_module: Module | None = None,
         head_module: Module | None = None,
+        loss_reduction: str = "uniform_mean",
     ):
+        """``loss_reduction`` declares how loss_fn reduces over the batch:
+
+        - "uniform_mean": a plain unweighted mean over examples (and, for
+          per-token losses, tokens) — every schedule supported.
+        - "batch_normalized": normalized by a per-BATCH quantity (e.g.
+          mean over the batch's non-pad tokens). GPipe applies loss_fn
+          once over the full batch, so this is fine there; 1F1B averages
+          per-micro losses, which would SILENTLY differ (pp1f1b.py class
+          docstring) — so 1F1B rejects it up front instead.
+        """
         self.mesh = mesh
         self.cfg = cfg
         self.parts = parts
         self.loss_fn = loss_fn
+        if loss_reduction not in ("uniform_mean", "batch_normalized"):
+            raise ValueError(
+                f"unknown loss_reduction {loss_reduction!r}; declare "
+                "'uniform_mean' or 'batch_normalized'"
+            )
+        if loss_reduction == "batch_normalized" and cfg.pp_schedule == "1f1b":
+            raise ValueError(
+                "pp_schedule='1f1b' computes the batch loss as the "
+                "unweighted mean of per-micro losses, which differs from "
+                "a per-batch-normalized loss (e.g. mean over the batch's "
+                "non-pad tokens). Use pp_schedule='gpipe' (loss_fn runs "
+                "once over the full batch there) or renormalize per "
+                "example and declare loss_reduction='uniform_mean'."
+            )
+        self.loss_reduction = loss_reduction
         self.num_stages = mesh.shape["pipe"]
         L = len(parts.block_params)
         if L % self.num_stages:
@@ -120,14 +159,6 @@ class ShardedTrainer:
         self.seq = mesh.shape.get("seq", 1)
         seq_impl = getattr(parts.block, "attn_impl", None)
         ring = seq_impl in ("ring", "ulysses")  # both need the seq axis bound
-        if ring and cfg.pp_schedule != "gpipe":
-            # Pipeline1F1B binds only the pipe axis, so the seq-parallel
-            # impls' axis_size("seq") would be unbound even at seq=1
-            raise NotImplementedError(
-                f"attn_impl={seq_impl!r} currently requires "
-                "pp_schedule='gpipe' (1F1B's shard_map does not bind the "
-                "seq axis)"
-            )
         if self.seq > 1:
             if not ring:
                 raise ValueError(
@@ -135,14 +166,28 @@ class ShardedTrainer:
                     "build the model with attn_impl='ring' or 'ulysses' "
                     "so attention spans the full sequence over the seq axis"
                 )
+            if cfg.pp_schedule == "1f1b" and parts.head_per_token is not True:
+                # under seq sharding 1F1B runs head_loss per token shard
+                # and pmeans — a position-selective head (CLS pooling)
+                # silently pools the wrong token on shards > 0
+                raise NotImplementedError(
+                    "pp_schedule='1f1b' with mesh seq>1 requires "
+                    "PipelineParts.head_per_token=True (a head+loss that "
+                    "reduces uniformly over token positions, e.g. "
+                    "causal-LM mean CE); this model's parts declare "
+                    f"head_per_token={parts.head_per_token!r}. Use "
+                    "pp_schedule='gpipe', whose head runs on the "
+                    "re-assembled full sequence."
+                )
+        # ring models bind the seq axis even at seq=1 so axis_index /
+        # axis_size inside ring_attention_local are always in scope
+        self._seq_axis = "seq" if ring else None
         self.pipeline = Pipeline(
             mesh,
             block_fn,
             self.num_stages,
             self.layers_per_stage,
-            # ring models bind the seq axis even at seq=1 so axis_index /
-            # axis_size inside ring_attention_local are always in scope
-            seq_axis="seq" if ring else None,
+            seq_axis=self._seq_axis,
             block_fn_aux=block_fn_aux,
         )
         sched = make_schedule(
@@ -210,6 +255,17 @@ class ShardedTrainer:
             params,
         )
 
+    def _micro_extras(self, batch, m: int):
+        """extras_fn output resliced to [M, mb, ...] leaves (or None)."""
+        if self.parts.extras_fn is None:
+            return None
+        ex = self.parts.extras_fn(batch)
+        if ex is None:
+            return None
+        return jax.tree.map(
+            lambda a: a.reshape(m, a.shape[0] // m, *a.shape[1:]), ex
+        )
+
     def _loss(self, params, batch, rng):
         """rng=None -> eval mode (no dropout anywhere)."""
         cfg = self.cfg
@@ -223,12 +279,14 @@ class ShardedTrainer:
         if B % m:
             raise ValueError(f"batch {B} not divisible by micro_batches {m}")
         xs = x.reshape(m, B // m, *x.shape[1:])
+        extras = self._micro_extras(batch, m)
         if self.aux_weight:
             ys, aux = self.pipeline.apply_with_aux(
-                cast["stages"], xs, rng=r_pipe
+                cast["stages"], xs, rng=r_pipe, extras=extras
             )
         else:
-            ys, aux = self.pipeline(cast["stages"], xs, rng=r_pipe), 0.0
+            ys = self.pipeline(cast["stages"], xs, rng=r_pipe, extras=extras)
+            aux = 0.0
         y = ys.reshape(B, *ys.shape[2:])
         out = self.parts.head_fn(cast, y, batch, rng=r_head)
         return self.loss_fn(out, batch) + self.aux_weight * aux
@@ -283,9 +341,11 @@ class ShardedTrainer:
             head_loss,
             block_fn_aux=self.block_fn_aux,
             aux_weight=self.aux_weight,
+            seq_axis=self._seq_axis,
         )
         loss, gsp, gaux, dxs = pipe.train_grads(
-            cast_stages, cast_aux, xs, micro_batches, rng=r_pipe
+            cast_stages, cast_aux, xs, micro_batches, rng=r_pipe,
+            extras=self._micro_extras(batch, m),
         )
         (dembed,) = embed_vjp(dxs.astype(xs.dtype))
         grads = {
@@ -343,31 +403,39 @@ class ShardedTrainer:
     def bubble_fraction(self) -> float:
         return pipeline_bubble_fraction(self.num_stages, self.cfg.micro_batches)
 
-    def measure_bubble(self, state, batch, repeats: int = 3) -> dict:
+    def measure_bubble(
+        self, state, batch, repeats: int = 3, factors: tuple = (1, 2, 3)
+    ) -> dict:
         """MEASURED pipeline bubble, not the closed form: time the GPipe
         pipeline forward (the engine's forward path regardless of the
         training schedule — 1F1B's interleave lives in its own grads-only
-        program) at M and 2M micro-batches (same per-micro shape),
-        fit ticks = a*M + b — the intercept b is the measured warmup/drain
-        overhead in tick units (ideally S-1), and
-        bubble = b / (M + b). The intercept also absorbs any fixed
-        per-call dispatch overhead, so the measured fraction is an UPPER
-        bound on the true schedule bubble (tight when tick time dominates
-        dispatch, i.e. real stages on real chips). Wall-clock is
-        synchronized with a device->host read (block_until_ready does not
-        drain the dispatch queue on tunneled runtimes)."""
+        program) at k*M micro-batches for each k in ``factors`` (same
+        per-micro shape), least-squares fit t = tick_s * (micros + extra):
+        the intercept ``extra`` is the measured warmup/drain overhead in
+        tick units (ideally S-1), and bubble = extra / (M + extra).
+
+        Multi-point LSQ instead of the round-3 two-point fit: on a noisy
+        host a single pair put all variance into the intercept
+        (MULTICHIP_r03 recorded 0.78 vs closed-form 0.20 from exactly
+        this). The intercept still absorbs fixed per-call dispatch, so
+        the fraction is an UPPER bound on the true schedule bubble —
+        tight when tick time dominates dispatch; r2 of the fit is
+        reported so a noise-dominated measurement is visible. Wall-clock
+        is synchronized with a device->host read (block_until_ready does
+        not drain the dispatch queue on tunneled runtimes)."""
         import time as _time
+
+        import numpy as _np
 
         m = self.cfg.micro_batches
         cast = self._cast(state.params)
         x = self.parts.embed_fn(cast["embed"], batch, rng=None)
         B = x.shape[0]
         xs1 = x.reshape(m, B // m, *x.shape[1:])
-        xs2 = jnp.concatenate([xs1, xs1], axis=0)  # 2M micros, same shape
 
         if getattr(self, "_bubble_fn", None) is None:
             # cached like _step_fn: a fresh jit closure per call would
-            # recompile the pipeline twice per invocation
+            # recompile the pipeline per invocation
             self._bubble_fn = jax.jit(lambda sp, xs: self.pipeline(sp, xs))
         run = self._bubble_fn
 
@@ -380,13 +448,20 @@ class ShardedTrainer:
             float(jnp.sum(out[-1]).astype(jnp.float32))
             return (_time.perf_counter() - t0) / repeats
 
-        t1, t2 = timed(xs1), timed(xs2)
-        # ticks(M) = M + extra; t(M) = tick_s * ticks(M). t2 <= t1 means
-        # timing noise swamped the slope — flag instead of reporting a
-        # garbage near-1.0 fraction
-        valid = t2 > t1 * 1.001
-        tick_s = (t2 - t1) / m if valid else float("nan")
-        extra_ticks = (t1 / tick_s - m) if valid else float("nan")
+        micros = _np.asarray([k * m for k in factors], _np.float64)
+        times = _np.asarray(
+            [timed(jnp.concatenate([xs1] * k, axis=0)) for k in factors]
+        )
+        # LSQ t = tick_s * micros + c; extra = c / tick_s
+        A = _np.stack([micros, _np.ones_like(micros)], axis=1)
+        (tick_s, c), res, *_ = _np.linalg.lstsq(A, times, rcond=None)
+        ss_tot = float(((times - times.mean()) ** 2).sum())
+        r2 = 1.0 - float(res[0]) / ss_tot if len(res) and ss_tot > 0 else 0.0
+        # a 2-point or rank-deficient fit has empty residuals — that is
+        # the confident-garbage failure mode this rewrite exists to flag,
+        # never a valid measurement
+        valid = tick_s > 0 and len(micros) >= 3 and len(res) == 1 and r2 > 0.95
+        extra_ticks = c / tick_s if valid else float("nan")
         measured = (
             extra_ticks / (m + extra_ticks)
             if valid and extra_ticks > 0 else (0.0 if valid else float("nan"))
@@ -394,11 +469,12 @@ class ShardedTrainer:
         return {
             "valid": bool(valid),
             "schedule_timed": "gpipe",  # self.pipeline IS the GPipe path
-            "t_call_m_s": t1,
-            "t_call_2m_s": t2,
-            "tick_s": tick_s,
-            "measured_extra_ticks": extra_ticks,
-            "measured_bubble_fraction": measured,
+            "micros_timed": [int(v) for v in micros],
+            "times_s": [float(t) for t in times],
+            "fit_r2": r2,
+            "tick_s": float(tick_s),
+            "measured_extra_ticks": float(extra_ticks),
+            "measured_bubble_fraction": float(measured),
             "closed_form_bubble_fraction": self.bubble_fraction,
             "num_stages": self.num_stages,
             "micro_batches": m,

@@ -63,7 +63,15 @@ class InferenceEngine:
     ):
         self.mesh = mesh
         self.model = model
+        # the user-facing bound stays EXACTLY max_len (a model's position
+        # table may end there — generating past it would gather out of
+        # range); only the CACHE allocation rounds up to a DECODE_BLOCK
+        # multiple so decode runs the length-bounded blockwise attention
+        # (nn/attention.py), whose per-token cost tracks the live prefix
+        from tensorlink_tpu.nn.attention import DECODE_BLOCK
+
         self.max_len = max_len
+        self.cache_len = -(-max_len // DECODE_BLOCK) * DECODE_BLOCK
         self.cache_dtype = cache_dtype
         self.data_axis = data_axis
         self.model_axis = model_axis
@@ -100,7 +108,7 @@ class InferenceEngine:
         """One jitted program: prefill + lax.scan decode. Retraced per
         (batch, prompt_len, generation config) — cached across calls."""
         model = self.model
-        L = self.max_len
+        L = self.cache_len  # cache capacity (block-rounded >= max_len)
         temperature, top_k = float(gen.temperature), int(gen.top_k)
         max_new = int(gen.max_new_tokens)
         eos = gen.eos_token_id

@@ -35,7 +35,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tensorlink_tpu.runtime.metrics import pipeline_bubble_fraction
 
 
-def stage_apply(block_fn, layers_per_stage: int, stage_params, x, rng=None, layer0=0):
+def stage_apply(
+    block_fn, layers_per_stage: int, stage_params, x, rng=None, layer0=0,
+    extras=None,
+):
     """Apply one stage's layers_per_stage blocks (static loop).
 
     ``rng`` is a per-micro-batch key; each layer folds in its GLOBAL
@@ -44,28 +47,36 @@ def stage_apply(block_fn, layers_per_stage: int, stage_params, x, rng=None, laye
     Pipeline and Pipeline1F1B share THIS function so the guarantee (and
     1F1B's backward mask-recompute) cannot silently diverge.
 
+    ``extras`` is this micro's auxiliary input pytree (e.g. a replicated
+    attention mask); when given, block_fn is called as
+    ``block_fn(lp, x, rng, extras)`` — rng may be None in that form.
+
     Implemented on the aux loop with a zero aux so the two variants
     cannot drift (XLA removes the dead accumulator)."""
     wrapped = lambda lp, xx, *r: (block_fn(lp, xx, *r), 0.0)  # noqa: E731
     return stage_apply_aux(
-        wrapped, layers_per_stage, stage_params, x, rng, layer0
+        wrapped, layers_per_stage, stage_params, x, rng, layer0, extras
     )[0]
 
 
 def stage_apply_aux(
-    block_fn_aux, layers_per_stage: int, stage_params, x, rng=None, layer0=0
+    block_fn_aux, layers_per_stage: int, stage_params, x, rng=None, layer0=0,
+    extras=None,
 ):
     """stage_apply variant for blocks with an auxiliary loss (MoE router
-    load balancing): block_fn_aux(lp, x[, rng]) -> (x, aux). Returns
-    (x, summed aux across this stage's layers). Same per-(micro, global
-    layer) rng folding as stage_apply."""
+    load balancing): block_fn_aux(lp, x[, rng[, extras]]) -> (x, aux).
+    Returns (x, summed aux across this stage's layers). Same per-(micro,
+    global layer) rng folding as stage_apply."""
     aux = jnp.zeros(())
     for l in range(layers_per_stage):
         lp = jax.tree.map(lambda a: a[l], stage_params)
-        if rng is None:
+        r = None if rng is None else jax.random.fold_in(rng, layer0 + l)
+        if extras is not None:
+            x, a = block_fn_aux(lp, x, r, extras)
+        elif rng is None:
             x, a = block_fn_aux(lp, x)
         else:
-            x, a = block_fn_aux(lp, x, jax.random.fold_in(rng, layer0 + l))
+            x, a = block_fn_aux(lp, x, r)
         aux = aux + a
     return x, aux
 
@@ -126,14 +137,16 @@ class Pipeline:
         return lambda m: pipeline_bubble_fraction(self.num_stages, m)
 
     # -- per-device program --------------------------------------------
-    def _stage_apply(self, stage_params, x, rng=None, layer0=0):
+    def _stage_apply(self, stage_params, x, rng=None, layer0=0, extras=None):
         return stage_apply(
-            self.block_fn, self.layers_per_stage, stage_params, x, rng, layer0
+            self.block_fn, self.layers_per_stage, stage_params, x, rng,
+            layer0, extras,
         )
 
-    def _shmap_fn(self, stacked_params, xs, rng, with_aux: bool = False):
+    def _shmap_fn(self, stacked_params, xs, rng, extras, with_aux: bool = False):
         """Runs per pipe-shard. stacked_params leaves [1, Lps, ...];
-        xs [M, mb, ...] and rng (or None) replicated over pipe."""
+        xs [M, mb, ...], rng and extras (leaves [M, ...], or None)
+        replicated over pipe."""
         S = self.num_stages
         axis = self.axis
         idx = jax.lax.axis_index(axis)
@@ -160,17 +173,26 @@ class Pipeline:
             inp = jnp.where(idx == 0, feed, recv)
             mic = jnp.clip(t - idx, 0, M - 1)  # micro processed this tick
             r = None if rng is None else jax.random.fold_in(rng, mic)
+            ex = (
+                None if extras is None
+                else jax.tree.map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, mic, 0, keepdims=False
+                    ),
+                    extras,
+                )
+            )
             if with_aux:
                 out, a = stage_apply_aux(
                     self.block_fn_aux, self.layers_per_stage, sp, inp, r,
-                    layer0,
+                    layer0, ex,
                 )
                 # warmup/drain ticks compute on garbage or duplicate
                 # micros — their aux must not count
                 valid = jnp.logical_and(t >= idx, t - idx <= M - 1)
                 aux = aux + jnp.where(valid, a, 0.0)
             else:
-                out = self._stage_apply(sp, inp, r, layer0)
+                out = self._stage_apply(sp, inp, r, layer0, ex)
             out_idx = jnp.clip(t - (S - 1), 0, M - 1)
             upd = jax.lax.dynamic_update_index_in_dim(outputs, out, out_idx, 0)
             write = jnp.logical_and(t >= S - 1, idx == S - 1)
@@ -196,45 +218,63 @@ class Pipeline:
         return outputs, aux
 
     # -- public ----------------------------------------------------------
-    def _run(self, stacked_params, xs, rng, with_aux: bool):
+    def _run(self, stacked_params, xs, rng, extras, with_aux: bool):
         """Shared shard_map builder for __call__ / apply_with_aux — one
         place for specs and axis binding so the two paths cannot drift."""
         param_specs = jax.tree.map(lambda _: P(self.axis), stacked_params)
-        extra = () if rng is None else (rng,)
+        has_rng = rng is not None
         axes = {self.axis}
         xs_spec = P()
         if self.seq_axis is not None:
             axes.add(self.seq_axis)
             xs_spec = P(None, None, self.seq_axis)  # [M, mb, T, ...]
+        # extras (e.g. attention masks) are replicated over every bound
+        # axis — under seq sharding that is exactly what lets a GLOBAL
+        # mask reach every token shard
+        ex_specs = (
+            () if extras is None
+            else (jax.tree.map(lambda _: P(), extras),)
+        )
+        rng_specs = (P(),) if has_rng else ()
         fn = jax.shard_map(
-            lambda sp_, x_, *r: self._shmap_fn(
-                sp_, x_, r[0] if r else None, with_aux=with_aux
+            lambda sp_, x_, *rest: self._shmap_fn(
+                sp_, x_,
+                rest[0] if has_rng else None,
+                (rest[1] if has_rng else rest[0]) if extras is not None else None,
+                with_aux=with_aux,
             ),
             mesh=self.mesh,
-            in_specs=(param_specs, xs_spec) + tuple(P() for _ in extra),
+            in_specs=(param_specs, xs_spec) + rng_specs + ex_specs,
             out_specs=(xs_spec, P()) if with_aux else xs_spec,
             axis_names=frozenset(axes),
             check_vma=False,
         )
-        return fn(stacked_params, xs, *extra)
+        args = (stacked_params, xs)
+        if has_rng:
+            args += (rng,)
+        if extras is not None:
+            args += (extras,)
+        return fn(*args)
 
-    def __call__(self, stacked_params, xs, rng=None):
+    def __call__(self, stacked_params, xs, rng=None, extras=None):
         """xs: [M, micro_batch, ...] -> outputs [M, micro_batch, ...].
 
         Differentiable; wrap in jax.jit (+ value_and_grad) at the call
         site. Not jitted here so it can be traced inside larger programs.
         ``rng`` enables dropout inside blocks (block_fn must then accept a
-        third rng argument)."""
-        return self._run(stacked_params, xs, rng, with_aux=False)
+        third rng argument). ``extras`` (leaves [M, ...]) are per-micro
+        auxiliary inputs handed to every stage — block_fn must then take a
+        fourth argument."""
+        return self._run(stacked_params, xs, rng, extras, with_aux=False)
 
-    def apply_with_aux(self, stacked_params, xs, rng=None):
+    def apply_with_aux(self, stacked_params, xs, rng=None, extras=None):
         """Like __call__ but also returns the summed auxiliary loss of all
         valid (stage, micro) applications — requires ``block_fn_aux``.
         Differentiable: jax.grad through (outputs, aux) trains the MoE
         router's load-balancing term inside the pipeline schedule."""
         if self.block_fn_aux is None:
             raise ValueError("apply_with_aux requires block_fn_aux")
-        return self._run(stacked_params, xs, rng, with_aux=True)
+        return self._run(stacked_params, xs, rng, extras, with_aux=True)
 
 
 def pipeline_sharding(mesh: Mesh, axis: str = "pipe") -> NamedSharding:
