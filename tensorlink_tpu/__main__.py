@@ -56,6 +56,33 @@ def _add_node_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dht-snapshot", default=None, metavar="PATH",
                    help="persist DHT state to PATH periodically (and "
                         "restore from it on start)")
+    # multi-HOST mesh formation (SURVEY §2.4/§5.8): all processes of one
+    # slice join a single JAX runtime; jax.devices() then spans hosts and
+    # ShardedTrainer programs compile over the global mesh
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="jax.distributed coordinator (process 0's "
+                        "address); omit for single-host")
+    p.add_argument("--num-processes", type=int, default=None,
+                   help="total processes in the multi-host slice "
+                        "(TPU pods can infer this; set explicitly on CPU)")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this process's index in the slice")
+
+
+def _maybe_init_distributed(args) -> None:
+    if not getattr(args, "coordinator", None):
+        return
+    from tensorlink_tpu.config import DistributedConfig
+    from tensorlink_tpu.runtime.mesh import initialize_distributed
+
+    info = initialize_distributed(DistributedConfig(
+        coordinator=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    ))
+    print(f"joined multi-host runtime: process {info['process_id']}/"
+          f"{info['num_processes']}, {info['global_devices']} global / "
+          f"{info['local_devices']} local devices")
 
 
 async def _run_role(role: str, args) -> None:
@@ -64,6 +91,7 @@ async def _run_role(role: str, args) -> None:
     from tensorlink_tpu.roles.validator import ValidatorNode
     from tensorlink_tpu.roles.worker import WorkerNode
 
+    _maybe_init_distributed(args)
     cls = {"worker": WorkerNode, "validator": ValidatorNode, "user": UserNode}[role]
     kw = {}
     if role == "validator" and not getattr(args, "chain_url", None):

@@ -50,6 +50,36 @@ class MeshConfig:
 
 
 @dataclass(frozen=True)
+class DistributedConfig:
+    """Multi-HOST mesh formation (SURVEY §2.4/§5.8: jax.distributed +
+    gRPC coordination over DCN, the road to a v4-32-style pod slice).
+
+    One SPMD program spans every process: each host contributes its
+    local chips and `jax.distributed.initialize` joins them into one
+    global device set, from which `make_mesh` builds the (data, pipe,
+    model, seq) mesh. The reference's analogue is its whole multi-machine
+    premise (socket workers, src/p2p/smart_node.py:490-537) — here the
+    DATA plane is one compiled program and only job control rides the
+    P2P overlay.
+
+    ``coordinator`` is "host:port" of process 0. ``num_processes`` and
+    ``process_id`` may be None when the platform supplies them (TPU pod
+    metadata); on CPU/manual deployments set them explicitly.
+    """
+
+    coordinator: str | None = None  # None = single-process (no init)
+    num_processes: int | None = None
+    process_id: int | None = None
+    # bound local devices per host (None = all; CPU tests use
+    # xla_force_host_platform_device_count instead)
+    local_device_ids: tuple | None = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.coordinator is not None
+
+
+@dataclass(frozen=True)
 class TrainConfig:
     """Training hyperparameters + micro-batching.
 
@@ -147,6 +177,7 @@ class FrameworkConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     node: NodeConfig = field(default_factory=NodeConfig)
+    distributed: DistributedConfig = field(default_factory=DistributedConfig)
 
     # ------------------------------------------------------------------
     # (De)serialization — configs travel inside job records on the wire.
@@ -156,10 +187,14 @@ class FrameworkConfig:
 
     @classmethod
     def from_dict(cls, d: Mapping[str, Any]) -> "FrameworkConfig":
+        dist = dict(d.get("distributed", {}))
+        if dist.get("local_device_ids") is not None:
+            dist["local_device_ids"] = tuple(dist["local_device_ids"])
         return cls(
             mesh=MeshConfig(**d.get("mesh", {})),
             train=TrainConfig(**d.get("train", {})),
             node=NodeConfig(**d.get("node", {})),
+            distributed=DistributedConfig(**dist),
         )
 
     def to_json(self) -> str:

@@ -17,7 +17,53 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tensorlink_tpu.config import MeshConfig
+from tensorlink_tpu.config import DistributedConfig, MeshConfig
+
+_distributed_initialized = False
+
+
+def initialize_distributed(cfg: DistributedConfig) -> dict:
+    """Join this process into one multi-HOST JAX runtime (SURVEY
+    §2.4/§5.8: jax.distributed + gRPC coordination over DCN).
+
+    After this returns, ``jax.devices()`` is the GLOBAL device set of
+    every participating process, and ``make_mesh`` over it yields one
+    mesh whose SPMD programs span hosts — collectives ride ICI within a
+    host/slice and DCN across, inserted by XLA from the same shardings
+    as the single-host path. No-op (with a report) when the config is
+    single-process or this process already initialized.
+
+    Returns a summary dict {enabled, process_id, num_processes,
+    global_devices, local_devices} for logs/status endpoints.
+    """
+    global _distributed_initialized
+    if not cfg.enabled:
+        return {"enabled": False}
+    if not _distributed_initialized:
+        kw = {}
+        if cfg.num_processes is not None:
+            kw["num_processes"] = cfg.num_processes
+        if cfg.process_id is not None:
+            kw["process_id"] = cfg.process_id
+        if cfg.local_device_ids is not None:
+            kw["local_device_ids"] = list(cfg.local_device_ids)
+        jax.distributed.initialize(cfg.coordinator, **kw)
+        _distributed_initialized = True
+    return {
+        "enabled": True,
+        "process_id": jax.process_index(),
+        "num_processes": jax.process_count(),
+        "global_devices": len(jax.devices()),
+        "local_devices": len(jax.local_devices()),
+    }
+
+
+def shutdown_distributed() -> None:
+    """Leave the multi-process runtime (tests spawn several in a row)."""
+    global _distributed_initialized
+    if _distributed_initialized:
+        jax.distributed.shutdown()
+        _distributed_initialized = False
 
 
 def make_mesh(cfg: MeshConfig, devices: Sequence[jax.Device] | None = None) -> Mesh:
