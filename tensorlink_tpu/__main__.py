@@ -52,7 +52,16 @@ def _add_node_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--key-dir", default=None,
                    help="persistent identity dir (ephemeral when omitted)")
     p.add_argument("--bootstrap", default=None, metavar="HOST:PORT",
-                   help="validator to join via")
+                   help="validator to join via (overrides the registry "
+                        "auto-join when --chain-url is also given)")
+    p.add_argument("--chain-url", default=None,
+                   help="EVM JSON-RPC endpoint: validators register on "
+                        "the contract; workers/users auto-join by "
+                        "sampling it (no --bootstrap needed)")
+    p.add_argument("--chain-contract", default=None,
+                   help="registry contract address (0x...)")
+    p.add_argument("--chain-sender", default=None,
+                   help="from-address for node-managed transactions")
     p.add_argument("--dht-snapshot", default=None, metavar="PATH",
                    help="persist DHT state to PATH periodically (and "
                         "restore from it on start)")
@@ -104,6 +113,17 @@ async def _run_role(role: str, args) -> None:
     if args.bootstrap:
         host, port = args.bootstrap.rsplit(":", 1)
         validator_peer = await node.connect(host, int(port))
+    elif role != "validator" and args.chain_url:
+        # registry auto-join: sample validators from the contract and
+        # dial (reference smart_node.py:539-585) — --chain-url suffices
+        from tensorlink_tpu.chain import Web3Registry
+
+        validator_peer = await node.bootstrap_from_registry(
+            Web3Registry(args.chain_url, args.chain_contract)
+        )
+        if validator_peer is None:
+            print("registry bootstrap found no reachable validator; "
+                  "running unconnected (will accept inbound peers)")
     node.start_heartbeat()
     if role == "user" and getattr(args, "resume_dir", None):
         if validator_peer is None:
@@ -210,14 +230,8 @@ def main(argv: list[str] | None = None) -> int:
     sub = ap.add_subparsers(dest="cmd", required=True)
     for role in ("worker", "validator", "user"):
         sp = sub.add_parser(role, help=f"run a {role} node")
-        _add_node_args(sp)
-        if role == "validator":
-            sp.add_argument("--chain-url", default=None,
-                            help="EVM JSON-RPC endpoint (chain-backed registry)")
-            sp.add_argument("--chain-contract", default=None,
-                            help="registry contract address (0x...)")
-            sp.add_argument("--chain-sender", default=None,
-                            help="from-address for node-managed transactions")
+        _add_node_args(sp)  # includes --chain-* (validator: registry
+        # membership; worker/user: contract auto-join)
         if role == "worker":
             sp.add_argument(
                 "--stage-tp-devices", type=int, default=1,

@@ -99,8 +99,13 @@ class Sequential(Module):
             self.child(str(i), l)
 
     def apply(self, params, x, **kwargs):
+        rng = kwargs.pop("rng", None)
         for i, layer in enumerate(self.layers):
-            x = layer.apply(params[str(i)], x, **kwargs)
+            # per-layer rng fold (same scheme as TransformerStack):
+            # passing one key to every layer would draw bitwise-identical
+            # dropout masks in each of them
+            r = None if rng is None else jax.random.fold_in(rng, i)
+            x = layer.apply(params[str(i)], x, rng=r, **kwargs)
         return x
 
     def __getitem__(self, idx) -> Module | "Sequential":

@@ -160,3 +160,21 @@ class MoEFeedForward(Module):
     def apply(self, params, x, *, rng=None, train=False, **_):
         out, _ = self.apply_with_aux(params, x, rng=rng, train=train)
         return out
+
+    def routing_stats(self, params, x) -> dict:
+        """Router telemetry for benchmarks/monitoring: the fraction of
+        (token, choice) routes dropped by the capacity limit (their
+        residual path carries the token unchanged) and the aux loss.
+        dispatch sums to the KEPT route count, so
+        drop = 1 - sum(dispatch) / (B*T*top_k)."""
+        B, T, _ = x.shape
+        logits = x.astype(jnp.float32) @ params["router"]["w"].astype(
+            jnp.float32
+        )
+        dispatch, _, aux = self._route(logits)
+        kept = float(jnp.sum(dispatch))
+        return {
+            "drop_fraction": 1.0 - kept / (B * T * self.top_k),
+            "aux_loss": float(aux),
+            "capacity_per_expert": self.capacity(T),
+        }

@@ -339,6 +339,51 @@ class Node:
             f"all candidates failed for :{port} ({[host, *alt_hosts]})"
         ) from last
 
+    async def bootstrap_from_registry(self, registry, k: int = 6):
+        """Auto-join the overlay from a validator registry (typically the
+        chain contract): sample up to ``k`` validators and dial each —
+        candidate addresses in order, identity pinned to the registered
+        node_id — until one handshakes. The reference joins exactly this
+        way, sampling the contract and dialing (smart_node.py:539-585);
+        with this, ``--chain-url`` alone suffices and ``--bootstrap`` is
+        an override, not a requirement.
+
+        Returns the connected validator Peer, or None when the registry
+        is empty or every candidate fails (callers may retry later —
+        an empty contract is a young network, not an error).
+        """
+        try:
+            entries = await asyncio.to_thread(registry.sample_validators, k)
+        except Exception as e:  # noqa: BLE001 — chain RPC may be down
+            self.log.warning("registry bootstrap: sampling failed: %s", e)
+            return None
+        for e in entries:
+            info = e.info
+            if info.node_id == self.node_id:
+                continue
+            try:
+                peer = await self.connect_candidates(
+                    info.host, info.port,
+                    tuple(getattr(info, "alt_hosts", ()) or ()),
+                    expect_id=info.node_id,
+                )
+            except (ConnectionError, OSError, asyncio.TimeoutError) as err:
+                self.log.info(
+                    "registry bootstrap: validator %s at %s:%s unreachable: %s",
+                    info.node_id[:8], info.host, info.port, err,
+                )
+                continue
+            self.log.info(
+                "registry bootstrap: joined via validator %s",
+                peer.node_id[:8],
+            )
+            return peer
+        self.log.warning(
+            "registry bootstrap: no reachable validator among %d sampled",
+            len(entries),
+        )
+        return None
+
     # ------------------------------------------------------------ handshake
     async def connect(
         self, host: str, port: int, expect_id: str | None = None

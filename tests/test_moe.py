@@ -248,3 +248,24 @@ def test_moe_aux_loss_through_pipeline_engine(devices):
             ls.append(float(mets["loss"]))
         traj[sched] = ls
     np.testing.assert_allclose(traj["gpipe"], traj["1f1b"], rtol=1e-4)
+
+
+def test_routing_stats_drop_fraction():
+    """Router telemetry: drop fraction is 0 with ample capacity and
+    rises when capacity forces drops; kept routes match dispatch mass."""
+    import numpy as np
+
+    from tensorlink_tpu.nn.moe import MoEFeedForward
+
+    r = np.random.default_rng(0)
+    x = jnp.asarray(r.standard_normal((2, 32, 16)), jnp.float32)
+    roomy = MoEFeedForward(16, 32, num_experts=4, top_k=2,
+                           capacity_factor=8.0)
+    p = roomy.init(jax.random.key(0))
+    st = roomy.routing_stats(p, x)
+    assert st["drop_fraction"] == pytest.approx(0.0)
+    tight = MoEFeedForward(16, 32, num_experts=4, top_k=2,
+                           capacity_factor=0.25)
+    st2 = tight.routing_stats(p, x)  # same params: capacity is the knob
+    assert 0.0 < st2["drop_fraction"] < 1.0
+    assert st2["capacity_per_expert"] < st["capacity_per_expert"]
