@@ -1,0 +1,18 @@
+"""Input pipeline: sharded, deterministic, device-prefetching data loading.
+
+The reference has no input pipeline at all — its e2e test iterates a HF
+dataset in a plain Python loop on the master
+(/root/reference/tests/ml/test_full_train.py:56-175), which on TPU would
+leave the chip idle during every host batch-assembly + H2D transfer. Here:
+
+- `ShardedLoader`: deterministic seeded shuffling, drop-remainder
+  batching, and PER-PROCESS sharding (jax.process_index/count aware) so
+  every host of a multi-host mesh reads only its slice of the global
+  batch — the loader is the data-side half of the jax.distributed story.
+- `prefetch_to_device`: double-buffered H2D transfer so the next batch
+  is already on device (with its target sharding) when the step ends.
+"""
+
+from tensorlink_tpu.data.loader import ShardedLoader, prefetch_to_device
+
+__all__ = ["ShardedLoader", "prefetch_to_device"]

@@ -1,0 +1,183 @@
+"""Sharded batch loader + device prefetch (see package docstring).
+
+Design notes (TPU-first):
+
+- Static shapes: batches are drop-remainder so every step compiles once.
+- Determinism: the epoch permutation derives from (seed, epoch) via
+  numpy's PCG64 — the same dataset + seed yields the same order on every
+  process and across restarts (resume mid-training re-derives it).
+- Multi-host: with a global batch size B and P processes, each process
+  assembles only its B/P examples (its rows of the global batch); the
+  global array is formed by `jax.make_array_from_process_local_data`,
+  so no host ever materializes (or ships) another host's shard — the
+  analogue of the per-replica DataLoader the reference never built.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Callable, Iterator, Mapping, Sequence
+
+import jax
+import numpy as np
+
+
+class ShardedLoader:
+    """Iterates {name: np.ndarray} batches over an array-backed dataset.
+
+    ``data`` maps column names to equal-length arrays (the whole dataset,
+    host-resident — the working set of the reference's flagship workloads
+    fits in RAM; back ``data`` with np.memmap for larger corpora).
+
+    One iteration of the loader is one epoch of the LOCAL shard; use
+    ``epochs(n)`` or re-iterate for more. Batches are the PROCESS-LOCAL
+    slice of the global batch (global_batch // process_count rows).
+    """
+
+    def __init__(
+        self,
+        data: Mapping[str, np.ndarray],
+        global_batch: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_remainder: bool = True,
+        process_index: int | None = None,
+        process_count: int | None = None,
+        transform: Callable[[dict], dict] | None = None,
+    ):
+        if not data:
+            raise ValueError("empty dataset")
+        lens = {k: len(v) for k, v in data.items()}
+        if len(set(lens.values())) != 1:
+            raise ValueError(f"column lengths differ: {lens}")
+        self.data = {k: np.asarray(v) for k, v in data.items()}
+        self.n = next(iter(lens.values()))
+        self.global_batch = int(global_batch)
+        self.shuffle = shuffle
+        self.seed = int(seed)
+        self.drop_remainder = drop_remainder
+        self.transform = transform
+        self.pid = jax.process_index() if process_index is None else process_index
+        self.pcount = (
+            jax.process_count() if process_count is None else process_count
+        )
+        if self.global_batch % self.pcount:
+            raise ValueError(
+                f"global_batch {self.global_batch} not divisible by "
+                f"process_count {self.pcount}"
+            )
+        self.local_batch = self.global_batch // self.pcount
+        if not drop_remainder:
+            raise NotImplementedError(
+                "static shapes only: a ragged final batch would retrace "
+                "the step program; pad the dataset instead"
+            )
+        self._epoch = 0
+
+    def __len__(self) -> int:
+        return self.n // self.global_batch
+
+    def set_epoch(self, epoch: int) -> None:
+        """Resume support: the (seed, epoch) pair fully determines the
+        permutation, so a restarted run at epoch k sees the same order."""
+        self._epoch = int(epoch)
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(self.n)
+        return np.random.default_rng((self.seed, epoch)).permutation(self.n)
+
+    def __iter__(self) -> Iterator[dict]:
+        order = self._epoch_order(self._epoch)
+        self._epoch += 1
+        steps = self.n // self.global_batch
+        for s in range(steps):
+            g0 = s * self.global_batch
+            # this process's rows of the global batch: contiguous block
+            # [pid*local : (pid+1)*local] — matches the row-major layout
+            # make_array_from_process_local_data expects
+            idx = order[
+                g0 + self.pid * self.local_batch:
+                g0 + (self.pid + 1) * self.local_batch
+            ]
+            batch = {k: v[idx] for k, v in self.data.items()}
+            yield self.transform(batch) if self.transform else batch
+
+    def epochs(self, n: int) -> Iterator[dict]:
+        for _ in range(n):
+            yield from self
+
+
+def prefetch_to_device(
+    it: Iterator[dict],
+    sharding: Any,
+    *,
+    size: int = 2,
+) -> Iterator[Any]:
+    """Double-buffered host->device pipeline: while the step consumes
+    batch i, batch i+1 is already transferring (and i+2 assembling on a
+    worker thread). ``sharding`` is the target jax.sharding.Sharding of
+    every leaf — under multi-host it must describe the GLOBAL batch, and
+    each process's local rows become its addressable shards.
+
+    The H2D transfer itself is issued from the consumer thread (jax
+    dislikes cross-thread transfers onto donated buffers); the worker
+    thread only hides the host-side batch assembly + any transform.
+    """
+    if size < 1:
+        raise ValueError("prefetch size must be >= 1")
+    multihost = jax.process_count() > 1
+
+    def put(batch: dict):
+        if multihost:
+            return jax.tree.map(
+                lambda a: jax.make_array_from_process_local_data(sharding, a),
+                batch,
+            )
+        return jax.device_put(batch, sharding)
+
+    q: collections.deque = collections.deque()
+    lock = threading.Lock()
+    have = threading.Semaphore(0)
+    space = threading.Semaphore(size)
+    stop = threading.Event()  # consumer abandoned: unblock + end producer
+    _END = object()
+
+    def producer():
+        try:
+            for b in it:
+                # poll so an abandoned consumer can't strand us on a full
+                # queue holding the dataset alive for the process lifetime
+                while not space.acquire(timeout=0.1):
+                    if stop.is_set():
+                        return
+                if stop.is_set():
+                    return
+                with lock:
+                    q.append(b)
+                have.release()
+            with lock:
+                q.append(_END)
+        except BaseException as e:  # noqa: BLE001 — surfaced to consumer
+            # a dying producer must fail the training loop, not hang it
+            with lock:
+                q.append(("__error__", e))
+        have.release()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            have.acquire()
+            with lock:
+                b = q.popleft()
+            space.release()
+            if b is _END:
+                return
+            if isinstance(b, tuple) and len(b) == 2 and b[0] == "__error__":
+                raise b[1]
+            yield put(b)
+    finally:
+        stop.set()

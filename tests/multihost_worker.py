@@ -23,7 +23,7 @@ def main() -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
-    import jax.numpy as jnp
+
     import numpy as np
 
     from tensorlink_tpu.config import DistributedConfig, MeshConfig, TrainConfig
@@ -54,14 +54,21 @@ def main() -> int:
     tr = ShardedTrainer(mesh, cfg, parts, lambda lg, b: softmax_cross_entropy(
         lg, b["labels"]))
     state = tr.init_state()
+    # the data pipeline is multi-host too: each process's ShardedLoader
+    # yields only ITS rows of the global batch, and prefetch_to_device
+    # assembles the global array from process-local shards — no host
+    # ever holds another host's data
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tensorlink_tpu.data import ShardedLoader, prefetch_to_device
+
     r = np.random.default_rng(0)
-    ids = r.integers(0, 128, (8, 17))
-    batch = {
-        "input_ids": jnp.asarray(ids[:, :-1]),
-        "labels": jnp.asarray(ids[:, 1:]),
-    }
+    ids = r.integers(0, 128, (16, 17))
+    ds = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    loader = ShardedLoader(ds, global_batch=8, seed=0)  # process-aware
+    sh = NamedSharding(mesh, P(("data",)))
     losses = []
-    for _ in range(2):
+    for batch in prefetch_to_device(loader.epochs(1), sh):
         state, m = tr.train_step(state, batch)
         losses.append(float(m["loss"]))
     print(json.dumps({"process": pid, "losses": losses}), flush=True)

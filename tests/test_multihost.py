@@ -15,7 +15,6 @@ import subprocess
 import sys
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -50,14 +49,15 @@ def _single_process_reference(devices):
     tr = ShardedTrainer(mesh, cfg, parts, lambda lg, b: softmax_cross_entropy(
         lg, b["labels"]))
     state = tr.init_state()
+    from tensorlink_tpu.data import ShardedLoader
+
     r = np.random.default_rng(0)
-    ids = r.integers(0, 128, (8, 17))
-    batch = {
-        "input_ids": jnp.asarray(ids[:, :-1]),
-        "labels": jnp.asarray(ids[:, 1:]),
-    }
+    ids = r.integers(0, 128, (16, 17))
+    ds = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    loader = ShardedLoader(ds, global_batch=8, seed=0,
+                           process_index=0, process_count=1)
     losses = []
-    for _ in range(2):
+    for batch in loader.epochs(1):
         state, m = tr.train_step(state, batch)
         losses.append(float(m["loss"]))
     return losses
