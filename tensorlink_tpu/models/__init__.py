@@ -3,3 +3,4 @@ from tensorlink_tpu.models.bert import Bert, BertClassifier, BertConfig  # noqa:
 from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config  # noqa: F401
 from tensorlink_tpu.models.vit import ViT, ViTClassifier, ViTConfig  # noqa: F401
 from tensorlink_tpu.models.llama import Llama, LlamaConfig  # noqa: F401
+from tensorlink_tpu.models.t5 import T5, T5Config  # noqa: F401

@@ -259,6 +259,73 @@ def llama_params_from_hf(sd: Mapping[str, np.ndarray], cfg: "LlamaConfig") -> di
     return _to_jnp(p)
 
 
+def t5_params_from_hf(sd: Mapping[str, np.ndarray], cfg) -> dict:
+    """Map an HF T5ForConditionalGeneration state dict onto the native
+    `T5` param tree (models/t5.py). The FF is the shared FeedForward:
+    v1.0 maps HF `wi` -> `up`; v1.1 gated maps HF `wi_0` (the activated
+    branch) -> `gate` and `wi_1` (the linear multiplier) -> `up`."""
+
+    def block(side: str, i: int, cross: bool) -> dict:
+        pre = f"{side}.block.{i}.layer."
+        ff_l = 2 if cross else 1
+        ffp = pre + f"{ff_l}.DenseReluDense."
+        out: dict = {
+            "norm1": {"scale": _a(sd[pre + "0.layer_norm.weight"])},
+            "attn": {
+                "q": {"w": _t(sd[pre + "0.SelfAttention.q.weight"])},
+                "k": {"w": _t(sd[pre + "0.SelfAttention.k.weight"])},
+                "v": {"w": _t(sd[pre + "0.SelfAttention.v.weight"])},
+                "o": {"w": _t(sd[pre + "0.SelfAttention.o.weight"])},
+            },
+            "norm2": {"scale": _a(sd[pre + f"{ff_l}.layer_norm.weight"])},
+            "ff": (
+                {
+                    "gate": {"w": _t(sd[ffp + "wi_0.weight"])},
+                    "up": {"w": _t(sd[ffp + "wi_1.weight"])},
+                    "down": {"w": _t(sd[ffp + "wo.weight"])},
+                    "drop": {},
+                }
+                if cfg.gated_ff
+                else {
+                    "up": {"w": _t(sd[ffp + "wi.weight"])},
+                    "down": {"w": _t(sd[ffp + "wo.weight"])},
+                    "drop": {},
+                }
+            ),
+            "drop": {},
+        }
+        if cross:
+            out["norm_x"] = {"scale": _a(sd[pre + "1.layer_norm.weight"])}
+            out["xattn"] = {
+                "q": {"w": _t(sd[pre + "1.EncDecAttention.q.weight"])},
+                "k": {"w": _t(sd[pre + "1.EncDecAttention.k.weight"])},
+                "v": {"w": _t(sd[pre + "1.EncDecAttention.v.weight"])},
+                "o": {"w": _t(sd[pre + "1.EncDecAttention.o.weight"])},
+            }
+        return out
+
+    p: dict = {
+        "shared": {"table": _a(sd["shared.weight"])},
+        "enc_rel": {"w": _a(sd[
+            "encoder.block.0.layer.0.SelfAttention."
+            "relative_attention_bias.weight"
+        ])},
+        "dec_rel": {"w": _a(sd[
+            "decoder.block.0.layer.0.SelfAttention."
+            "relative_attention_bias.weight"
+        ])},
+        "enc_norm": {"scale": _a(sd["encoder.final_layer_norm.weight"])},
+        "dec_norm": {"scale": _a(sd["decoder.final_layer_norm.weight"])},
+        "drop": {},
+    }
+    for i in range(cfg.num_layers):
+        p[f"enc{i}"] = block("encoder", i, cross=False)
+        p[f"dec{i}"] = block("decoder", i, cross=True)
+    if not cfg.tie_word_embeddings:
+        p["lm_head"] = {"w": _t(sd["lm_head.weight"])}
+    return _to_jnp(p)
+
+
 def _to_jnp(tree):
     import jax
 

@@ -1,0 +1,344 @@
+"""T5 encoder-decoder family, TPU-native.
+
+The reference's model scope is "any HF module it can pickle"
+(src/ml/distributed.py:305-378 walks arbitrary module trees); this
+framework builds model families from its own blocks instead, and T5 adds
+the encoder-decoder shape the zoo lacked: bidirectional encoder,
+causal decoder with cross-attention, bucketed relative position biases
+shared across layers, RMS layer norm, and the no-softmax-scale attention
+convention (folded into T5's init). v1.0 (ReLU FF) and v1.1 (gated-GeLU)
+are both expressible via ``gated_ff``.
+
+TP: the same Megatron col/row ``PartitionSpec``s as every other family
+(q/k/v/o + FF splits) — `param_spec` composes per block. The engine's
+pipeline path needs a homogeneous block stack, which an encoder-decoder
+is not; T5 trains via plain (sharded) apply and serves via
+``greedy_decode`` (self-attention KV-cached, encoder k/v precomputed
+once per layer outside the scan).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorlink_tpu.nn.attention import MultiHeadAttention
+from tensorlink_tpu.nn.layers import Dense, Dropout, Embedding, RMSNorm, _normal
+from tensorlink_tpu.nn.module import Module
+from tensorlink_tpu.nn.transformer import FeedForward
+
+
+@dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    dim: int = 512
+    num_layers: int = 6  # per side (encoder AND decoder)
+    num_heads: int = 8
+    head_dim: int = 64
+    hidden_dim: int = 2048
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    dropout: float = 0.1
+    rms_eps: float = 1e-6
+    gated_ff: bool = False  # False = v1.0 ReLU; True = v1.1 gated-GeLU
+    tie_word_embeddings: bool = True  # v1.0 ties (and rescales logits)
+
+    @classmethod
+    def small(cls) -> "T5Config":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "T5Config":
+        return cls(vocab_size=128, dim=32, num_layers=2, num_heads=2,
+                   head_dim=16, hidden_dim=64, rel_buckets=8,
+                   rel_max_distance=16, dropout=0.0)
+
+
+def relative_position_bucket(
+    rel: jax.Array, *, bidirectional: bool, num_buckets: int, max_distance: int
+) -> jax.Array:
+    """T5's log-bucketed relative positions (key_pos - query_pos).
+
+    Mirrors the published bucketing exactly: half the buckets for exact
+    small offsets, the rest log-spaced up to max_distance; bidirectional
+    (encoder) splits buckets between signs, causal (decoder) uses only
+    non-positive offsets.
+    """
+    ret = jnp.zeros_like(rel)
+    n = -rel
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n < 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = jnp.maximum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    # n=0 is covered by is_small, but where() evaluates both branches —
+    # clamp so log never sees 0 (no epsilon: it could flip a bucket at
+    # an exact boundary and break bitwise parity with the published
+    # bucketing)
+    safe_n = jnp.maximum(n, 1).astype(jnp.float32)
+    log_big = max_exact + (
+        jnp.log(safe_n / max_exact)
+        / np.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    log_big = jnp.minimum(log_big, num_buckets - 1)
+    return ret + jnp.where(is_small, n, log_big)
+
+
+class RelativePositionBias(Module):
+    """[H, buckets] embedding -> additive attention bias [1, H, Tq, Tk].
+    ONE instance per stack, shared by every layer (T5 convention: only
+    layer 0 holds the table)."""
+
+    def __init__(self, num_heads: int, num_buckets: int, max_distance: int,
+                 bidirectional: bool):
+        super().__init__()
+        self.num_heads = num_heads
+        self.num_buckets = num_buckets
+        self.max_distance = max_distance
+        self.bidirectional = bidirectional
+
+    def init(self, key):
+        return {"w": _normal(key, (self.num_buckets, self.num_heads))}
+
+    def param_spec(self, model_axis: str = "model"):
+        from jax.sharding import PartitionSpec as P
+
+        # heads are TP-split in attention; the bias table is tiny —
+        # replicate and let XLA slice the head dim with the logits
+        return {"w": P()}
+
+    def apply(self, params, q_pos, k_pos, **_):
+        """q_pos [Tq], k_pos [Tk] (absolute positions) -> [1, H, Tq, Tk]."""
+        rel = k_pos[None, :] - q_pos[:, None]  # [Tq, Tk]
+        bucket = relative_position_bucket(
+            rel, bidirectional=self.bidirectional,
+            num_buckets=self.num_buckets, max_distance=self.max_distance,
+        )
+        bias = params["w"][bucket]  # [Tq, Tk, H]
+        return bias.transpose(2, 0, 1)[None]
+
+
+class T5Block(Module):
+    """Pre-RMSNorm residual block: self-attn [+ cross-attn] + FF.
+    The relative-position bias arrives from the stack (shared table)."""
+
+    def __init__(self, cfg: T5Config, *, causal: bool, cross: bool):
+        super().__init__()
+        self.causal = causal
+        self.cross = cross
+        mk_attn = lambda: MultiHeadAttention(  # noqa: E731
+            cfg.dim, cfg.num_heads, head_dim=cfg.head_dim, use_bias=False,
+            causal=False,  # causality rides the explicit mask (rel bias
+            # needs the same [Tq, Tk] geometry anyway)
+            attn_impl="reference", scale=1.0,
+        )
+        self.child("norm1", RMSNorm(cfg.dim, eps=cfg.rms_eps))
+        self.child("attn", mk_attn())
+        if cross:
+            self.child("norm_x", RMSNorm(cfg.dim, eps=cfg.rms_eps))
+            self.child("xattn", mk_attn())
+        self.child("norm2", RMSNorm(cfg.dim, eps=cfg.rms_eps))
+        # the shared FeedForward covers both T5 variants: v1.0 is the
+        # ungated ReLU MLP, v1.1 is act(gate(x)) * up(x) with gelu_new —
+        # exactly HF's act(wi_0(x)) * wi_1(x)
+        self.child(
+            "ff",
+            FeedForward(
+                cfg.dim, cfg.hidden_dim,
+                activation="gelu" if cfg.gated_ff else "relu",
+                use_bias=False, gated=cfg.gated_ff, dropout=cfg.dropout,
+            ),
+        )
+        self.child("drop", Dropout(cfg.dropout))
+
+    def apply(self, params, x, *, mask=None, bias=None, memory=None,
+              memory_mask=None, cache=None, rng=None, train=False, **_):
+        drop = self.children["drop"]
+        r1 = r2 = r3 = r4 = None
+        if rng is not None:
+            # 4 independent streams: self-attn residual, cross residual,
+            # FF-internal, FF residual — sharing a key between the last
+            # two would correlate (at hidden==dim, equate) their masks
+            r1, r2, r3, r4 = jax.random.split(rng, 4)
+        h = self.children["norm1"].apply(params["norm1"], x)
+        if cache is None:
+            a = self.children["attn"].apply(
+                params["attn"], h, mask=mask, bias=bias
+            )
+            new_cache = None
+        else:
+            a, new_cache = self.children["attn"].apply(
+                params["attn"], h, mask=mask, bias=bias, cache=cache
+            )
+        x = x + drop.apply({}, a, rng=r1, train=train)
+        if self.cross:
+            h = self.children["norm_x"].apply(params["norm_x"], x)
+            a = self.children["xattn"].apply(
+                params["xattn"], h, kv=memory, mask=memory_mask
+            )
+            x = x + drop.apply({}, a, rng=r2, train=train)
+        h = self.children["norm2"].apply(params["norm2"], x)
+        f = self.children["ff"].apply(params["ff"], h, rng=r3, train=train)
+        x = x + drop.apply({}, f, rng=r4, train=train)
+        if cache is not None:
+            return x, new_cache
+        return x
+
+
+class T5(Module):
+    """Encoder-decoder; ``apply`` returns decoder LM logits."""
+
+    def __init__(self, cfg: T5Config = T5Config()):
+        super().__init__()
+        self.cfg_obj = cfg
+        self.child("shared", Embedding(cfg.vocab_size, cfg.dim))
+        self.child("enc_rel", RelativePositionBias(
+            cfg.num_heads, cfg.rel_buckets, cfg.rel_max_distance,
+            bidirectional=True,
+        ))
+        self.child("dec_rel", RelativePositionBias(
+            cfg.num_heads, cfg.rel_buckets, cfg.rel_max_distance,
+            bidirectional=False,
+        ))
+        for i in range(cfg.num_layers):
+            self.child(f"enc{i}", T5Block(cfg, causal=False, cross=False))
+        self.child("enc_norm", RMSNorm(cfg.dim, eps=cfg.rms_eps))
+        for i in range(cfg.num_layers):
+            self.child(f"dec{i}", T5Block(cfg, causal=True, cross=True))
+        self.child("dec_norm", RMSNorm(cfg.dim, eps=cfg.rms_eps))
+        if not cfg.tie_word_embeddings:
+            self.child("lm_head", Dense(cfg.dim, cfg.vocab_size,
+                                        use_bias=False, shard="col"))
+        self.child("drop", Dropout(cfg.dropout))
+
+    # ------------------------------------------------------------- encoder
+    def encode(self, params, input_ids, *, attention_mask=None, rng=None,
+               train=False):
+        cfg = self.cfg_obj
+        T = input_ids.shape[1]
+        x = self.children["shared"].apply(params["shared"], input_ids)
+        x = self.children["drop"].apply({}, x, rng=rng, train=train)
+        pos = jnp.arange(T)
+        bias = self.children["enc_rel"].apply(params["enc_rel"], pos, pos)
+        mask = None
+        if attention_mask is not None:
+            mask = attention_mask[:, None, None, :].astype(bool)
+        for i in range(cfg.num_layers):
+            r = jax.random.fold_in(rng, i) if rng is not None else None
+            x = self.children[f"enc{i}"].apply(
+                params[f"enc{i}"], x, mask=mask, bias=bias, rng=r,
+                train=train,
+            )
+        return self.children["enc_norm"].apply(params["enc_norm"], x)
+
+    # ------------------------------------------------------------- decoder
+    def _dec_mask(self, B, T):
+        tri = jnp.tril(jnp.ones((T, T), bool))
+        return jnp.broadcast_to(tri[None, None], (B, 1, T, T))
+
+    def decode(self, params, decoder_ids, memory, *, memory_mask=None,
+               decoder_attention_mask=None, rng=None, train=False):
+        cfg = self.cfg_obj
+        B, T = decoder_ids.shape
+        x = self.children["shared"].apply(params["shared"], decoder_ids)
+        x = self.children["drop"].apply({}, x, rng=rng, train=train)
+        pos = jnp.arange(T)
+        bias = self.children["dec_rel"].apply(params["dec_rel"], pos, pos)
+        mask = self._dec_mask(B, T)
+        if decoder_attention_mask is not None:
+            # padded decoder batches: real positions must not attend to
+            # pad keys that precede them under the causal mask
+            mask = mask & decoder_attention_mask[:, None, None, :].astype(bool)
+        mm = None
+        if memory_mask is not None:
+            mm = memory_mask[:, None, None, :].astype(bool)
+        for i in range(cfg.num_layers):
+            r = (
+                jax.random.fold_in(rng, 100 + i) if rng is not None else None
+            )
+            x = self.children[f"dec{i}"].apply(
+                params[f"dec{i}"], x, mask=mask, bias=bias, memory=memory,
+                memory_mask=mm, rng=r, train=train,
+            )
+        x = self.children["dec_norm"].apply(params["dec_norm"], x)
+        return self._lm_logits(params, x)
+
+    def _lm_logits(self, params, x):
+        cfg = self.cfg_obj
+        if cfg.tie_word_embeddings:
+            # T5 rescales tied logits by d^-0.5 (the missing attention
+            # scale's twin convention)
+            x = x * (cfg.dim ** -0.5)
+            return self.children["shared"].attend(params["shared"], x)
+        return self.children["lm_head"].apply(params["lm_head"], x)
+
+    def apply(self, params, input_ids, decoder_input_ids, *,
+              attention_mask=None, decoder_attention_mask=None, rng=None,
+              train=False, **_):
+        r_enc = r_dec = None
+        if rng is not None:
+            r_enc, r_dec = jax.random.split(rng)
+        memory = self.encode(
+            params, input_ids, attention_mask=attention_mask, rng=r_enc,
+            train=train,
+        )
+        return self.decode(
+            params, decoder_input_ids, memory, memory_mask=attention_mask,
+            decoder_attention_mask=decoder_attention_mask,
+            rng=r_dec, train=train,
+        )
+
+    # ------------------------------------------------------------ serving
+    def greedy_decode(self, params, input_ids, *, attention_mask=None,
+                      max_new_tokens: int = 32, start_id: int = 0):
+        """Greedy seq2seq generation: encoder runs once; the decoder
+        recomputes its growing prefix per step inside one jitted scan
+        with STATIC shapes (position slots masked beyond the live
+        length). Exact — the decoder's rel-pos bias depends only on
+        relative offsets, so a left-aligned growing prefix is identical
+        to re-running decode() on the emitted tokens."""
+        cfg = self.cfg_obj
+        B = input_ids.shape[0]
+        L = int(max_new_tokens) + 1
+        memory = self.encode(params, input_ids,
+                             attention_mask=attention_mask)
+        mm = None
+        if attention_mask is not None:
+            mm = attention_mask[:, None, None, :].astype(bool)
+
+        def step(carry, t):
+            ids = carry  # [B, L] with slots >= live masked by position
+            x = self.children["shared"].apply(params["shared"], ids)
+            pos = jnp.arange(L)
+            bias = self.children["dec_rel"].apply(
+                params["dec_rel"], pos, pos
+            )
+            live = jnp.arange(L)[None, :] <= t  # valid decoder slots
+            mask = (
+                self._dec_mask(B, L)
+                & live[:, None, None, :]
+            )
+            h = x
+            for i in range(cfg.num_layers):
+                h = self.children[f"dec{i}"].apply(
+                    params[f"dec{i}"], h, mask=mask, bias=bias,
+                    memory=memory, memory_mask=mm,
+                )
+            h = self.children["dec_norm"].apply(params["dec_norm"], h)
+            logits = self._lm_logits(params, h[:, t, :][:, None])[:, 0]
+            nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+            ids = jax.lax.dynamic_update_index_in_dim(
+                ids, nxt, t + 1, axis=1
+            )
+            return ids, nxt
+
+        ids0 = jnp.full((B, L), start_id, jnp.int32)
+        _, toks = jax.lax.scan(step, ids0, jnp.arange(max_new_tokens))
+        return np.asarray(toks.T)

@@ -31,6 +31,7 @@ def dot_product_attention(
     mask: jax.Array | None = None,  # [B, 1|H, Tq, Tk] bool, True=attend
     bias: jax.Array | None = None,
     q_offset: int | jax.Array = 0,
+    scale: float | None = None,  # None = 1/sqrt(D); T5 uses 1.0
     **_,
 ) -> jax.Array:
     """Reference attention, f32 softmax. ``q_offset`` shifts query positions
@@ -41,7 +42,7 @@ def dot_product_attention(
         rep = H // Hkv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    scale = D ** -0.5
+    scale = D ** -0.5 if scale is None else scale
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
     if causal:
         Tk = k.shape[1]
@@ -193,6 +194,7 @@ class MultiHeadAttention(Module):
         rope_theta: float = 10000.0,
         causal: bool = False,
         attn_impl: str | Callable = "auto",
+        scale: float | None = None,  # None = 1/sqrt(head_dim); T5 = 1.0
     ):
         super().__init__()
         self.dim = dim
@@ -203,6 +205,15 @@ class MultiHeadAttention(Module):
         self.rope = rope
         self.rope_theta = rope_theta
         self.causal = causal
+        if scale is not None:
+            # only the reference einsum honors a custom scale; flash/ring
+            # would silently use 1/sqrt(D) (T5's no-scale convention is
+            # folded into its init, so this matters numerically)
+            if attn_impl != "reference":
+                raise ValueError(
+                    "custom attention scale requires attn_impl='reference'"
+                )
+            self.scale = scale
         if isinstance(attn_impl, str):
             # only a string impl is recorded for config()/spec-shipping; a
             # callable can't cross the wire, so the attribute is omitted
@@ -225,12 +236,22 @@ class MultiHeadAttention(Module):
         mask=None,
         cache=None,  # {"k": [B,Tmax,Hkv,D], "v": ..., "index": int32}
         positions=None,
+        kv=None,  # cross-attention: keys/values from THIS source (enc out)
+        bias=None,  # additive attention bias [1|B, H, Tq, Tk] (T5 rel-pos)
         **kw,
     ):
         B, T, _ = x.shape
+        if bias is not None and self._attn is not dot_product_attention:
+            # flash/ring/ulysses swallow unknown kwargs (**_) — an
+            # additive bias must not be silently dropped
+            raise NotImplementedError(
+                "additive attention bias requires attn_impl='reference'"
+            )
+        src = x if kv is None else kv
+        Ts = src.shape[1]
         q = self.children["q"].apply(params["q"], x).reshape(B, T, self.num_heads, self.head_dim)
-        k = self.children["k"].apply(params["k"], x).reshape(B, T, self.num_kv_heads, self.head_dim)
-        v = self.children["v"].apply(params["v"], x).reshape(B, T, self.num_kv_heads, self.head_dim)
+        k = self.children["k"].apply(params["k"], src).reshape(B, Ts, self.num_kv_heads, self.head_dim)
+        v = self.children["v"].apply(params["v"], src).reshape(B, Ts, self.num_kv_heads, self.head_dim)
 
         q_offset = 0
         if cache is not None:
@@ -250,6 +271,11 @@ class MultiHeadAttention(Module):
 
         new_cache = None
         use_blockwise = False
+        if cache is not None and kv is not None:
+            raise NotImplementedError(
+                "cross-attention KV caching is not supported; precompute "
+                "encoder k/v outside the decode loop (models/t5.py does)"
+            )
         if cache is not None:
             ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k.astype(cache["k"].dtype), cache["index"], axis=1)
             cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v.astype(cache["v"].dtype), cache["index"], axis=1)
@@ -263,7 +289,12 @@ class MultiHeadAttention(Module):
             # blockwise attention so cost tracks the live prefix, not
             # capacity. The valid mask already enforces causality for the
             # lone query (every slot < live_len is at or before it).
-            use_blockwise = T == 1 and Tk > DECODE_BLOCK and Tk % DECODE_BLOCK == 0
+            # Additive biases (T5 rel-pos) and custom scales stay on the
+            # full path — the blockwise kernel hardcodes 1/sqrt(D).
+            use_blockwise = (
+                T == 1 and Tk > DECODE_BLOCK and Tk % DECODE_BLOCK == 0
+                and bias is None and getattr(self, "scale", None) is None
+            )
 
         if use_blockwise:
             out = decode_attention_blockwise(
@@ -279,6 +310,7 @@ class MultiHeadAttention(Module):
             out = self._attn(
                 q, k.astype(q.dtype), v.astype(q.dtype),
                 causal=self.causal, mask=mask, q_offset=q_offset,
+                bias=bias, scale=getattr(self, "scale", None),
             )
         out = out.reshape(B, T, self.num_heads * self.head_dim)
         out = self.children["o"].apply(params["o"], out)

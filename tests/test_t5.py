@@ -1,0 +1,184 @@
+"""T5 encoder-decoder: HF parity, greedy decode, TP sharding.
+
+The encoder-decoder shape (cross-attention, shared relative-position
+buckets, RMS norm, no-scale attention) is absent from the reference's
+hand-built coverage; parity is pinned against transformers' T5 exactly
+like the other families in test_models.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.models.t5 import T5, T5Config, relative_position_bucket
+
+KEY = jax.random.key(0)
+
+
+@pytest.fixture(scope="module")
+def torch_mods():
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    return torch, transformers
+
+
+def _hf_t5(transformers, cfg: T5Config, gated: bool):
+    hf_cfg = transformers.T5Config(
+        vocab_size=cfg.vocab_size,
+        d_model=cfg.dim,
+        d_kv=cfg.head_dim,
+        d_ff=cfg.hidden_dim,
+        num_layers=cfg.num_layers,
+        num_decoder_layers=cfg.num_layers,
+        num_heads=cfg.num_heads,
+        relative_attention_num_buckets=cfg.rel_buckets,
+        relative_attention_max_distance=cfg.rel_max_distance,
+        dropout_rate=0.0,
+        feed_forward_proj="gated-gelu" if gated else "relu",
+        tie_word_embeddings=cfg.tie_word_embeddings,
+        decoder_start_token_id=0,
+        pad_token_id=0,
+        eos_token_id=1,
+    )
+    return transformers.T5ForConditionalGeneration(hf_cfg).eval()
+
+
+@pytest.mark.parametrize("gated", [False, True])
+def test_t5_parity_vs_hf(torch_mods, gated):
+    torch, transformers = torch_mods
+    cfg = T5Config(
+        vocab_size=128, dim=32, num_layers=2, num_heads=2, head_dim=16,
+        hidden_dim=64, rel_buckets=8, rel_max_distance=16, dropout=0.0,
+        gated_ff=gated,
+    )
+    hf = _hf_t5(transformers, cfg, gated)
+    from tensorlink_tpu.models.hf_import import (
+        t5_params_from_hf,
+        torch_state_dict_to_numpy,
+    )
+
+    params = t5_params_from_hf(torch_state_dict_to_numpy(hf), cfg)
+    model = T5(cfg)
+
+    r = np.random.default_rng(0)
+    B, Ts, Tt = 2, 10, 7
+    ids = r.integers(2, cfg.vocab_size, (B, Ts))
+    am = np.ones((B, Ts), np.int64)
+    am[0, 7:] = 0
+    ids[0, 7:] = 0
+    dec = r.integers(2, cfg.vocab_size, (B, Tt))
+    dec[:, 0] = 0  # decoder start
+
+    with torch.no_grad():
+        ref = hf(
+            input_ids=torch.tensor(ids),
+            attention_mask=torch.tensor(am),
+            decoder_input_ids=torch.tensor(dec),
+        ).logits.numpy()
+    ours = np.asarray(model.apply(
+        params, jnp.asarray(ids), jnp.asarray(dec),
+        attention_mask=jnp.asarray(am),
+    ))
+    # compare only non-pad encoder-influenced outputs (all decoder slots
+    # are real); fp32 end to end
+    np.testing.assert_allclose(ours, ref, atol=2e-4, rtol=2e-4)
+
+
+def test_t5_greedy_decode_matches_step_by_step():
+    cfg = T5Config.tiny()
+    model = T5(cfg)
+    params = model.init(KEY)
+    r = np.random.default_rng(1)
+    ids = jnp.asarray(r.integers(2, cfg.vocab_size, (2, 6)))
+
+    toks = model.greedy_decode(params, ids, max_new_tokens=5, start_id=0)
+    assert toks.shape == (2, 5)
+
+    # naive reference: full decode() re-run per emitted token
+    memory = model.encode(params, ids)
+    dec = jnp.zeros((2, 1), jnp.int32)
+    for t in range(5):
+        logits = model.decode(params, dec, memory)
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32), axis=-1)
+        dec = jnp.concatenate([dec, nxt[:, None].astype(jnp.int32)], axis=1)
+    np.testing.assert_array_equal(toks, np.asarray(dec[:, 1:]))
+
+
+def test_t5_padding_invariance():
+    """Encoder padding must not leak through cross-attention."""
+    cfg = T5Config.tiny()
+    model = T5(cfg)
+    params = model.init(KEY)
+    r = np.random.default_rng(2)
+    short = r.integers(2, cfg.vocab_size, (1, 4))
+    padded = np.zeros((1, 8), np.int64)
+    padded[0, :4] = short[0]
+    am = np.zeros((1, 8), np.int64)
+    am[0, :4] = 1
+    dec = jnp.asarray(r.integers(2, cfg.vocab_size, (1, 3)))
+    a = model.apply(params, jnp.asarray(short), dec)
+    b = model.apply(params, jnp.asarray(padded), dec,
+                    attention_mask=jnp.asarray(am))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_t5_bucket_function_shapes():
+    q = jnp.arange(6)
+    rel = q[None, :] - q[:, None]
+    bi = relative_position_bucket(rel, bidirectional=True, num_buckets=8,
+                                  max_distance=16)
+    ca = relative_position_bucket(rel, bidirectional=False, num_buckets=8,
+                                  max_distance=16)
+    assert int(bi.max()) < 8 and int(ca.max()) < 8
+    assert int(bi.min()) >= 0 and int(ca.min()) >= 0
+    # causal: future keys (rel > 0) all collapse to bucket 0
+    assert int(ca[0, 5]) == 0
+
+
+def test_t5_tensor_parallel_apply(devices):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tensorlink_tpu.config import MeshConfig
+    from tensorlink_tpu.nn.module import spec_tree_to_shardings
+    from tensorlink_tpu.runtime.mesh import make_mesh
+
+    cfg = T5Config.tiny()
+    model = T5(cfg)
+    params = model.init(KEY)
+    single = model.apply(
+        params, jnp.ones((2, 6), jnp.int32), jnp.ones((2, 4), jnp.int32)
+    )
+    mesh = make_mesh(MeshConfig(model=2))
+    shardings = spec_tree_to_shardings(model.param_spec(), mesh)
+    sharded_params = jax.tree.map(jax.device_put, params, shardings)
+    # attention projections really are TP-split
+    assert "model" in sharded_params["enc0"]["attn"]["q"]["w"].sharding.spec
+    out = jax.jit(
+        lambda p, a, b: model.apply(p, a, b),
+        out_shardings=NamedSharding(mesh, P()),
+    )(sharded_params, jnp.ones((2, 6), jnp.int32), jnp.ones((2, 4), jnp.int32))
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(single), atol=2e-5
+    )
+
+
+def test_t5_decoder_padding_mask_honored():
+    """decoder_attention_mask must actually gate attention (review
+    finding: it used to be silently swallowed)."""
+    cfg = T5Config.tiny()
+    model = T5(cfg)
+    params = model.init(KEY)
+    r = np.random.default_rng(3)
+    ids = jnp.asarray(r.integers(2, cfg.vocab_size, (1, 5)))
+    dec = r.integers(2, cfg.vocab_size, (1, 6))
+    dam = np.ones((1, 6), np.int64)
+    dam[0, 2:4] = 0  # interior pads
+    a = model.apply(params, ids, jnp.asarray(dec))
+    b = model.apply(params, ids, jnp.asarray(dec),
+                    decoder_attention_mask=jnp.asarray(dam))
+    # positions after the pads see different keys -> different logits
+    assert not np.allclose(np.asarray(a)[0, 5], np.asarray(b)[0, 5])
+    # positions before the pads are unaffected (causal: pads are ahead)
+    np.testing.assert_allclose(
+        np.asarray(a)[0, :2], np.asarray(b)[0, :2], atol=1e-5
+    )
