@@ -13,8 +13,9 @@ TP: the same Megatron col/row ``PartitionSpec``s as every other family
 (q/k/v/o + FF splits) — `param_spec` composes per block. The engine's
 pipeline path needs a homogeneous block stack, which an encoder-decoder
 is not; T5 trains via plain (sharded) apply and serves via
-``greedy_decode`` (self-attention KV-cached, encoder k/v precomputed
-once per layer outside the scan).
+``greedy_decode`` — a correctness-first jitted scan that re-runs the
+static-shape decoder per token (the encoder runs once; self-attn KV
+caching for T5 decode is future work, see greedy_decode's docstring).
 """
 
 from __future__ import annotations

@@ -208,10 +208,12 @@ class MultiHeadAttention(Module):
         if scale is not None:
             # only the reference einsum honors a custom scale; flash/ring
             # would silently use 1/sqrt(D) (T5's no-scale convention is
-            # folded into its init, so this matters numerically)
-            if attn_impl != "reference":
+            # folded into its init, so this matters numerically). Checked
+            # on the RESOLVED impl so a callable reference also passes.
+            if resolve_attn_impl(attn_impl) is not dot_product_attention:
                 raise ValueError(
-                    "custom attention scale requires attn_impl='reference'"
+                    "custom attention scale requires the reference "
+                    "attention implementation"
                 )
             self.scale = scale
         if isinstance(attn_impl, str):
@@ -273,8 +275,9 @@ class MultiHeadAttention(Module):
         use_blockwise = False
         if cache is not None and kv is not None:
             raise NotImplementedError(
-                "cross-attention KV caching is not supported; precompute "
-                "encoder k/v outside the decode loop (models/t5.py does)"
+                "cross-attention KV caching is not supported; run decode "
+                "without a cache on the cross-attention (models/t5.py "
+                "re-runs its static-shape decoder per token instead)"
             )
         if cache is not None:
             ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k.astype(cache["k"].dtype), cache["index"], axis=1)
