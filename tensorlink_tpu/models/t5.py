@@ -13,9 +13,9 @@ TP: the same Megatron col/row ``PartitionSpec``s as every other family
 (q/k/v/o + FF splits) — `param_spec` composes per block. The engine's
 pipeline path needs a homogeneous block stack, which an encoder-decoder
 is not; T5 trains via plain (sharded) apply and serves via
-``greedy_decode`` — a correctness-first jitted scan that re-runs the
-static-shape decoder per token (the encoder runs once; self-attn KV
-caching for T5 decode is future work, see greedy_decode's docstring).
+``greedy_decode``: encoder once, per-layer cross k/v projected once,
+self-attention KV-cached — one single-token decoder pass per emitted
+token inside one jitted scan.
 """
 
 from __future__ import annotations
@@ -160,7 +160,8 @@ class T5Block(Module):
         self.child("drop", Dropout(cfg.dropout))
 
     def apply(self, params, x, *, mask=None, bias=None, memory=None,
-              memory_mask=None, cache=None, rng=None, train=False, **_):
+              memory_mask=None, cross_kv=None, cache=None, rng=None,
+              train=False, **_):
         drop = self.children["drop"]
         r1 = r2 = r3 = r4 = None
         if rng is not None:
@@ -182,7 +183,8 @@ class T5Block(Module):
         if self.cross:
             h = self.children["norm_x"].apply(params["norm_x"], x)
             a = self.children["xattn"].apply(
-                params["xattn"], h, kv=memory, mask=memory_mask
+                params["xattn"], h, kv=memory, precomputed_kv=cross_kv,
+                mask=memory_mask,
             )
             x = x + drop.apply({}, a, rng=r2, train=train)
         h = self.children["norm2"].apply(params["norm2"], x)
@@ -299,12 +301,14 @@ class T5(Module):
     # ------------------------------------------------------------ serving
     def greedy_decode(self, params, input_ids, *, attention_mask=None,
                       max_new_tokens: int = 32, start_id: int = 0):
-        """Greedy seq2seq generation: encoder runs once; the decoder
-        recomputes its growing prefix per step inside one jitted scan
-        with STATIC shapes (position slots masked beyond the live
-        length). Exact — the decoder's rel-pos bias depends only on
-        relative offsets, so a left-aligned growing prefix is identical
-        to re-running decode() on the emitted tokens."""
+        """Greedy seq2seq generation, KV-cached: the encoder runs once,
+        each decoder layer's cross-attention k/v are projected ONCE
+        (``project_kv``), and self-attention reads its per-layer cache —
+        one single-token decoder pass per emitted token inside one
+        jitted ``lax.scan``. Exact vs re-running ``decode()`` on the
+        emitted prefix: the rel-pos bias row for query position t is
+        sliced from the same table, and the cache's validity mask plays
+        the causal mask's role for the lone query."""
         cfg = self.cfg_obj
         B = input_ids.shape[0]
         L = int(max_new_tokens) + 1
@@ -313,33 +317,48 @@ class T5(Module):
         mm = None
         if attention_mask is not None:
             mm = attention_mask[:, None, None, :].astype(bool)
+        # per-layer one-time setup: cross k/v + empty self-attn caches
+        cross_kv = [
+            self.children[f"dec{i}"].children["xattn"].project_kv(
+                params[f"dec{i}"]["xattn"], memory
+            )
+            for i in range(cfg.num_layers)
+        ]
+        caches = [
+            self.children[f"dec{i}"].children["attn"].init_cache(
+                B, L, dtype=memory.dtype
+            )
+            for i in range(cfg.num_layers)
+        ]
+        # full [L, L] rel-pos table once; row t is step t's bias
+        pos = jnp.arange(L)
+        bias_full = self.children["dec_rel"].apply(
+            params["dec_rel"], pos, pos
+        )
 
         def step(carry, t):
-            ids = carry  # [B, L] with slots >= live masked by position
-            x = self.children["shared"].apply(params["shared"], ids)
-            pos = jnp.arange(L)
-            bias = self.children["dec_rel"].apply(
-                params["dec_rel"], pos, pos
-            )
-            live = jnp.arange(L)[None, :] <= t  # valid decoder slots
-            mask = (
-                self._dec_mask(B, L)
-                & live[:, None, None, :]
-            )
+            tok, caches = carry  # current input token [B]
+            x = self.children["shared"].apply(params["shared"], tok[:, None])
+            bias = jax.lax.dynamic_slice_in_dim(
+                bias_full, t, 1, axis=2
+            )  # [1, H, 1, L]
+            new_caches = []
             h = x
             for i in range(cfg.num_layers):
-                h = self.children[f"dec{i}"].apply(
-                    params[f"dec{i}"], h, mask=mask, bias=bias,
-                    memory=memory, memory_mask=mm,
+                h, c = self.children[f"dec{i}"].apply(
+                    params[f"dec{i}"], h, bias=bias, cross_kv=cross_kv[i],
+                    memory_mask=mm, cache=caches[i],
                 )
+                new_caches.append(c)
             h = self.children["dec_norm"].apply(params["dec_norm"], h)
-            logits = self._lm_logits(params, h[:, t, :][:, None])[:, 0]
-            nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1)
-            ids = jax.lax.dynamic_update_index_in_dim(
-                ids, nxt, t + 1, axis=1
+            logits = self._lm_logits(params, h)[:, 0]
+            nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(
+                jnp.int32
             )
-            return ids, nxt
+            return (nxt, new_caches), nxt
 
-        ids0 = jnp.full((B, L), start_id, jnp.int32)
-        _, toks = jax.lax.scan(step, ids0, jnp.arange(max_new_tokens))
+        tok0 = jnp.full((B,), start_id, jnp.int32)
+        _, toks = jax.lax.scan(
+            step, (tok0, caches), jnp.arange(max_new_tokens)
+        )
         return np.asarray(toks.T)

@@ -239,6 +239,7 @@ class MultiHeadAttention(Module):
         cache=None,  # {"k": [B,Tmax,Hkv,D], "v": ..., "index": int32}
         positions=None,
         kv=None,  # cross-attention: keys/values from THIS source (enc out)
+        precomputed_kv=None,  # (k, v) [B,Tk,Hkv,D]: skip k/v projections
         bias=None,  # additive attention bias [1|B, H, Tq, Tk] (T5 rel-pos)
         **kw,
     ):
@@ -249,11 +250,15 @@ class MultiHeadAttention(Module):
             raise NotImplementedError(
                 "additive attention bias requires attn_impl='reference'"
             )
-        src = x if kv is None else kv
-        Ts = src.shape[1]
         q = self.children["q"].apply(params["q"], x).reshape(B, T, self.num_heads, self.head_dim)
-        k = self.children["k"].apply(params["k"], src).reshape(B, Ts, self.num_kv_heads, self.head_dim)
-        v = self.children["v"].apply(params["v"], src).reshape(B, Ts, self.num_kv_heads, self.head_dim)
+        if precomputed_kv is not None:
+            # decode-loop cross-attention: the encoder's k/v were
+            # projected ONCE via project_kv (rope, if any, must have been
+            # applied there — T5 has none)
+            k, v = precomputed_kv
+        else:
+            # one projection path for cached and uncached callers
+            k, v = self.project_kv(params, x if kv is None else kv)
 
         q_offset = 0
         if cache is not None:
@@ -268,16 +273,21 @@ class MultiHeadAttention(Module):
                 positions = positions + jax.lax.axis_index("seq") * T
 
         if self.rope:
+            if precomputed_kv is not None:
+                raise NotImplementedError(
+                    "precomputed_kv with rope would re-rotate the keys; "
+                    "apply rope in project_kv first"
+                )
             q = apply_rope(q, positions, self.rope_theta)
             k = apply_rope(k, positions, self.rope_theta)
 
         new_cache = None
         use_blockwise = False
-        if cache is not None and kv is not None:
+        if cache is not None and (kv is not None or precomputed_kv is not None):
             raise NotImplementedError(
-                "cross-attention KV caching is not supported; run decode "
-                "without a cache on the cross-attention (models/t5.py "
-                "re-runs its static-shape decoder per token instead)"
+                "cross-attention KV caching is not supported; precompute "
+                "the encoder k/v once (project_kv) and pass them per step "
+                "WITHOUT a cache (models/t5.py greedy_decode does)"
             )
         if cache is not None:
             ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k.astype(cache["k"].dtype), cache["index"], axis=1)
@@ -320,6 +330,18 @@ class MultiHeadAttention(Module):
         if cache is not None:
             return out, new_cache
         return out
+
+    def project_kv(self, params, src):
+        """Project a cross-attention source ONCE: (k, v) [B, Tk, Hkv, D]
+        for reuse across a decode loop via ``precomputed_kv=``."""
+        B, Ts, _ = src.shape
+        k = self.children["k"].apply(params["k"], src).reshape(
+            B, Ts, self.num_kv_heads, self.head_dim
+        )
+        v = self.children["v"].apply(params["v"], src).reshape(
+            B, Ts, self.num_kv_heads, self.head_dim
+        )
+        return k, v
 
     def init_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16):
         shape = (batch, max_len, self.num_kv_heads, self.head_dim)

@@ -55,33 +55,21 @@ def roofline(
     t_memory = hbm_bytes_per_step / (hbm_gbps * 1e9)
     intensity = flops_per_step / max(hbm_bytes_per_step, 1.0)
     ridge = peak_tflops * 1e12 / (hbm_gbps * 1e9)  # FLOP/byte at the knee
+    floor = max(t_compute, t_memory)
     out = {
         "t_compute_floor_s": t_compute,
         "t_memory_floor_s": t_memory,
         "arithmetic_intensity_flop_per_byte": intensity,
         "ridge_flop_per_byte": ridge,
         "bound": "compute" if t_compute >= t_memory else "memory",
+        # the MFU ceiling the floors imply — independent of any
+        # measurement, useful for pre-run planning
+        "attainable_mfu_at_floor": flops_per_step / floor / (peak_tflops * 1e12),
     }
     if measured_step_s is not None:
-        floor = max(t_compute, t_memory)
         out["measured_step_s"] = measured_step_s
         out["fraction_of_binding_floor"] = floor / measured_step_s
-        out["attainable_mfu_at_floor"] = (
-            flops_per_step / max(t_compute, t_memory) / (peak_tflops * 1e12)
-        )
     return out
-
-
-def step_bytes_accessed(compiled) -> float | None:
-    """XLA-measured main-memory traffic of a compiled program
-    ('bytes accessed' cost analysis key), or None off-backend."""
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0]
-        return float(cost["bytes accessed"])
-    except Exception:  # noqa: BLE001 — backend-optional
-        return None
 
 
 class Stopwatch:
