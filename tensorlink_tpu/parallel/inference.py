@@ -37,6 +37,7 @@ class GenerationConfig:
     max_new_tokens: int = 64
     temperature: float = 0.0  # 0 => greedy
     top_k: int = 0  # 0 => full softmax
+    top_p: float = 1.0  # nucleus sampling; 1.0 => off
     eos_token_id: int | None = None
 
 
@@ -91,7 +92,7 @@ class InferenceEngine:
         self._generate_jit = {}
 
     # ------------------------------------------------------------ internals
-    def _sample(self, logits, key, temperature, top_k):
+    def _sample(self, logits, key, temperature, top_k, top_p=1.0):
         logits = logits.astype(jnp.float32)
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1)
@@ -102,6 +103,17 @@ class InferenceEngine:
             # the hot path (review finding)
             kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
             logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p < 1.0:
+            # nucleus: keep the smallest prefix of descending-prob tokens
+            # whose EXCLUSIVE cumulative mass is < top_p (the first token
+            # always survives). Costs one vocab sort per token — opt-in.
+            srt = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(srt, axis=-1)
+            keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+            thr = jnp.min(
+                jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True
+            )
+            logits = jnp.where(logits >= thr, logits, -jnp.inf)
         return jax.random.categorical(key, logits, axis=-1)
 
     def _build(self, B: int, T0: int, gen: GenerationConfig):
@@ -110,6 +122,7 @@ class InferenceEngine:
         model = self.model
         L = self.cache_len  # cache capacity (block-rounded >= max_len)
         temperature, top_k = float(gen.temperature), int(gen.top_k)
+        top_p = float(gen.top_p)
         max_new = int(gen.max_new_tokens)
         eos = gen.eos_token_id
 
@@ -147,13 +160,13 @@ class InferenceEngine:
                     params, tok[:, None], caches=caches,
                     positions=positions, mask=mask,
                 )
-                nxt = self._sample(logits[:, -1], sub, temperature, top_k)
+                nxt = self._sample(logits[:, -1], sub, temperature, top_k, top_p)
                 if eos is not None:
                     nxt = jnp.where(done, eos, nxt)
                     done = done | (nxt == eos)
                 return (caches, valid, nxt, key, done), nxt
 
-            tok0 = self._sample(last, key, temperature, top_k)
+            tok0 = self._sample(last, key, temperature, top_k, top_p)
             done0 = (
                 (tok0 == eos) if eos is not None else jnp.zeros((B,), bool)
             )
@@ -182,6 +195,13 @@ class InferenceEngine:
     ) -> np.ndarray:
         """ids: [B, T0] left-padded prompts; returns [B, max_new_tokens]."""
         gen = gen or GenerationConfig()
+        if not 0.0 < gen.top_p <= 1.0:
+            # top_p=0 would mask EVERY token and categorical over all
+            # -inf silently degenerates to token 0 (review finding);
+            # "off" is 1.0, not 0 (unlike top_k's 0-means-off)
+            raise ValueError(
+                f"top_p must be in (0, 1] (1.0 = off), got {gen.top_p}"
+            )
         ids = np.asarray(ids)
         B, T0 = ids.shape
         if T0 + gen.max_new_tokens > self.max_len:

@@ -128,6 +128,15 @@ class DistributedJob:
         # in-memory recovery cache survives a master+validator loss only
         # if it also lands on disk (VERDICT weak #8)
         self._ckpt = None
+        # inference passes get their own step namespace, advancing per
+        # call: reusing self.step would (a) make repeated train-mode
+        # forwards draw bitwise-identical dropout masks (MC dropout
+        # variance 0) and (b) let a straggler RELAY_RESULT from an
+        # aborted forward() fulfill a LATER call's identical waiter key
+        # with the previous batch's activations (review finding). Offset
+        # far above any realistic training step count, inside int32 for
+        # the rng fold.
+        self._infer_seq = 1 << 30
         # train/eval mode fan-out (reference: DistributedModel.train()/
         # eval() over UT-REQ, src/ml/distributed.py:204-234). Here the
         # mode rides every FORWARD/RELAY_FORWARD message; stages run
@@ -188,7 +197,8 @@ class DistributedJob:
         ]
 
     async def _relay_micro(
-        self, step: int, micro: int, arr: np.ndarray, *, backward: bool
+        self, step: int, micro: int, arr: np.ndarray, *, backward: bool,
+        infer: bool = False,
     ) -> np.ndarray:
         """One micro-batch through the chain via worker-to-worker relay:
         one request to the entry stage carrying the remaining route; the
@@ -217,6 +227,7 @@ class DistributedJob:
                     "origin": self.user.node_id,
                     "route": [placement_wire(st) for st in order[1:]],
                     "train": self._train_flag,
+                    "infer": infer,
                     "data": pack_arrays({arr_key: np.asarray(arr)}),
                 },
                 timeout=60.0,
@@ -230,10 +241,14 @@ class DistributedJob:
         finally:
             self.user.drop_relay_waiter(key)
 
-    async def _micro_forward(self, step: int, micro: int, x: np.ndarray) -> np.ndarray:
+    async def _micro_forward(
+        self, step: int, micro: int, x: np.ndarray, infer: bool = False
+    ) -> np.ndarray:
         chain = self.chains[micro % len(self.chains)]
         if self.relay and len(chain) > 1:
-            return await self._relay_micro(step, micro, x, backward=False)
+            return await self._relay_micro(
+                step, micro, x, backward=False, infer=infer
+            )
         for st in chain:
             if self.plan is not None:
                 x = self.plan.forward_in(st.index, x)
@@ -247,6 +262,7 @@ class DistributedJob:
                     "micro": micro,
                     "fence": self._fence,
                     "train": self._train_flag,
+                    "infer": infer,
                     "data": pack_arrays({"x": np.asarray(x)}),
                 },
                 timeout=60.0,
@@ -312,6 +328,33 @@ class DistributedJob:
                     # only consistent restart point is the shared snapshot
                     rollback_all=isinstance(e, StepEndFailure),
                 )
+        raise AssertionError("unreachable")
+
+    async def forward(self, batch_x: np.ndarray) -> np.ndarray:
+        """Inference-only pipelined pass: micro-batches stream through
+        the stage chain(s) and the concatenated final activations return
+        — no gradient state is stashed on any worker (the reference gets
+        this for free from nn.Module.forward; the socket path needs the
+        explicit no-stash contract). Respects train()/eval() mode, so
+        eval-mode inference is deterministic and MC-dropout inference is
+        a train() away. Elastic like train_step: a dead stage is
+        re-recruited and the pass retried."""
+        for attempt in range(self.max_step_retries + 1):
+            # fresh identity per call AND per retry (see _infer_seq note)
+            seq = self._infer_seq
+            self._infer_seq += 1
+            try:
+                m = self.job.micro_batches
+                micros = np.array_split(np.asarray(batch_x), m)
+                outs = await asyncio.gather(*(
+                    self._micro_forward(seq, i, x, infer=True)
+                    for i, x in enumerate(micros)
+                ))
+                return np.concatenate([np.asarray(o) for o in outs], axis=0)
+            except (ConnectionError, asyncio.TimeoutError, RuntimeError):
+                if attempt == self.max_step_retries or self.validator is None:
+                    raise
+                await self.recover_dead_stages(aborted=set())
         raise AssertionError("unreachable")
 
     async def _try_train_step(self, batch_x, loss_grad_fn) -> float:

@@ -276,7 +276,7 @@ class StageRunner:
 
     def forward(
         self, step: int, micro: int, x: np.ndarray, fence: int = 0,
-        train: bool = False,
+        train: bool = False, stash: bool = True,
     ) -> np.ndarray:
         # TP path: one host->mesh transfer straight from the numpy buffer
         # (asarray-then-device_put would copy via device 0 first)
@@ -292,8 +292,12 @@ class StageRunner:
             if fence < self.fence:
                 raise StaleFenceError(f"fence {fence} < {self.fence}")
             # the mode rides the stash so backward recomputes the same
-            # program (and mask) without any extra wire field
-            self.inputs[(step, micro)] = (xj, use_train)
+            # program (and mask) without any extra wire field.
+            # stash=False is the inference contract (FORWARD infer=True):
+            # no backward will come, so stashing would leak one
+            # activation per inference micro until the next reset
+            if stash:
+                self.inputs[(step, micro)] = (xj, use_train)
         if use_train:
             k = self._micro_key(step, micro)
             return np.asarray(
@@ -762,6 +766,7 @@ class WorkerNode(Node):
             out = await asyncio.to_thread(
                 runner.forward, int(msg["step"]), int(msg["micro"]), x,
                 int(msg.get("fence", 0)), bool(msg.get("train", False)),
+                not bool(msg.get("infer", False)),
             )
         except StaleFenceError:
             return {"type": "ERROR", "error": "stale fence (aborted step)"}
@@ -857,7 +862,10 @@ class WorkerNode(Node):
             # unpack inside the try: a malformed hop payload must flow to
             # the master as RELAY_ERROR, not stall its waiter to timeout
             data = unpack_arrays(msg["data"])[arr_key]
-            extra = () if backward else (bool(msg.get("train", False)),)
+            extra = () if backward else (
+                bool(msg.get("train", False)),
+                not bool(msg.get("infer", False)),
+            )
             fn = runner.backward if backward else runner.forward
             out = await asyncio.to_thread(
                 fn, int(msg["step"]), int(msg["micro"]), data,
@@ -883,9 +891,11 @@ class WorkerNode(Node):
                     "fence": msg.get("fence", 0),
                     "origin": msg.get("origin"),
                     "route": route[1:],
-                    # train mode rides every hop: each stage derives its
-                    # own (seed, stage, step, micro) dropout stream
+                    # train/infer modes ride every hop: each stage derives
+                    # its own (seed, stage, step, micro) dropout stream,
+                    # and inference hops skip the backward stash
                     "train": bool(msg.get("train", False)),
+                    "infer": bool(msg.get("infer", False)),
                     "data": blob,
                 })
             except (ConnectionError, OSError, asyncio.TimeoutError) as e:

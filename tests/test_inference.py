@@ -184,3 +184,32 @@ def test_temperature_sampling_reproducible(tiny_llama):
     c = eng.generate(ids, gen, rng=jax.random.key(8))
     np.testing.assert_array_equal(a, b)
     assert (a != c).any()
+
+
+def test_top_p_restricts_to_nucleus(tiny_llama):
+    """top_p sampling only ever draws tokens from the nucleus: with a
+    peaked distribution and small top_p it must match greedy; the
+    first (most probable) token always survives even at tiny top_p."""
+    cfg, m, p = tiny_llama
+    mesh = make_mesh(MeshConfig())
+    eng = InferenceEngine(
+        mesh, m, p, max_len=32, cache_dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    ids = np.asarray(jax.random.randint(KEY, (2, 4), 0, cfg.vocab_size))
+    greedy = eng.generate(ids, GenerationConfig(max_new_tokens=5))
+    # a tiny nucleus collapses sampling to the argmax token
+    nuc = eng.generate(
+        ids, GenerationConfig(max_new_tokens=5, temperature=0.7,
+                              top_p=1e-6),
+        rng=jax.random.key(3),
+    )
+    np.testing.assert_array_equal(nuc, greedy)
+    # a wide nucleus with temperature actually samples (differs by rng)
+    a = eng.generate(ids, GenerationConfig(max_new_tokens=5,
+                                           temperature=1.5, top_p=0.95),
+                     rng=jax.random.key(1))
+    b = eng.generate(ids, GenerationConfig(max_new_tokens=5,
+                                           temperature=1.5, top_p=0.95),
+                     rng=jax.random.key(2))
+    assert (a != b).any()

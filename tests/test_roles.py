@@ -859,3 +859,37 @@ async def test_validator_replica_failover_mid_job():
             user, val_b,
             *[w for w in workers if w.node_id != victim_id],
         )
+
+
+@pytest.mark.asyncio
+async def test_job_forward_inference_only():
+    """Pipelined inference without training state: DistributedJob.forward
+    returns the chain's output for the whole batch and leaves NO stashed
+    activations on any worker (the no-stash contract of FORWARD
+    infer=True) — the reference gets forward-only for free from
+    nn.Module; the socket path needs it explicit."""
+    reg, validator, workers, user, v_peer = await _setup_network(2)
+    try:
+        m, p = _model()
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer,
+            max_stage_bytes=16 * 32 * 4 + 200,  # 2 stages
+            micro_batches=2,
+            train={"optimizer": "sgd", "learning_rate": 0.05},
+        )
+        x = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+        out = await job.forward(x)
+        ref = np.asarray(m.apply(p, jnp.asarray(x)))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        # no gradient state left behind on any stage
+        for w in workers:
+            for runner in w.stages.values():
+                assert not runner.inputs
+                assert runner.grad_accum is None
+        # inference composes with training: a train step still works after
+        def lg(logits, micro):
+            g = np.asarray(logits, dtype=np.float32)
+            return float(np.mean(g * g)), 2 * g / g.size
+        await job.train_step(x, lg)
+    finally:
+        await _teardown(user, validator, *workers)
