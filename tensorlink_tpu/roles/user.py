@@ -337,24 +337,58 @@ class DistributedJob:
         this for free from nn.Module.forward; the socket path needs the
         explicit no-stash contract). Respects train()/eval() mode, so
         eval-mode inference is deterministic and MC-dropout inference is
-        a train() away. Elastic like train_step: a dead stage is
-        re-recruited and the pass retried."""
+        a train() away.
+
+        Elastic, with failure handling scoped to what inference actually
+        disturbs: a TRANSIENT failure just retries under a fresh
+        inference identity (no worker state to clean — nothing was
+        stashed, and stragglers can't collide with the new identity);
+        only a genuinely DEAD stage triggers the full train-style
+        recovery (fence bump + re-recruit + snapshot re-ship), which —
+        as with a failed train_step — rolls every stage back to the last
+        recovery snapshot. That rollback is loudly logged: call
+        ``checkpoint_stages()`` first if you must not lose progress
+        since the last refresh."""
         for attempt in range(self.max_step_retries + 1):
             # fresh identity per call AND per retry (see _infer_seq note)
             seq = self._infer_seq
             self._infer_seq += 1
-            try:
-                m = self.job.micro_batches
-                micros = np.array_split(np.asarray(batch_x), m)
-                outs = await asyncio.gather(*(
+            m = self.job.micro_batches
+            micros = np.array_split(np.asarray(batch_x), m)
+            tasks = [
+                asyncio.ensure_future(
                     self._micro_forward(seq, i, x, infer=True)
-                    for i, x in enumerate(micros)
-                ))
+                )
+                for i, x in enumerate(micros)
+            ]
+            try:
+                outs = await asyncio.gather(*tasks)
                 return np.concatenate([np.asarray(o) for o in outs], axis=0)
             except (ConnectionError, asyncio.TimeoutError, RuntimeError):
+                # cancel + drain siblings: an aborted attempt's micros
+                # must not keep driving the chain during the retry
+                for t in tasks:
+                    t.cancel()
+                await asyncio.gather(*tasks, return_exceptions=True)
                 if attempt == self.max_step_retries or self.validator is None:
                     raise
-                await self.recover_dead_stages(aborted=set())
+                alive = await asyncio.gather(
+                    *(self._live_stage(s) for s in self.stages)
+                )
+                if all(alive):
+                    # transient (slow hop, dropped frame): plain retry.
+                    # No ABORT_STEP — at the current fence it would wipe
+                    # a concurrent train step's gradient state without
+                    # invalidating its in-flight messages (review
+                    # finding), and inference left nothing to clean.
+                    continue
+                self.user.log.warning(
+                    "forward(): dead stage detected — recovering; ALL "
+                    "stages roll back to the last recovery snapshot "
+                    "(training progress since then is discarded)"
+                )
+                acked = await self._abort_step()  # bump fence first
+                await self.recover_dead_stages(aborted=acked)
         raise AssertionError("unreachable")
 
     async def _try_train_step(self, batch_x, loss_grad_fn) -> float:

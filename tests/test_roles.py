@@ -893,3 +893,35 @@ async def test_job_forward_inference_only():
         await job.train_step(x, lg)
     finally:
         await _teardown(user, validator, *workers)
+
+
+@pytest.mark.asyncio
+async def test_job_forward_recovers_dead_stage():
+    """forward() with a dead stage: fence-bumped recovery re-recruits and
+    the retried pass returns the (snapshot) model's output."""
+    reg, validator, workers, user, v_peer = await _setup_network(3)  # 1 spare
+    victim_id = None
+    try:
+        m, p = _model()
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer,
+            max_stage_bytes=16 * 32 * 4 + 200,
+            micro_batches=2,
+            train={"optimizer": "sgd", "learning_rate": 0.05},
+        )
+        x = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+        victim_id = job.stages[1].peer.node_id
+        victim = next(w for w in workers if w.node_id == victim_id)
+        await victim.stop()
+        out = await job.forward(x)
+        assert job.stages[1].peer.node_id != victim_id
+        # recovered pass serves the shipped (initial-snapshot) params
+        np.testing.assert_allclose(
+            out, np.asarray(m.apply(p, jnp.asarray(x))), rtol=1e-5,
+            atol=1e-6,
+        )
+    finally:
+        await _teardown(
+            user, validator,
+            *[w for w in workers if w.node_id != victim_id],
+        )
