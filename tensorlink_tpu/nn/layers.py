@@ -76,7 +76,17 @@ class Dense(Module):
         return spec
 
     def apply(self, params, x, **_):
-        y = x @ params["w"].astype(x.dtype)
+        w = params["w"]
+        if isinstance(w, dict):
+            # int8 weight-only quantization: int8 matrix + per-channel
+            # scale. Dequant fuses into the matmul under XLA; the weight
+            # stays int8 in HBM — on memory-bound decode that is the
+            # point. (Function-level import: quant walks the module tree
+            # and imports Dense.)
+            from tensorlink_tpu.ops.quant import dequantize_weight
+
+            w = dequantize_weight(w, x.dtype)
+        y = x @ w.astype(x.dtype)
         if self.use_bias:
             y = y + params["b"].astype(x.dtype)
         return y

@@ -1,0 +1,121 @@
+"""Weight-only int8 quantization for serving.
+
+The decode phase of autoregressive inference is memory-bound: every
+generated token re-reads every weight matrix from HBM. Storing Dense
+weights as int8 with a per-output-channel scale cuts that traffic 2x
+(vs bf16) to 4x (vs f32); the dequantize multiply fuses into the matmul
+under XLA, and activations/accumulation stay in the compute dtype, so
+quality loss is the per-channel rounding error only (symmetric absmax,
+~0.4% relative on typical layers).
+
+Scope: 2-D ``{"w": ...}`` leaves of Dense-shaped subtrees (matmul
+weights — where the bytes are). Embeddings, norms, biases, and KV caches
+stay in their original dtypes. Training is unaffected: quantize at
+serving time (InferenceEngine ``quantize="int8"``), never in the
+optimizer loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def quantize_weight_int8(w) -> dict:
+    """[in, out] float -> {"q": int8 [in, out], "s": f32 [out]} with a
+    symmetric per-output-channel absmax scale."""
+    w = jnp.asarray(w)
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+    s = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / s), -127, 127).astype(
+        jnp.int8
+    )
+    return {"q": q, "s": s.astype(jnp.float32)}
+
+
+def dequantize_weight(qw: dict, dtype=jnp.float32):
+    return (qw["q"].astype(dtype) * qw["s"].astype(dtype))
+
+
+def quantize_params_int8(module, params):
+    """Quantize the ``w`` of every Dense submodule of ``module``,
+    walking the MODULE tree in lockstep with the param tree — only
+    Dense.apply understands the {"q", "s"} form, so a path heuristic
+    over the params alone would also catch look-alike 2-D ``w`` leaves
+    that other code reads as raw arrays (the MoE router's
+    ``params["router"]["w"]``, T5's relative-bias table — review
+    finding: quantizing those crashes serving). Everything that is not
+    a Dense weight passes through untouched."""
+    from tensorlink_tpu.nn.layers import Dense
+
+    if isinstance(module, Dense):
+        w = params.get("w")
+        if (
+            hasattr(w, "ndim") and w.ndim == 2
+            and jnp.issubdtype(jnp.asarray(w).dtype, jnp.floating)
+        ):
+            return {**params, "w": quantize_weight_int8(w)}
+        return params
+    out = dict(params) if isinstance(params, dict) else params
+    for name, child in getattr(module, "children", {}).items():
+        if isinstance(params, dict) and name in params:
+            out[name] = quantize_params_int8(child, params[name])
+    return out
+
+
+def quantized_spec_tree(spec_tree, params):
+    """PartitionSpec tree matching a quantized param tree: ``q`` keeps
+    the weight's spec; the per-output-channel ``s`` takes the spec of the
+    weight's LAST axis (col-split weights shard their scales, row-split
+    and replicated weights replicate them)."""
+
+    def convert(spec, leaf):
+        if isinstance(leaf, dict) and set(leaf) == {"q", "s"}:
+            last = spec[-1] if isinstance(spec, P) and len(spec) else None
+            return {"q": spec, "s": P(last)}
+        return spec
+
+    # walk both trees in lockstep (specs are a prefix-shaped tree of P
+    # leaves; the quantized tree replaced some array leaves with dicts)
+    def walk(spec, leaf):
+        if isinstance(leaf, dict) and not (set(leaf) == {"q", "s"}):
+            return {k: walk(spec[k], leaf[k]) for k in leaf}
+        return convert(spec, leaf)
+
+    return walk(spec_tree, params)
+
+
+def quantization_report(params, qparams) -> dict:
+    """Bytes before/after + worst per-layer relative error — the honest
+    'what did int8 cost me' summary. Errors come from the ALREADY
+    quantized leaves in ``qparams`` (no re-quantization pass)."""
+    def nbytes(t):
+        return sum(
+            jnp.asarray(x).size * jnp.asarray(x).dtype.itemsize
+            for x in jax.tree.leaves(t)
+        )
+
+    worst = 0.0
+
+    def walk(orig, quant):
+        nonlocal worst
+        if isinstance(quant, dict) and set(quant) == {"q", "s"}:
+            d = dequantize_weight(quant) - jnp.asarray(orig, jnp.float32)
+            rel = float(
+                jnp.linalg.norm(d) / (jnp.linalg.norm(orig) + 1e-12)
+            )
+            worst = max(worst, rel)
+            return
+        if isinstance(quant, dict):
+            for k in quant:
+                walk(orig[k], quant[k])
+
+    walk(params, qparams)
+    before, after = nbytes(params), nbytes(qparams)
+    return {
+        "bytes_before": int(before),
+        "bytes_after": int(after),
+        "compression": round(before / max(after, 1), 2),
+        "worst_layer_rel_error": worst,
+    }

@@ -364,12 +364,18 @@ class DistributedJob:
             try:
                 outs = await asyncio.gather(*tasks)
                 return np.concatenate([np.asarray(o) for o in outs], axis=0)
-            except (ConnectionError, asyncio.TimeoutError, RuntimeError):
-                # cancel + drain siblings: an aborted attempt's micros
-                # must not keep driving the chain during the retry
+            except BaseException as e:
+                # cancel + drain siblings on ANY exit — including the
+                # caller's own cancellation (wait_for timeout): an
+                # aborted attempt's micros must not keep driving the
+                # chain (review finding; mirrors _try_train_step)
                 for t in tasks:
                     t.cancel()
                 await asyncio.gather(*tasks, return_exceptions=True)
+                if not isinstance(
+                    e, (ConnectionError, asyncio.TimeoutError, RuntimeError)
+                ):
+                    raise
                 if attempt == self.max_step_retries or self.validator is None:
                     raise
                 alive = await asyncio.gather(

@@ -1,0 +1,130 @@
+"""Weight-only int8 quantization (ops/quant.py): round-trip accuracy,
+tree surgery, sharding specs, and quantized serving through the
+inference engine."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tensorlink_tpu.ops.quant import (
+    dequantize_weight,
+    quantization_report,
+    quantize_params_int8,
+    quantize_weight_int8,
+    quantized_spec_tree,
+)
+
+KEY = jax.random.key(0)
+
+
+def test_weight_roundtrip_error_bounded():
+    w = jax.random.normal(KEY, (64, 32)) * 0.05
+    qw = quantize_weight_int8(w)
+    assert qw["q"].dtype == jnp.int8 and qw["s"].shape == (32,)
+    rel = float(
+        jnp.linalg.norm(dequantize_weight(qw) - w) / jnp.linalg.norm(w)
+    )
+    assert rel < 0.01  # symmetric per-channel absmax: ~0.4% typical
+    # zero column must not divide by zero
+    w0 = w.at[:, 0].set(0.0)
+    q0 = quantize_weight_int8(w0)
+    assert np.all(np.asarray(q0["q"][:, 0]) == 0)
+
+
+def test_param_tree_surgery_targets_dense_weights_only():
+    from tensorlink_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig.tiny()
+    m = Llama(cfg)
+    p = m.init(KEY)
+    q = quantize_params_int8(m, p)
+    # attention projection got quantized
+    assert q["blocks"]["0"]["attn"]["q"]["w"]["q"].dtype == jnp.int8
+    # embeddings and norms untouched
+    assert q["tok_emb"]["table"].dtype == p["tok_emb"]["table"].dtype
+    assert q["norm_f"]["scale"].dtype == p["norm_f"]["scale"].dtype
+    rep = quantization_report(p, q)
+    assert rep["compression"] > 2.0
+    assert rep["worst_layer_rel_error"] < 0.02
+
+
+def test_moe_router_and_t5_bias_not_quantized():
+    """Only Dense weights quantize: the MoE router's 2-D 'w' and T5's
+    relative-bias table are consumed as RAW arrays by their modules —
+    quantizing them crashed serving (review finding). Quantized MoE
+    generation must run."""
+    from tensorlink_tpu.models.llama import Llama, LlamaConfig
+    from tensorlink_tpu.models.t5 import T5, T5Config
+
+    mcfg = LlamaConfig.moe_tiny()
+    mm = Llama(mcfg)
+    mp = mm.init(KEY)
+    mq = quantize_params_int8(mm, mp)
+    router = mq["blocks"]["0"]["mlp"]["router"]["w"]
+    assert not isinstance(router, dict)  # untouched raw array
+    # expert stacks are 3-D (not Dense) — untouched too
+    assert not isinstance(mq["blocks"]["0"]["mlp"]["up"], dict)
+
+    t5 = T5(T5Config.tiny())
+    tp = t5.init(KEY)
+    tq = quantize_params_int8(t5, tp)
+    assert not isinstance(tq["dec_rel"]["w"], dict)
+    assert isinstance(tq["enc0"]["attn"]["q"]["w"], dict)  # Dense: yes
+
+    # quantized MoE forward actually runs
+    import jax.numpy as jnp
+    ids = jnp.ones((1, 8), jnp.int32)
+    out = mm.apply(mq, ids)
+    assert np.all(np.isfinite(np.asarray(out, np.float32)))
+
+
+def test_quantized_spec_tree_scales_follow_columns():
+    spec = {"a": {"w": P(None, "model")}, "b": {"w": P("model", None)},
+            "c": {"w": P()}}
+    params = {
+        "a": {"w": quantize_weight_int8(jnp.ones((8, 4)))},
+        "b": {"w": quantize_weight_int8(jnp.ones((8, 4)))},
+        "c": {"w": jnp.ones((4,))},  # not quantized (1-D passthrough)
+    }
+    out = quantized_spec_tree(spec, params)
+    assert out["a"]["w"] == {"q": P(None, "model"), "s": P("model")}
+    assert out["b"]["w"] == {"q": P("model", None), "s": P(None)}
+    assert out["c"]["w"] == P()
+
+
+def test_quantized_engine_generates_close_to_fp(devices):
+    """Serving with quantize='int8' on a TP mesh: tokens mostly match the
+    fp engine (greedy on a tiny model tolerates ~0.5% weight error), and
+    weights really are int8 on device."""
+    from tensorlink_tpu.config import MeshConfig
+    from tensorlink_tpu.models.llama import Llama, LlamaConfig
+    from tensorlink_tpu.parallel.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from tensorlink_tpu.runtime.mesh import make_mesh
+
+    cfg = LlamaConfig.tiny()
+    m = Llama(cfg)
+    p = m.init(KEY)
+    ids = np.asarray(jax.random.randint(KEY, (2, 5), 0, cfg.vocab_size))
+    gen = GenerationConfig(max_new_tokens=8)
+    fp = InferenceEngine(
+        make_mesh(MeshConfig()), m, p, max_len=32,
+        cache_dtype=jnp.float32, param_dtype=jnp.float32,
+    ).generate(ids, gen)
+    mesh = make_mesh(MeshConfig(model=2))
+    eng = InferenceEngine(
+        mesh, m, p, max_len=32, cache_dtype=jnp.float32,
+        param_dtype=jnp.float32, quantize="int8",
+    )
+    qleaf = eng.params["blocks"]["0"]["attn"]["q"]["w"]
+    assert qleaf["q"].dtype == jnp.int8
+    assert "model" in qleaf["q"].sharding.spec
+    q8 = eng.generate(ids, gen)
+    # greedy argmax under ~0.5% weight noise: require strong agreement,
+    # not exactness (ties can flip)
+    agree = float((q8 == fp).mean())
+    assert agree >= 0.75, (agree, q8, fp)
