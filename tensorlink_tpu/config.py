@@ -105,6 +105,20 @@ class TrainConfig:
     # (0 = off; requires PipelineParts.block_fn_aux; works under both
     # pipeline schedules)
     moe_aux_weight: float = 0.0
+    # "lora" = train ONLY LoRA adapter leaves (nn/lora.py lora_init'd
+    # params): base weights ride the same sharded update program with a
+    # zero update, so every schedule/axis combination works unchanged
+    train_only: str | None = None
+
+    def __post_init__(self):
+        # validated HERE so BOTH trainers (train/trainer.py Trainer and
+        # parallel/engine.py ShardedTrainer) reject a typo'd mode — a
+        # silently ignored train_only would full-fine-tune a run the
+        # user believes is frozen-base LoRA
+        if self.train_only not in (None, "lora"):
+            raise ValueError(
+                f"unknown train_only {self.train_only!r}; supported: 'lora'"
+            )
 
     @property
     def micro_batch_size(self) -> int:

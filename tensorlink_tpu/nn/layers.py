@@ -87,6 +87,13 @@ class Dense(Module):
 
             w = dequantize_weight(w, x.dtype)
         y = x @ w.astype(x.dtype)
+        if "lora_a" in params:
+            # LoRA adapters (nn/lora.py): two skinny matmuls on the side,
+            # scaled by the tree-carried alpha/rank
+            y = y + (
+                (x @ params["lora_a"].astype(x.dtype))
+                @ params["lora_b"].astype(x.dtype)
+            ) * params["lora_s"].astype(x.dtype)
         if self.use_bias:
             y = y + params["b"].astype(x.dtype)
         return y

@@ -1,0 +1,167 @@
+"""LoRA: low-rank adapters for parameter-efficient fine-tuning.
+
+The reference's whole purpose is fine-tuning models too big for one
+machine; LoRA shrinks that job — train two rank-r matrices per targeted
+projection instead of the full weight, cutting trainable params by
+orders of magnitude.
+
+Design (TPU-first):
+- adapters live INSIDE the Dense param dict ({"w", "lora_a", "lora_b"}),
+  so the stacked-stage engine, spec shipping, and checkpointing all see
+  one ordinary pytree — no parallel adapter registry;
+- Dense.apply adds ``(x @ a) @ b * (alpha/rank)`` when adapters are
+  present: two skinny matmuls, MXU-fine, fused by XLA;
+- freezing is ``mask_to_lora`` applied by both trainers to the GRADS
+  (before clipping/optimizer, so frozen params neither dominate the
+  clip norm nor accumulate moments) and to the final updates (AdamW's
+  decoupled weight decay moves params even at zero grad) — simple and
+  schedule-agnostic (GPipe and 1F1B unchanged). Moment buffers are
+  still allocated for frozen params (sharded; a masked-optimizer
+  variant could reclaim them later) — the big wins here are the tiny
+  gradient math and the tiny checkpoint/update deltas;
+- ``lora_merge`` folds the adapters back into ``w`` for serving at
+  exactly base-model cost (and composes with int8 quantization).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+LORA_KEYS = ("lora_a", "lora_b")  # trainable adapter leaves
+LORA_ALL = LORA_KEYS + ("lora_s",)
+
+
+def lora_init(
+    module,
+    params,
+    key,
+    *,
+    rank: int = 8,
+    alpha: float = 16.0,
+    targets: tuple = ("q", "k", "v", "o", "up", "gate", "down"),
+    _name: str = "",
+):
+    """Add {lora_a, lora_b} to every Dense child whose NAME is in
+    ``targets`` (attention projections and/or MLP, per convention).
+    ``a`` is small-normal, ``b`` zeros — the adapted model starts
+    exactly at the base model. Returns a NEW param tree."""
+    from tensorlink_tpu.nn.layers import Dense, _normal
+
+    if isinstance(module, Dense):
+        if _name in targets and "w" in params:
+            ka, _ = jax.random.split(key)
+            w = params["w"]
+            return {
+                **params,
+                # both adapter halves follow the BASE weight's dtype —
+                # mixed a/b dtypes would skew checkpoint bytes and
+                # moment dtypes between the pair
+                "lora_a": _normal(
+                    ka, (w.shape[0], rank), stddev=0.01
+                ).astype(w.dtype),
+                "lora_b": jnp.zeros((rank, w.shape[1]), w.dtype),
+                # self-describing scale: the tree (not module attrs)
+                # carries alpha/rank, so spec-shipping and merge need no
+                # side-channel configuration
+                "lora_s": jnp.float32(alpha / rank),
+            }
+        return params
+    out = dict(params) if isinstance(params, dict) else params
+    for name, child in getattr(module, "children", {}).items():
+        if isinstance(params, dict) and name in params:
+            key, sub = jax.random.split(key)
+            out[name] = lora_init(
+                child, params[name], sub, rank=rank, alpha=alpha,
+                targets=targets, _name=name,
+            )
+    return out
+
+
+def lora_scale(rank: int, alpha: float) -> float:
+    return alpha / rank
+
+
+def lora_merge(module, params):
+    """Fold adapters into the base weights: w += a @ b * lora_s,
+    dropping the adapter leaves — serving then costs exactly the base
+    model (and the merged tree quantizes like any other)."""
+    from tensorlink_tpu.nn.layers import Dense
+
+    if isinstance(module, Dense):
+        if "lora_a" in params:
+            delta = (
+                params["lora_a"].astype(jnp.float32)
+                @ params["lora_b"].astype(jnp.float32)
+            ) * params["lora_s"]
+            merged = {
+                k: v for k, v in params.items() if k not in LORA_ALL
+            }
+            merged["w"] = (
+                params["w"].astype(jnp.float32) + delta
+            ).astype(params["w"].dtype)
+            return merged
+        return params
+    out = dict(params) if isinstance(params, dict) else params
+    for name, child in getattr(module, "children", {}).items():
+        if isinstance(params, dict) and name in params:
+            out[name] = lora_merge(child, params[name])
+    return out
+
+
+def lora_spec_tree(spec_tree, params):
+    """Patch a PartitionSpec tree for a LoRA'd param tree (structural,
+    like ops/quant.quantized_spec_tree): where params carry adapters,
+    derive their specs from the base weight's — ``a`` shards its in-dim
+    like w's rows, ``b`` its out-dim like w's columns, the scale
+    replicates. Works for any nesting (engine patches per-layer specs
+    before stacking)."""
+    from jax.sharding import PartitionSpec as P
+
+    def walk(spec, leaf):
+        if isinstance(leaf, dict) and "lora_a" in leaf and "w" in leaf:
+            wspec = spec["w"]
+            row = wspec[0] if isinstance(wspec, P) and len(wspec) > 0 else None
+            col = wspec[1] if isinstance(wspec, P) and len(wspec) > 1 else None
+            return {
+                **spec,
+                "lora_a": P(row, None),
+                "lora_b": P(None, col),
+                "lora_s": P(),
+            }
+        if isinstance(leaf, dict):
+            return {
+                k: (walk(spec[k], leaf[k]) if k in spec else spec.get(k))
+                for k in leaf
+            } if isinstance(spec, dict) else spec
+        return spec
+
+    return walk(spec_tree, params)
+
+
+def mask_to_lora(updates):
+    """Zero every update that is not an adapter leaf: base weights (and
+    the scale) freeze while riding the SAME sharded optimizer program —
+    schedule-agnostic (GPipe/1F1B/DP/TP unchanged)."""
+    def mask(path, u):
+        trainable = any(
+            getattr(k, "key", None) in LORA_KEYS for k in path
+        )
+        return u if trainable else jnp.zeros_like(u)
+
+    return jax.tree_util.tree_map_with_path(mask, updates)
+
+
+def trainable_leaf_count(params) -> tuple[int, int]:
+    """(lora trainable, total) parameter counts — the brag numbers."""
+    import numpy as np
+
+    total = lora = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        n = int(np.prod(jnp.asarray(leaf).shape))
+        total += n
+        if any(
+            getattr(k, "key", None) in LORA_KEYS for k in path
+        ):
+            lora += n
+    return lora, total

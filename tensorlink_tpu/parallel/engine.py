@@ -74,9 +74,19 @@ class PipelineParts:
     head_per_token: bool | None = None
 
 
-def _stacked_spec(block: Module, num_stages: int, model_axis="model"):
-    """Per-block PartitionSpec tree -> stacked [pipe, layer, ...] specs."""
+def _stacked_spec(
+    block: Module, num_stages: int, model_axis="model",
+    example_layer_params=None,
+):
+    """Per-block PartitionSpec tree -> stacked [pipe, layer, ...] specs.
+    ``example_layer_params`` (one layer's params) lets the spec tree
+    follow param-tree surgery the module can't know about (LoRA
+    adapters)."""
     spec = block.param_spec(model_axis)
+    if example_layer_params is not None:
+        from tensorlink_tpu.nn.lora import lora_spec_tree
+
+        spec = lora_spec_tree(spec, example_layer_params)
     return jax.tree.map(
         lambda s: P("pipe", None, *s),
         spec,
@@ -125,7 +135,8 @@ class ShardedTrainer:
                 "once over the full batch there) or renormalize per "
                 "example and declare loss_reduction='uniform_mean'."
             )
-        self.loss_reduction = loss_reduction
+        self.loss_reduction = loss_reduction  # train_only validated by
+        # TrainConfig.__post_init__ (shared with the single-host Trainer)
         self.num_stages = mesh.shape["pipe"]
         L = len(parts.block_params)
         if L % self.num_stages:
@@ -197,7 +208,12 @@ class ShardedTrainer:
         self.compute_dtype = jnp.dtype(cfg.dtype)
 
         # shardings ----------------------------------------------------
-        stacked_specs = _stacked_spec(parts.block, self.num_stages)
+        from tensorlink_tpu.nn.lora import lora_spec_tree
+
+        stacked_specs = _stacked_spec(
+            parts.block, self.num_stages,
+            example_layer_params=parts.block_params["0"],
+        )
         embed_specs = (
             embed_module.param_spec() if embed_module is not None
             else jax.tree.map(lambda _: P(), parts.embed_params)
@@ -206,6 +222,9 @@ class ShardedTrainer:
             head_module.param_spec() if head_module is not None
             else jax.tree.map(lambda _: P(), parts.head_params)
         )
+        # adapters may also live in embed/head trees (e.g. a LoRA'd head)
+        embed_specs = lora_spec_tree(embed_specs, parts.embed_params)
+        head_specs = lora_spec_tree(head_specs, parts.head_params)
         self.param_specs = {
             "embed": embed_specs,
             "stages": stacked_specs,
@@ -372,6 +391,16 @@ class ShardedTrainer:
             loss, grads = self._loss_and_grads_1f1b(state.params, batch, rng)
         else:
             loss, grads = jax.value_and_grad(self._loss)(state.params, batch, rng)
+        if self.cfg.train_only == "lora":
+            # parameter-efficient fine-tune, inside the SAME sharded
+            # program (schedules/axes unchanged). Grads mask BEFORE
+            # clipping/optimizer — frozen params must not dominate the
+            # clip norm (>99% of it) or accumulate Adam moments — and
+            # updates mask again AFTER: AdamW's decoupled weight decay
+            # updates params even at zero grad (review finding).
+            from tensorlink_tpu.nn.lora import mask_to_lora
+
+            grads = mask_to_lora(grads)
         if self.cfg.grad_clip_norm:
             grads, gnorm = clip_by_global_norm(grads, self.cfg.grad_clip_norm)
         else:
@@ -379,6 +408,10 @@ class ShardedTrainer:
         updates, opt_state = self.optimizer.update(
             grads, state.opt_state, state.params, state.step
         )
+        if self.cfg.train_only == "lora":
+            from tensorlink_tpu.nn.lora import mask_to_lora
+
+            updates = mask_to_lora(updates)
         params = apply_updates(state.params, updates)
         return (
             TrainState(params=params, opt_state=opt_state, step=state.step + 1),

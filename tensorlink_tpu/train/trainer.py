@@ -134,6 +134,14 @@ class Trainer:
             grads, losses = jax.lax.scan(body, zero, (mb, rngs))
             loss = jnp.mean(losses)
 
+        if self.cfg.train_only == "lora":
+            # mask GRADS before clipping/optimizer (frozen params must
+            # not pollute the clip norm or accumulate moments) AND the
+            # final updates (AdamW's decoupled weight decay would
+            # otherwise shrink frozen weights with zero grad)
+            from tensorlink_tpu.nn.lora import mask_to_lora
+
+            grads = mask_to_lora(grads)
         if self.cfg.grad_clip_norm:
             grads, gnorm = clip_by_global_norm(grads, self.cfg.grad_clip_norm)
         else:
@@ -141,6 +149,10 @@ class Trainer:
         updates, opt_state = self.optimizer.update(
             grads, state.opt_state, state.params, state.step
         )
+        if self.cfg.train_only == "lora":
+            from tensorlink_tpu.nn.lora import mask_to_lora
+
+            updates = mask_to_lora(updates)
         params = apply_updates(state.params, updates)
         new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
         return new_state, {"loss": loss, "grad_norm": gnorm}

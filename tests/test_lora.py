@@ -1,0 +1,124 @@
+"""LoRA parameter-efficient fine-tuning: adapter math, engine training
+with frozen base, merge-for-serving, int8 composition."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.nn.lora import (
+    lora_init,
+    lora_merge,
+    mask_to_lora,
+    trainable_leaf_count,
+)
+
+KEY = jax.random.key(0)
+
+
+def _gpt2():
+    from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config
+
+    m = GPT2(GPT2Config(vocab_size=128, dim=32, num_layers=4, num_heads=2,
+                        max_len=64, dropout=0.0))
+    return m, m.init(KEY)
+
+
+def test_adapters_start_as_identity_and_merge_exactly():
+    m, p = _gpt2()
+    lp = lora_init(m, p, jax.random.key(1), rank=4, alpha=8.0)
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 128, (2, 16)))
+    # b = 0 -> adapted model IS the base model at init
+    np.testing.assert_array_equal(
+        np.asarray(m.apply(lp, ids)), np.asarray(m.apply(p, ids))
+    )
+    # perturb b, then merged-weights forward == adapted forward
+    lp2 = jax.tree_util.tree_map_with_path(
+        lambda path, x: (
+            x + 0.01 if any(getattr(k, "key", None) == "lora_b" for k in path)
+            else x
+        ),
+        lp,
+    )
+    merged = lora_merge(m, lp2)
+    assert "lora_a" not in merged["blocks"]["0"]["attn"]["q"]
+    np.testing.assert_allclose(
+        np.asarray(m.apply(merged, ids)), np.asarray(m.apply(lp2, ids)),
+        rtol=2e-5, atol=2e-5,
+    )
+    lora_n, total = trainable_leaf_count(lp)
+    # rank/dim is 4/32 on this tiny model — real models are 8/4096; the
+    # assertion pins the direction, not production magnitude
+    assert 0 < lora_n < 0.2 * total
+
+
+def test_mask_to_lora_zeroes_base_updates():
+    m, p = _gpt2()
+    lp = lora_init(m, p, jax.random.key(1))
+    fake_updates = jax.tree.map(jnp.ones_like, lp)
+    masked = mask_to_lora(fake_updates)
+    q = masked["blocks"]["0"]["attn"]["q"]
+    assert float(jnp.sum(jnp.abs(q["w"]))) == 0.0
+    assert float(jnp.sum(jnp.abs(q["lora_a"]))) > 0
+    assert float(jnp.sum(jnp.abs(q["lora_b"]))) > 0
+    assert float(jnp.sum(jnp.abs(masked["wte"]["table"]))) == 0.0
+
+
+@pytest.mark.parametrize("sched", ["gpipe", "1f1b"])
+def test_engine_lora_trains_adapters_only(devices, sched):
+    """train_only='lora' on the full DP x PP x TP engine: loss decreases,
+    adapter leaves move, every base leaf stays bitwise frozen — under
+    BOTH pipeline schedules."""
+    from tensorlink_tpu.config import MeshConfig, TrainConfig
+    from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config
+    from tensorlink_tpu.parallel.engine import ShardedTrainer
+    from tensorlink_tpu.runtime.mesh import make_mesh
+    from tensorlink_tpu.train.trainer import softmax_cross_entropy
+
+    m = GPT2(GPT2Config(vocab_size=128, dim=32, num_layers=4, num_heads=2,
+                        max_len=64, dropout=0.0))
+    p = m.init(KEY)
+    lp = lora_init(m, p, jax.random.key(1), rank=4, alpha=8.0)
+    parts = m.as_pipeline_parts(lp)
+    mesh = make_mesh(MeshConfig(data=2, pipe=2, model=2))
+    cfg = TrainConfig(batch_size=8, micro_batches=4, learning_rate=5e-2,
+                      optimizer="adamw", dtype="float32",
+                      pp_schedule=sched, train_only="lora")
+    tr = ShardedTrainer(mesh, cfg, parts,
+                        lambda lg, b: softmax_cross_entropy(lg, b["labels"]))
+    state = tr.init_state()
+    before = jax.tree.map(np.asarray, state.params)
+    r = np.random.default_rng(0)
+    ids = r.integers(0, 128, (8, 17))
+    batch = {"input_ids": jnp.asarray(ids[:, :-1]),
+             "labels": jnp.asarray(ids[:, 1:])}
+    losses = []
+    for _ in range(5):
+        state, met = tr.train_step(state, batch)
+        losses.append(float(met["loss"]))
+    assert losses[-1] < losses[0]
+    after = jax.tree.map(np.asarray, state.params)
+
+    moved = frozen_ok = True
+    for (path, b), (_, a) in zip(
+        jax.tree_util.tree_flatten_with_path(before)[0],
+        jax.tree_util.tree_flatten_with_path(after)[0],
+    ):
+        is_lora = any(
+            getattr(k, "key", None) in ("lora_a", "lora_b") for k in path
+        )
+        if is_lora:
+            moved = moved and not np.array_equal(a, b)
+        else:
+            frozen_ok = frozen_ok and np.array_equal(a, b)
+    assert moved, "adapters did not train"
+    assert frozen_ok, "a base leaf changed under train_only='lora'"
+
+
+def test_lora_merge_composes_with_int8(devices):
+    from tensorlink_tpu.ops.quant import quantize_params_int8
+
+    m, p = _gpt2()
+    lp = lora_init(m, p, jax.random.key(1))
+    q = quantize_params_int8(m, lora_merge(m, lp))
+    assert q["blocks"]["0"]["attn"]["q"]["w"]["q"].dtype == jnp.int8
