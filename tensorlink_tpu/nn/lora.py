@@ -39,17 +39,18 @@ def lora_init(
     *,
     rank: int = 8,
     alpha: float = 16.0,
-    targets: tuple = ("q", "k", "v", "o", "up", "gate", "down"),
+    targets: tuple | None = ("q", "k", "v", "o", "up", "gate", "down"),
     _name: str = "",
 ):
     """Add {lora_a, lora_b} to every Dense child whose NAME is in
-    ``targets`` (attention projections and/or MLP, per convention).
-    ``a`` is small-normal, ``b`` zeros — the adapted model starts
-    exactly at the base model. Returns a NEW param tree."""
+    ``targets`` (attention projections and/or MLP, per convention;
+    None = every Dense — e.g. a plain Sequential whose children are
+    named by index). ``a`` is small-normal, ``b`` zeros — the adapted
+    model starts exactly at the base model. Returns a NEW param tree."""
     from tensorlink_tpu.nn.layers import Dense, _normal
 
     if isinstance(module, Dense):
-        if _name in targets and "w" in params:
+        if (targets is None or _name in targets) and "w" in params:
             ka, _ = jax.random.split(key)
             w = params["w"]
             return {

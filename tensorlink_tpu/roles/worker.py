@@ -105,6 +105,18 @@ class StageRunner:
     # dropout mask derived per (seed, stage, step, micro) so BACKWARD's
     # recompute — and a validator's replay — reproduce it exactly.
     train_seed: int | None = None
+    # "lora" = only adapter leaves update (MODULE_SPEC train.train_only);
+    # same double-mask semantics as the mesh trainers: grads before the
+    # optimizer (clip-norm/moment hygiene), updates after (AdamW decay
+    # moves frozen params even at zero grad)
+    train_only: str | None = None
+
+    def _mask_if_lora(self, tree):
+        if self.train_only != "lora":
+            return tree
+        from tensorlink_tpu.nn.lora import mask_to_lora
+
+        return mask_to_lora(tree)
 
     def _max_tp_width(self, spec, want: int) -> int:
         """Largest width <= want that divides EVERY model-sharded param
@@ -134,7 +146,12 @@ class StageRunner:
         argument shardings alone."""
         from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-        spec = self.module.param_spec("model")
+        from tensorlink_tpu.nn.lora import lora_spec_tree
+
+        # the module's own spec knows nothing about param-tree surgery —
+        # LoRA'd stages carry adapter leaves the spec tree must mirror or
+        # every tree.map against params raises a structure mismatch
+        spec = lora_spec_tree(self.module.param_spec("model"), self.params)
         width = self._max_tp_width(spec, len(self.devices))
         if width <= 1:
             self._x_sharding = None
@@ -372,11 +389,11 @@ class StageRunner:
             self.micro_seen = 0
             if master_step is not None:
                 self.last_applied_step = master_step
-        grads = jax.tree.map(lambda g: g / n, grads)
+        grads = self._mask_if_lora(jax.tree.map(lambda g: g / n, grads))
         updates, self.opt_state = self.opt.update(
             grads, self.opt_state, self.params, self.step
         )
-        self.params = apply_updates(self.params, updates)
+        self.params = apply_updates(self.params, self._mask_if_lora(updates))
         self.step += 1
         return True
 
@@ -397,6 +414,12 @@ class StageRunner:
             g, n = self.grad_accum, self.micro_seen
             self.grad_accum = None
             self.micro_seen = 0
+        # mask BEFORE the replica exchange: shipping base-weight grads
+        # that apply_synced would zero anyway is exactly the bandwidth
+        # LoRA exists to avoid (mask is linear + idempotent, so the
+        # deterministic cross-replica sum is unaffected)
+        if g is not None:
+            g = self._mask_if_lora(g)
         return g, n
 
     def restore_accum(self, g, n: int, master_step: int | None, fence: int) -> None:
@@ -436,7 +459,7 @@ class StageRunner:
             if g is None:
                 continue
             acc = g if acc is None else jax.tree.map(jnp.add, acc, g)
-        grads = jax.tree.map(lambda x: x / total_n, acc)
+        grads = self._mask_if_lora(jax.tree.map(lambda x: x / total_n, acc))
         with self._lock:
             if master_step is not None and master_step <= self.last_applied_step:
                 return False
@@ -445,7 +468,7 @@ class StageRunner:
         updates, self.opt_state = self.opt.update(
             grads, self.opt_state, self.params, self.step
         )
-        self.params = apply_updates(self.params, updates)
+        self.params = apply_updates(self.params, self._mask_if_lora(updates))
         self.step += 1
         return True
 
@@ -589,6 +612,8 @@ class WorkerNode(Node):
             local = jax.local_devices()
             devices = local if tp == -1 else local[: min(tp, len(local))]
         seed = train.get("seed")
+        t_only = train.get("train_only")  # validated pre-transfer by
+        # _validate_train_meta on both spec entry paths
         runner = StageRunner(
             job_id=str(meta["job_id"]),
             stage_index=int(meta["stage"]),
@@ -598,6 +623,7 @@ class WorkerNode(Node):
             opt_state=opt.init(params),
             devices=devices,
             train_seed=int(seed) if seed is not None else None,
+            train_only=t_only,
             owner=peer.node_id,
             replica=int(meta.get("replica", 0)),
             replica_peers=[
@@ -629,9 +655,26 @@ class WorkerNode(Node):
             "param_bytes": tree_bytes(params),
         }
 
+    @staticmethod
+    def _validate_train_meta(meta: dict) -> dict | None:
+        """Cheap schema checks that must run BEFORE authorization and
+        transfer: rejecting a typo'd train_only after streaming a
+        multi-GB stage (and consuming the reservation) wastes the whole
+        shipment (review finding)."""
+        t_only = dict(meta.get("train") or {}).get("train_only")
+        if t_only not in (None, "lora"):
+            return {
+                "type": "ERROR",
+                "error": f"unknown train_only {t_only!r}; supported: 'lora'",
+            }
+        return None
+
     async def _h_module_spec(self, node, peer, msg) -> dict:
         """One-shot path: spec + weights in a single message (small
         stages; large ones arrive via the module_spec stream kind)."""
+        err = self._validate_train_meta(msg)
+        if err is not None:
+            return err
         key = (str(msg["job_id"]), int(msg["stage"]))
         # params + grads + 2x Adam moments + activation slack, measured
         # on the UNCOMPRESSED manifest bytes — len(blob) is zstd-sized
@@ -658,6 +701,9 @@ class WorkerNode(Node):
         """Stream-kind factory: a stage too large for one frame arrives
         tensor-by-tensor; each tensor moves to device the moment it
         completes, so host memory is bounded by the largest tensor."""
+        err = self._validate_train_meta(meta)
+        if err is not None:
+            return err
         key = (str(meta["job_id"]), int(meta["stage"]))
         err = self._authorize_spec(
             key, peer, int(manifest["total"]) * 4 + (64 << 20)
