@@ -970,6 +970,11 @@ class UserNode(Node):
             # the specs shipped would leave loaded stages + reservations
             # orphaned on every worker (review finding)
             raise ValueError("relay transfer is incompatible with obfuscation")
+        if obfuscate and (train or {}).get("train_only") == "lora":
+            # the rotation plan folds only w/b (privacy.py): adapters
+            # would train in the rotated basis while lora_merge later
+            # adds them in the clear one — silently wrong weights
+            raise ValueError("obfuscation is incompatible with train_only='lora'")
         stage_parts = partition_sequential(model, params, max_stage_bytes)
         plan = None
         key = None

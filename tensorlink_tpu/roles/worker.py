@@ -614,6 +614,20 @@ class WorkerNode(Node):
         seed = train.get("seed")
         t_only = train.get("train_only")  # validated pre-transfer by
         # _validate_train_meta on both spec entry paths
+        if t_only == "lora":
+            from tensorlink_tpu.nn.lora import trainable_leaf_count
+
+            if trainable_leaf_count(params)[0] == 0:
+                # adapter-only training with zero adapter leaves would
+                # run to completion applying all-zero updates — loss
+                # flat, no diagnostic (review finding). The user forgot
+                # lora_init (or its targets matched nothing here).
+                return {
+                    "type": "ERROR",
+                    "error": "train_only='lora' but the shipped stage "
+                             "carries no LoRA adapter leaves (run "
+                             "nn.lora.lora_init on the params first)",
+                }
         runner = StageRunner(
             job_id=str(meta["job_id"]),
             stage_index=int(meta["stage"]),

@@ -99,3 +99,34 @@ def test_local_npz_fallback(tmp_path):
     out = load_arrays_local(p)
     np.testing.assert_array_equal(out["a"]["w"], tree["a"]["w"])
     assert float(out["b"]) == 2.5
+
+
+def test_checkpoint_roundtrips_lora_and_int8_trees(tmp_path):
+    """Adapter and quantized param trees are ordinary pytrees by design —
+    the checkpoint manager must round-trip them bit-exactly (int8 dtypes
+    included), since PEFT runs checkpoint adapters constantly."""
+    import jax
+    import numpy as np
+
+    from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config
+    from tensorlink_tpu.nn.lora import lora_init, lora_merge
+    from tensorlink_tpu.ops.quant import quantize_params_int8
+    from tensorlink_tpu.runtime.checkpoint import CheckpointManager
+
+    m = GPT2(GPT2Config(vocab_size=64, dim=32, num_layers=2, num_heads=2,
+                        max_len=32, dropout=0.0))
+    p = m.init(jax.random.key(0))
+    lp = lora_init(m, p, jax.random.key(1), rank=4)
+    qp = quantize_params_int8(m, lora_merge(m, lp))
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=False)
+    mgr.save(0, {"lora": lp, "quant": qp}, force=True)
+    restored = mgr.restore(step=0)
+    for name, ref in (("lora", lp), ("quant", qp)):
+        got = restored[name]
+        for (pa, a), (_, b) in zip(
+            jax.tree_util.tree_flatten_with_path(ref)[0],
+            jax.tree_util.tree_flatten_with_path(got)[0],
+        ):
+            assert np.asarray(a).dtype == np.asarray(b).dtype, pa
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -220,3 +220,47 @@ def test_stage_runner_tp_with_lora(devices):
     # TP actually engaged and adapters sharded consistently with w
     qw = runner.params["0"]["attn"]["q"]
     assert len(qw["w"].sharding.device_set) == 2
+
+
+@pytest.mark.asyncio
+async def test_lora_composition_guards():
+    """Silently-wrong combinations are rejected up front: obfuscation
+    rotates only w/b (adapters would merge in the wrong basis), and a
+    lora job whose params carry no adapters would train nothing."""
+    from tensorlink_tpu.config import NodeConfig
+    from tensorlink_tpu.nn.layers import Dense
+    from tensorlink_tpu.nn.module import Sequential
+    from tensorlink_tpu.roles.registry import InMemoryRegistry
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    reg = InMemoryRegistry()
+    validator = ValidatorNode(
+        NodeConfig(role="validator", port=0), registry=reg
+    )
+    await validator.start()
+    worker = WorkerNode(NodeConfig(role="worker", port=0))
+    await worker.start()
+    await worker.connect("127.0.0.1", validator.port)
+    user = UserNode(NodeConfig(role="user", port=0))
+    await user.start()
+    v_peer = await user.connect("127.0.0.1", validator.port)
+    try:
+        m = Sequential([Dense(16, 4)])
+        p = m.init(KEY)
+        lp = lora_init(m, p, jax.random.key(1), rank=2, targets=None)
+        with pytest.raises(ValueError, match="obfuscation"):
+            await user.request_job(
+                m, lp, v_peer, obfuscate=True,
+                train={"optimizer": "sgd", "train_only": "lora"},
+            )
+        # no adapters shipped -> the worker refuses the stage
+        with pytest.raises(RuntimeError, match="no LoRA adapter"):
+            await user.request_job(
+                m, p, v_peer,
+                train={"optimizer": "sgd", "train_only": "lora"},
+            )
+    finally:
+        for n in (user, validator, worker):
+            await n.stop()
