@@ -563,18 +563,13 @@ def main() -> None:
                     lambda s, _: moe_step(s, b), st, None, length=10
                 )
 
-            mcomp = moe_multi.lower(mstate, mbatch).compile()
-            mstate, ml = mcomp(mstate, mbatch)
-            float(ml[-1])
-            t0 = time.perf_counter()
-            mstate, ml = mcomp(mstate, mbatch)
-            float(ml[-1])
-            dt = (time.perf_counter() - t0) / 10
-            out["moe_tokens_per_sec"] = round(Bm * Tm / dt, 1)
             # router drop fraction on the input layer 0's router actually
-            # sees: pre-norm block order is norm2(x + attn(norm1(x)))
-            # (review finding: the raw embedding has a different
-            # scale/correlation and can misstate capacity drops)
+            # sees (pre-norm block order norm2(x + attn(norm1(x))) — the
+            # raw embedding has a different scale/correlation and can
+            # misstate capacity drops). Computed FIRST: mcomp donates
+            # mstate, whose leaves alias mparams — reading them after
+            # hits deleted buffers (observed live r4: "Array has been
+            # deleted")
             blk = mmodel.children["blocks"].children["0"]
             bp0 = mparams["blocks"]["0"]
             emb = mmodel.children["tok_emb"].apply(
@@ -586,7 +581,17 @@ def main() -> None:
             )
             router_in = blk.children["norm2"].apply(bp0["norm2"], emb + a)
             rs = blk.children["mlp"].routing_stats(bp0["mlp"], router_in)
-            out["moe_router_drop_fraction"] = round(rs["drop_fraction"], 4)
+            drop_frac = float(rs["drop_fraction"])
+
+            mcomp = moe_multi.lower(mstate, mbatch).compile()
+            mstate, ml = mcomp(mstate, mbatch)
+            float(ml[-1])
+            t0 = time.perf_counter()
+            mstate, ml = mcomp(mstate, mbatch)
+            float(ml[-1])
+            dt = (time.perf_counter() - t0) / 10
+            out["moe_tokens_per_sec"] = round(Bm * Tm / dt, 1)
+            out["moe_router_drop_fraction"] = round(drop_frac, 4)
             out["moe_config"] = (
                 f"MoE-Llama d{mcfg.dim} L{mcfg.num_layers} "
                 f"E{mcfg.moe_experts} top{mcfg.moe_top_k} bf16, "

@@ -109,6 +109,10 @@ class TrainConfig:
     # params): base weights ride the same sharded update program with a
     # zero update, so every schedule/axis combination works unchanged
     train_only: str | None = None
+    # FSDP/ZeRO-3: shard params + optimizer moments over the ``data``
+    # axis as well (parallel/dp.py fsdp_spec_tree); XLA all-gathers at
+    # use and reduce-scatters grads. Replicated DP otherwise.
+    fsdp: bool = False
 
     def __post_init__(self):
         # validated HERE so BOTH trainers (train/trainer.py Trainer and

@@ -74,6 +74,16 @@ class Trainer:
         self.module = module
         self.loss_fn = loss_fn
         self.cfg = cfg
+        if cfg.fsdp:
+            # same convention as the train_only guard: a mode this class
+            # cannot honor must fail loudly, not run silently replicated
+            raise ValueError(
+                "TrainConfig(fsdp=True) has no effect on the single-host "
+                "Trainer: wrap its ._step with "
+                "parallel.dp.fsdp_train_step(step, mesh, state) (which "
+                "shards params+moments over the data axis), or use "
+                "ShardedTrainer on a mesh with a data axis"
+            )
         sched = make_schedule(
             cfg.schedule, cfg.learning_rate, cfg.warmup_steps, cfg.total_steps
         )
