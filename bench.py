@@ -185,11 +185,11 @@ def backend_with_retry(budget_s: float | None = None):
     sys.exit(1)
 
 
-def build(batch_size: int, seq: int):
+def build(batch_size: int, seq: int, moment_dtype: str = "float32"):
     cfg = BertConfig.tiny() if _BERT == "tiny" else BertConfig.base()
     model = BertClassifier(cfg, num_classes=CLASSES)
     params = model.init(jax.random.key(0))
-    opt = make_optimizer("adam", 2e-5)
+    opt = make_optimizer("adam", 2e-5, moment_dtype=moment_dtype)
     state = TrainState.create(params, opt)
 
     r = np.random.default_rng(0)
@@ -458,6 +458,25 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001 — OOM at 128 is fine
                 sweep[str(b2)] = f"error: {str(e)[:80]}"
         out["batch_sweep_samples_per_sec"] = sweep
+
+    # -- bf16 optimizer moments at the headline shape: the roofline says
+    # batch 32 is memory-bound and m+v are a third of the state bytes —
+    # this measures what halving them buys (opt_moment_dtype feature)
+    if os.environ.get("BENCH_BF16_MOM", "1") == "1" and _BERT == "base":
+        try:
+            _, stm, bam, onem, multim = build(
+                BATCH, SEQ, moment_dtype="bfloat16"
+            )
+            dtm, _ = measure(stm, bam, multim)
+            spsm = BATCH * STEPS_PER_CALL / dtm
+            out["bf16_moments_samples_per_sec"] = round(spsm, 2)
+            fm, _ = xla_step_cost(onem, stm, bam)
+            if fm and peak:
+                out["bf16_moments_mfu"] = round(
+                    fm * (STEPS_PER_CALL / dtm) / 1e12 / peak, 4
+                )
+        except Exception as e:  # noqa: BLE001 — must not sink the headline
+            out["bf16_moments_error"] = str(e)[:200]
 
     # -- secondary: seq 512 where attention carries real weight ---------
     if _LONG and _BERT == "base":

@@ -113,6 +113,9 @@ class TrainConfig:
     # axis as well (parallel/dp.py fsdp_spec_tree); XLA all-gathers at
     # use and reduce-scatters grads. Replicated DP otherwise.
     fsdp: bool = False
+    # storage dtype of adam/adamw m+v ("bfloat16" halves optimizer-state
+    # bytes and HBM traffic; update math stays f32 — train/optim.py)
+    opt_moment_dtype: str = "float32"
 
     def __post_init__(self):
         # validated HERE so BOTH trainers (train/trainer.py Trainer and

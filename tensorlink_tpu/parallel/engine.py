@@ -211,15 +211,18 @@ class ShardedTrainer:
         sched = make_schedule(
             cfg.schedule, cfg.learning_rate, cfg.warmup_steps, cfg.total_steps
         )
-        self.optimizer = make_optimizer(cfg.optimizer, sched, cfg.weight_decay)
+        self.optimizer = make_optimizer(
+            cfg.optimizer, sched, cfg.weight_decay,
+            moment_dtype=cfg.opt_moment_dtype,
+        )
         self.compute_dtype = jnp.dtype(cfg.dtype)
 
         # shardings ----------------------------------------------------
         from tensorlink_tpu.nn.lora import lora_spec_tree
         from tensorlink_tpu.parallel.dp import fsdp_spec_tree
 
-        fsdp_n = mesh.shape.get("data", 1) if getattr(cfg, "fsdp", False) else 1
-        if getattr(cfg, "fsdp", False) and fsdp_n <= 1:
+        fsdp_n = mesh.shape.get("data", 1) if cfg.fsdp else 1
+        if cfg.fsdp and fsdp_n <= 1:
             import logging
 
             logging.getLogger("tensorlink_tpu.engine").warning(

@@ -605,6 +605,7 @@ class WorkerNode(Node):
             train.get("optimizer", "adam"),
             float(train.get("learning_rate", 1e-3)),
             float(train.get("weight_decay", 0.0)),
+            moment_dtype=train.get("moment_dtype", "float32"),
         )
         tp = self.cfg.stage_tp_devices
         devices = None
@@ -675,11 +676,26 @@ class WorkerNode(Node):
         transfer: rejecting a typo'd train_only after streaming a
         multi-GB stage (and consuming the reservation) wastes the whole
         shipment (review finding)."""
-        t_only = dict(meta.get("train") or {}).get("train_only")
+        train = dict(meta.get("train") or {})
+        t_only = train.get("train_only")
         if t_only not in (None, "lora"):
             return {
                 "type": "ERROR",
                 "error": f"unknown train_only {t_only!r}; supported: 'lora'",
+            }
+        mdt = train.get("moment_dtype", "float32")
+        if mdt not in ("float32", "bfloat16"):
+            return {
+                "type": "ERROR",
+                "error": f"unsupported moment_dtype {mdt!r}; supported: "
+                         "'float32', 'bfloat16'",
+            }
+        if mdt != "float32" and train.get("optimizer", "adam") == "sgd":
+            # make_optimizer would raise this AFTER the stage shipped
+            return {
+                "type": "ERROR",
+                "error": "moment_dtype is an adam/adamw option (sgd "
+                         "stores no moments)",
             }
         return None
 

@@ -88,7 +88,8 @@ class Trainer:
             cfg.schedule, cfg.learning_rate, cfg.warmup_steps, cfg.total_steps
         )
         self.optimizer = optimizer or make_optimizer(
-            cfg.optimizer, sched, cfg.weight_decay
+            cfg.optimizer, sched, cfg.weight_decay,
+            moment_dtype=cfg.opt_moment_dtype,
         )
         self.compute_dtype = jnp.dtype(cfg.dtype)
         self._train_step = jax.jit(

@@ -12,6 +12,11 @@ import json
 import os
 import sys
 
+# script execution puts tests/ (not the repo root) on sys.path, and the
+# venv has no installed tensorlink_tpu — the parent pytest process gets
+# the root from its rootdir, but this subprocess must pin it itself
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def main() -> int:
     coordinator, pid = sys.argv[1], int(sys.argv[2])

@@ -925,3 +925,23 @@ async def test_job_forward_recovers_dead_stage():
             user, validator,
             *[w for w in workers if w.node_id != victim_id],
         )
+
+
+def test_validate_train_meta_rejects_bad_moment_dtype():
+    """Pre-transfer schema check: a typo'd moment_dtype (or one sgd
+    cannot honor) must be rejected BEFORE the stage ships, like
+    train_only (the wasted-shipment guard)."""
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    ok = WorkerNode._validate_train_meta(
+        {"train": {"optimizer": "adam", "moment_dtype": "bfloat16"}}
+    )
+    assert ok is None
+    err = WorkerNode._validate_train_meta(
+        {"train": {"moment_dtype": "bf16"}}  # typo
+    )
+    assert err is not None and "moment_dtype" in err["error"]
+    err = WorkerNode._validate_train_meta(
+        {"train": {"optimizer": "sgd", "moment_dtype": "bfloat16"}}
+    )
+    assert err is not None and "sgd" in err["error"]
