@@ -126,6 +126,17 @@ class TrainConfig:
             raise ValueError(
                 f"unknown train_only {self.train_only!r}; supported: 'lora'"
             )
+        from tensorlink_tpu.train.optim import SUPPORTED_MOMENT_DTYPES
+
+        if self.opt_moment_dtype not in SUPPORTED_MOMENT_DTYPES:
+            # same allowlist the P2P worker schema enforces — one source
+            # of truth stops a local config from silently doing what a
+            # remote job would reject (fp16's narrow exponent can
+            # over/underflow the second moment)
+            raise ValueError(
+                f"unsupported opt_moment_dtype {self.opt_moment_dtype!r}; "
+                f"supported: {SUPPORTED_MOMENT_DTYPES}"
+            )
 
     @property
     def micro_batch_size(self) -> int:

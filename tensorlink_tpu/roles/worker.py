@@ -683,14 +683,28 @@ class WorkerNode(Node):
                 "type": "ERROR",
                 "error": f"unknown train_only {t_only!r}; supported: 'lora'",
             }
+        from tensorlink_tpu.train.optim import (
+            SUPPORTED_MOMENT_DTYPES,
+            SUPPORTED_OPTIMIZERS,
+        )
+
+        opt_name = train.get("optimizer", "adam")
+        if opt_name not in SUPPORTED_OPTIMIZERS:
+            # same wasted-shipment rationale: make_optimizer would raise
+            # this only in _install_stage, after the full stage streamed
+            return {
+                "type": "ERROR",
+                "error": f"unknown optimizer {opt_name!r}; supported: "
+                         f"{SUPPORTED_OPTIMIZERS}",
+            }
         mdt = train.get("moment_dtype", "float32")
-        if mdt not in ("float32", "bfloat16"):
+        if mdt not in SUPPORTED_MOMENT_DTYPES:
             return {
                 "type": "ERROR",
                 "error": f"unsupported moment_dtype {mdt!r}; supported: "
-                         "'float32', 'bfloat16'",
+                         f"{SUPPORTED_MOMENT_DTYPES}",
             }
-        if mdt != "float32" and train.get("optimizer", "adam") == "sgd":
+        if mdt != "float32" and opt_name == "sgd":
             # make_optimizer would raise this AFTER the stage shipped
             return {
                 "type": "ERROR",

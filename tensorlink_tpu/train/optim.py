@@ -28,6 +28,22 @@ from tensorlink_tpu.utils.trees import global_norm
 
 Schedule = Callable[[jax.Array], jax.Array]
 
+# single source of truth for every surface that validates these (local
+# TrainConfig.__post_init__ AND the P2P worker's pre-transfer schema
+# check) — hand-duplicated literals drifted once already (review finding)
+SUPPORTED_OPTIMIZERS = ("sgd", "adam", "adamw")
+SUPPORTED_MOMENT_DTYPES = ("float32", "bfloat16")
+
+
+def _moment_dtype_name(md) -> str:
+    """Canonical dtype name for allowlist checks; never raises (an
+    unknown string must surface as the allowlist ValueError, not
+    jnp.dtype's TypeError)."""
+    try:
+        return jnp.dtype(md).name
+    except TypeError:
+        return str(md)
+
 
 def make_schedule(
     kind: str = "constant",
@@ -64,6 +80,24 @@ def make_schedule(
 class Optimizer:
     init: Callable[[Any], Any]
     update: Callable[..., tuple[Any, Any]]  # (grads, state, params, step)
+
+
+def _stochastic_round_bf16(x: jax.Array, key: jax.Array) -> jax.Array:
+    """Unbiased f32 -> bf16: bf16 is the top 16 bits of f32, so adding a
+    uniform 16-bit integer to the f32 bit pattern and truncating the low
+    half rounds up with probability equal to the dropped fraction
+    (magnitude-space stochastic rounding; exact for both signs).
+    Non-finite values pass through round-to-nearest — the bit trick
+    would walk an inf's exponent into NaN space."""
+    f = x.astype(jnp.float32)
+    bits = jax.lax.bitcast_convert_type(f, jnp.uint32)
+    # exactly the 16 bits needed — generating uint32 and masking costs
+    # 2x the RNG work for bits that are then thrown away
+    r = jax.random.bits(key, x.shape, jnp.uint16).astype(jnp.uint32)
+    sr = jax.lax.bitcast_convert_type(
+        (bits + r) & jnp.uint32(0xFFFF0000), jnp.float32
+    ).astype(jnp.bfloat16)
+    return jnp.where(jnp.isfinite(f), sr, f.astype(jnp.bfloat16))
 
 
 def clip_by_global_norm(grads, max_norm: float):
@@ -105,15 +139,28 @@ def adam(
     moment_dtype: str | jnp.dtype = "float32",
 ) -> Optimizer:
     """``moment_dtype="bfloat16"`` STORES m/v in bf16 (compute stays
-    f32): halves optimizer-state bytes. Measured live (r4, v5e,
-    BERT-base batch 32): throughput is UNCHANGED (1414.8 vs 1416.4
-    samples/s) — the memory-bound step's binding stream is activations,
-    not opt state — so the win is footprint (larger model/batch per
-    chip, smaller checkpoints, pairs with FSDP), not speed. The trade
-    is ~16 bits of moment mantissa; parity is pinned loosely in tests,
-    exactness is not claimed."""
+    f32): halves optimizer-state bytes for a measured ~5% step cost at
+    the flagship BERT shape (live r4, v5e: 1348.6 vs 1418.4 samples/s
+    — the rounding-bit generation and extra store pass). The win is
+    footprint (larger model/batch per chip, smaller checkpoints, pairs
+    with FSDP), not speed.
+
+    The bf16 store uses STOCHASTIC rounding (see _stochastic_round_bf16):
+    with b2=0.999 the per-step v increment is ~0.1% of v, below bf16's
+    ~0.2% half-ulp, so round-to-nearest storage would freeze the
+    second-moment EMA at steady state (review finding) — every update
+    would round back to the old value. Unbiased rounding keeps the EMA
+    tracking in expectation; the randomness derives from ``step`` (and
+    a per-leaf salt), so runs stay bitwise reproducible."""
     sched = lr if callable(lr) else (lambda _: jnp.asarray(lr))
-    mdt = jnp.dtype(moment_dtype)
+    name = _moment_dtype_name(moment_dtype)
+    if name not in SUPPORTED_MOMENT_DTYPES:
+        raise ValueError(
+            f"moment_dtype {moment_dtype!r} unsupported: "
+            f"{SUPPORTED_MOMENT_DTYPES} (fp16's narrow exponent can "
+            "over/underflow v)"
+        )
+    mdt = jnp.dtype(name)
 
     def init(params):
         return {
@@ -148,8 +195,30 @@ def adam(
             return u.astype(p.dtype)
 
         updates = jax.tree.map(upd, m, v, params)
-        store = lambda t: jax.tree.map(lambda a: a.astype(mdt), t)  # noqa: E731
-        return updates, {"m": store(m), "v": store(v)}
+        if mdt == jnp.dtype(jnp.bfloat16):
+            # deterministic-by-step rounding streams: same step -> same
+            # stored bits (PoL replay + checkpoint-resume reproducibility).
+            # impl="rbg": threefry spent ~4 ms/step generating 2x110M
+            # rounding bits on the BERT-base bench (a 15% regression);
+            # the TPU's hardware RngBitGenerator is ~7x cheaper at
+            # identical unbiasedness. rbg's bit stream is fixed given
+            # (key, program, backend) — PoL replay pins those anyway —
+            # but is NOT portable across compiler versions the way
+            # threefry is; moments never cross that boundary.
+            base = jax.random.key(jnp.asarray(step, jnp.uint32), impl="rbg")
+
+            def store(t, salt):
+                leaves, treedef = jax.tree.flatten(t)
+                out = [
+                    _stochastic_round_bf16(
+                        a, jax.random.fold_in(base, salt + i)
+                    )
+                    for i, a in enumerate(leaves)
+                ]
+                return jax.tree.unflatten(treedef, out)
+
+            return updates, {"m": store(m, 0), "v": store(v, 1 << 20)}
+        return updates, {"m": m, "v": v}
 
     return Optimizer(init, update)
 
@@ -177,7 +246,7 @@ def make_optimizer(
     moment_dtype: str | jnp.dtype = "float32",
 ) -> Optimizer:
     if name == "sgd":
-        if jnp.dtype(moment_dtype) != jnp.float32:
+        if _moment_dtype_name(moment_dtype) != "float32":
             # sgd stores no moments (or f32 momentum) — a silently
             # ignored dtype request would misreport the memory budget
             raise ValueError("moment_dtype is an adam/adamw option")
