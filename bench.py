@@ -267,7 +267,7 @@ def _bubble_child() -> None:
 
     mesh = make_mesh(MeshConfig(pipe=S))
     # sized so a tick is tens of ms (>> sub-ms local dispatch) while the
-    # whole 3-point fit stays under ~1 min even on a 1-core host where
+    # whole multi-point fit stays under ~2 min even on a 1-core host where
     # the S virtual devices serialize
     gcfg = GPT2Config(
         vocab_size=512, dim=256, num_layers=S, num_heads=8, max_len=128,
