@@ -101,6 +101,25 @@ def _stacked_spec(
     )
 
 
+def reshape_stages(tree, new_stages: int):
+    """Re-factor stacked stage leaves [S, Lps, ...] for a different
+    pipeline depth: stack_stage_params lays layers out stage-major and
+    contiguous (stage s holds layers [s*Lps, (s+1)*Lps)), so changing S
+    is a pure reshape through the flat [L, ...] layout — no data
+    movement beyond resharding. Works identically on param and
+    optimizer-moment trees (same stacked structure)."""
+
+    def leaf(a):
+        L = a.shape[0] * a.shape[1]
+        if L % new_stages:
+            raise ValueError(
+                f"{L} layers not divisible by {new_stages} stages"
+            )
+        return a.reshape(new_stages, L // new_stages, *a.shape[2:])
+
+    return jax.tree.map(leaf, tree)
+
+
 class ShardedTrainer:
     """Builds the fully sharded train/eval steps for one mesh + model."""
 
@@ -292,6 +311,38 @@ class ShardedTrainer:
             k: self._param_shardings if isinstance(v, dict) else self._repl
             for k, v in opt_state.items()
         }
+
+    def adopt_state(self, state: TrainState) -> TrainState:
+        """Adopt a TrainState produced by a trainer on a DIFFERENT mesh
+        shape (elastic resume, SURVEY §7.5.4: membership change =>
+        re-form mesh + recompile, state carries over). Stage leaves are
+        re-factored to this trainer's pipeline depth (reshape_stages)
+        and everything is re-placed under this mesh's shardings; embed/
+        head/scalars pass through. The checkpoint side needs no mesh
+        knowledge — restore host-side, then adopt."""
+        S = self.num_stages
+
+        def fix(tree):
+            if not isinstance(tree, dict) or "stages" not in tree:
+                return tree
+            return {
+                k: (reshape_stages(v, S) if k == "stages" else v)
+                for k, v in tree.items()
+            }
+
+        params = fix(state.params)
+        opt_state = {
+            k: fix(v) if isinstance(v, dict) else v
+            for k, v in state.opt_state.items()
+        }
+        params = jax.tree.map(
+            lambda p, s: jax.device_put(p, s), params, self._param_shardings
+        )
+        opt_state = jax.device_put(opt_state, self._opt_shardings(opt_state))
+        return TrainState(
+            params=params, opt_state=opt_state,
+            step=jax.device_put(state.step, self._repl),
+        )
 
     # -- step ------------------------------------------------------------
     def _cast(self, params):

@@ -130,3 +130,72 @@ def test_checkpoint_roundtrips_lora_and_int8_trees(tmp_path):
         ):
             assert np.asarray(a).dtype == np.asarray(b).dtype, pa
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cross_mesh_shape_resume(tmp_path, devices):
+    """Elastic mesh re-formation (SURVEY §7.5.4): train on pipe=4, lose
+    half the pipeline, resume the SAME checkpoint on a data=2 x pipe=2
+    mesh via ShardedTrainer.adopt_state — trajectory must continue
+    exactly as an uninterrupted run on the new mesh (engine schedules
+    are numerically mesh-shape-invariant, so the two runs agree)."""
+    import jax.numpy as jnp
+
+    from tensorlink_tpu.config import MeshConfig, TrainConfig
+    from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config
+    from tensorlink_tpu.parallel.engine import ShardedTrainer
+    from tensorlink_tpu.runtime.mesh import make_mesh
+    from tensorlink_tpu.train.trainer import TrainState, softmax_cross_entropy
+
+    model = GPT2(GPT2Config(
+        vocab_size=128, dim=32, num_layers=4, num_heads=2, max_len=64,
+        dropout=0.0,
+    ))
+    params = model.init(jax.random.key(0))
+    loss = lambda lg, b: softmax_cross_entropy(lg, b["labels"])
+    cfg = TrainConfig(
+        batch_size=8, micro_batches=4, learning_rate=0.01,
+        optimizer="adamw", dtype="float32",
+    )
+    # fresh param copies per trainer: init_state's device_put may alias
+    # the shared leaves, and the donating train step deletes them
+    mk = lambda mesh_cfg: ShardedTrainer(
+        make_mesh(mesh_cfg), cfg,
+        model.as_pipeline_parts(jax.tree.map(jnp.array, params)), loss,
+    )
+    r = np.random.default_rng(0)
+    ids = r.integers(0, 128, (8, 17))
+    batch = {
+        "input_ids": jnp.asarray(ids[:, :-1]),
+        "labels": jnp.asarray(ids[:, 1:]),
+    }
+
+    # uninterrupted reference entirely on the NEW mesh shape
+    tr_ref = mk(MeshConfig(data=2, pipe=2))
+    s_ref = tr_ref.init_state()
+    for _ in range(5):
+        s_ref, m_ref = tr_ref.train_step(s_ref, batch)
+
+    # 2 steps on the old shape, checkpoint, adopt on the new shape
+    tr_old = mk(MeshConfig(pipe=4))
+    s_old = tr_old.init_state()
+    for _ in range(2):
+        s_old, _ = tr_old.train_step(s_old, batch)
+    with CheckpointManager(tmp_path / "xm", async_save=False) as mgr:
+        mgr.save(2, s_old, metadata={"mesh": {"pipe": 4}})
+        mgr.wait_until_finished()
+        raw = mgr.restore()  # host numpy, no mesh knowledge
+
+    tr_new = mk(MeshConfig(data=2, pipe=2))
+    resumed = tr_new.adopt_state(TrainState(
+        params=raw["params"], opt_state=raw["opt_state"], step=raw["step"]
+    ))
+    w = resumed.params["stages"]
+    lead = jax.tree.leaves(w)[0].shape[:2]
+    assert lead == (2, 2), lead  # re-factored [4,1,...] -> [2,2,...]
+    for _ in range(3):
+        resumed, m_res = tr_new.train_step(resumed, batch)
+
+    assert int(resumed.step) == 5
+    np.testing.assert_allclose(
+        float(m_res["loss"]), float(m_ref["loss"]), rtol=1e-5
+    )
