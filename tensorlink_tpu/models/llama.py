@@ -35,14 +35,28 @@ class LlamaConfig:
     attn_impl: str = "auto"  # auto | flash | reference | ring (seq-parallel)
     # Mixture-of-Experts FFN (Mixtral-style): 0 = dense. Experts shard
     # over the mesh 'model' axis (nn/moe.py — expert parallelism as
-    # tensor sharding; dispatch/combine lower to all_to_all).
+    # tensor sharding; see that module for the measured collective set).
     moe_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # sliding-window attention (Mistral-style): each token attends the
+    # last `attn_window` positions only. Requires attn_impl="reference"
+    # (the flash/ring kernels do not window-mask; MultiHeadAttention
+    # rejects the combination loudly). None = full causal attention.
+    attn_window: int | None = None
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
         return cls()
+
+    @classmethod
+    def mistral_7b(cls) -> "LlamaConfig":
+        """Mistral-7B-v0.1 shape: Llama trunk + 4096-token sliding
+        window (the architecture's distinguishing feature)."""
+        return cls(vocab_size=32000, dim=4096, num_layers=32,
+                   num_heads=32, num_kv_heads=8, hidden_dim=14336,
+                   max_len=32768, rope_theta=10000.0,
+                   attn_impl="reference", attn_window=4096)
 
     @classmethod
     def mixtral_8x7b(cls) -> "LlamaConfig":
@@ -50,6 +64,13 @@ class LlamaConfig:
         return cls(vocab_size=32000, dim=4096, num_layers=32, num_heads=32,
                    num_kv_heads=8, hidden_dim=14336, max_len=32768,
                    rope_theta=1e6, moe_experts=8, moe_top_k=2)
+
+    @classmethod
+    def mistral_tiny(cls) -> "LlamaConfig":
+        return cls(vocab_size=128, dim=32, num_layers=2, num_heads=4,
+                   num_kv_heads=2, hidden_dim=64, max_len=64,
+                   rope_theta=10000.0, attn_impl="reference",
+                   attn_window=8)
 
     @classmethod
     def moe_tiny(cls) -> "LlamaConfig":
@@ -103,6 +124,7 @@ class Llama(Module):
                 moe_experts=cfg.moe_experts,
                 moe_top_k=cfg.moe_top_k,
                 moe_capacity_factor=cfg.moe_capacity_factor,
+                attn_window=cfg.attn_window,
             ),
         )
         self.child("norm_f", RMSNorm(cfg.dim, eps=cfg.rms_eps))

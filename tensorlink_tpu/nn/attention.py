@@ -32,10 +32,15 @@ def dot_product_attention(
     bias: jax.Array | None = None,
     q_offset: int | jax.Array = 0,
     scale: float | None = None,  # None = 1/sqrt(D); T5 uses 1.0
+    window: int | None = None,  # sliding window: attend iff |q-k| < window
     **_,
 ) -> jax.Array:
     """Reference attention, f32 softmax. ``q_offset`` shifts query positions
-    for causal masking during incremental decode (cache len Tk > Tq)."""
+    for causal masking during incremental decode (cache len Tk > Tq).
+
+    ``window`` is Mistral-style sliding-window attention: a query at
+    position i attends keys in (i-window, i] when causal, or the
+    symmetric band |i-j| < window when not."""
     B, Tq, H, D = q.shape
     Hkv = k.shape[2]
     if Hkv != H:  # grouped-query: repeat kv heads
@@ -44,12 +49,16 @@ def dot_product_attention(
         v = jnp.repeat(v, rep, axis=2)
     scale = D ** -0.5 if scale is None else scale
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if causal:
+    if causal or window is not None:
         Tk = k.shape[1]
         qpos = jnp.arange(Tq)[:, None] + q_offset
         kpos = jnp.arange(Tk)[None, :]
-        causal_mask = qpos >= kpos
-        logits = jnp.where(causal_mask[None, None], logits, -1e30)
+        keep = jnp.ones((Tq, Tk), bool) if not causal else (qpos >= kpos)
+        if window is not None:
+            keep = jnp.logical_and(keep, kpos > qpos - window)
+            if not causal:  # symmetric band
+                keep = jnp.logical_and(keep, kpos < qpos + window)
+        logits = jnp.where(keep[None, None], logits, -1e30)
     if mask is not None:
         logits = jnp.where(mask, logits, -1e30)
     if bias is not None:
@@ -69,6 +78,7 @@ def decode_attention_blockwise(
     *,
     mask: jax.Array | None = None,  # [B, 1|H, 1, L] bool over cache slots
     block: int = DECODE_BLOCK,
+    start: jax.Array | int = 0,  # first attendable slot (sliding window)
 ) -> jax.Array:
     """Length-bounded decode attention: online softmax over
     ceil(live_len / block) cache blocks via a dynamic-bound fori_loop, so
@@ -94,6 +104,10 @@ def decode_attention_blockwise(
     rep = H // Hkv
     scale = D ** -0.5
     nb = (live_len.astype(jnp.int32) + block - 1) // block
+    # sliding window: blocks wholly below ``start`` are fully masked —
+    # skip them so windowed decode cost tracks the WINDOW, not the
+    # prefix (correctness still comes from ``mask``; this is pure skip)
+    b0 = jnp.asarray(start, jnp.int32) // block
 
     m0 = jnp.full((B, H, 1, 1), -1e30, jnp.float32)
     l0 = jnp.zeros((B, H, 1, 1), jnp.float32)
@@ -123,7 +137,7 @@ def decode_attention_blockwise(
         acc = acc * alpha.transpose(0, 2, 1, 3) + pv
         return (m_new, l, acc)
 
-    m, l, acc = jax.lax.fori_loop(0, nb, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(b0, nb, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     return (acc / l_safe.transpose(0, 2, 1, 3)).astype(q.dtype)
 
@@ -195,6 +209,7 @@ class MultiHeadAttention(Module):
         causal: bool = False,
         attn_impl: str | Callable = "auto",
         scale: float | None = None,  # None = 1/sqrt(head_dim); T5 = 1.0
+        window: int | None = None,  # sliding-window attention (Mistral)
     ):
         super().__init__()
         self.dim = dim
@@ -205,6 +220,19 @@ class MultiHeadAttention(Module):
         self.rope = rope
         self.rope_theta = rope_theta
         self.causal = causal
+        if window is not None:
+            if window < 1:
+                raise ValueError(f"window must be >= 1, got {window}")
+            # flash/ring/ulysses swallow unknown kwargs (**_) — a window
+            # they ignore would SILENTLY widen attention to full context.
+            # Same guard pattern as the custom-scale restriction below.
+            if resolve_attn_impl(attn_impl) is not dot_product_attention:
+                raise ValueError(
+                    "sliding-window attention requires "
+                    "attn_impl='reference' (the flash/ring kernels do "
+                    "not implement window masking)"
+                )
+        self.window = window
         if scale is not None:
             # only the reference einsum honors a custom scale; flash/ring
             # would silently use 1/sqrt(D) (T5's no-scale convention is
@@ -309,21 +337,32 @@ class MultiHeadAttention(Module):
                 and bias is None and getattr(self, "scale", None) is None
             )
 
+        window = getattr(self, "window", None)
         if use_blockwise:
+            live = cache["index"] + T
+            win_start = 0
+            if window is not None:
+                # the lone query sits at position live-1: it may attend
+                # slots (live-1-window, live-1] = [live-window, live)
+                win_start = jnp.maximum(live - window, 0)
+                kpos = jnp.arange(Tk)[None, None, None, :]
+                mask = jnp.logical_and(mask, kpos >= win_start)
             out = decode_attention_blockwise(
                 q, k.astype(q.dtype), v.astype(q.dtype),
-                cache["index"] + T,
+                live,
                 # concrete dims for the in-loop dynamic_slice (a [1,1,1,Tk]
                 # broadcastable mask has no sliceable batch dim)
                 mask=jnp.broadcast_to(
                     mask, jnp.broadcast_shapes(mask.shape, (B, 1, 1, Tk))
                 ),
+                start=win_start,
             )
         else:
             out = self._attn(
                 q, k.astype(q.dtype), v.astype(q.dtype),
                 causal=self.causal, mask=mask, q_offset=q_offset,
                 bias=bias, scale=getattr(self, "scale", None),
+                window=window,
             )
         out = out.reshape(B, T, self.num_heads * self.head_dim)
         out = self.children["o"].apply(params["o"], out)

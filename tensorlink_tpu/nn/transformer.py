@@ -86,6 +86,7 @@ class TransformerBlock(Module):
         moe_experts: int = 0,
         moe_top_k: int = 2,
         moe_capacity_factor: float = 1.25,
+        attn_window: int | None = None,  # sliding window (Mistral)
     ):
         super().__init__()
         self.dim = dim
@@ -108,6 +109,7 @@ class TransformerBlock(Module):
         self.moe_experts = moe_experts
         self.moe_top_k = moe_top_k
         self.moe_capacity_factor = moe_capacity_factor
+        self.attn_window = attn_window
         norm_cls = RMSNorm if norm == "rms" else LayerNorm
         self.child("norm1", norm_cls(dim, eps=norm_eps))
         self.child("norm2", norm_cls(dim, eps=norm_eps))
@@ -122,6 +124,7 @@ class TransformerBlock(Module):
                 rope=rope,
                 rope_theta=rope_theta,
                 attn_impl=attn_impl,
+                window=attn_window,
             ),
         )
         if moe_experts:

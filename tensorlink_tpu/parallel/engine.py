@@ -331,10 +331,7 @@ class ShardedTrainer:
             }
 
         params = fix(state.params)
-        opt_state = {
-            k: fix(v) if isinstance(v, dict) else v
-            for k, v in state.opt_state.items()
-        }
+        opt_state = {k: fix(v) for k, v in state.opt_state.items()}
         params = jax.tree.map(
             lambda p, s: jax.device_put(p, s), params, self._param_shardings
         )

@@ -112,7 +112,7 @@ def parse_op_breakdown(trace_events: list, lane: str = "XLA Ops") -> dict:
     import collections
 
     tids = {
-        (e["pid"], e["tid"]): e["args"].get("name", "")
+        (e["pid"], e["tid"]): e.get("args", {}).get("name", "")
         for e in trace_events
         if e.get("ph") == "M" and e.get("name") == "thread_name"
     }

@@ -442,3 +442,109 @@ def test_ulysses_gqa_narrow_and_fallback(devices, hkv):
         lambda q, k, v: ulysses_attention(q, k, v, mesh, causal=True)
     )(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+# ------------------------------------------------- sliding window (SWA)
+
+
+def test_sliding_window_matches_full_when_wide():
+    """window >= T is exactly full causal attention."""
+    r = np.random.default_rng(0)
+    q = jnp.asarray(r.normal(size=(2, 8, 4, 16)), jnp.float32)
+    k = jnp.asarray(r.normal(size=(2, 8, 4, 16)), jnp.float32)
+    v = jnp.asarray(r.normal(size=(2, 8, 4, 16)), jnp.float32)
+    full = dot_product_attention(q, k, v, causal=True)
+    wide = dot_product_attention(q, k, v, causal=True, window=8)
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(wide))
+
+
+def test_sliding_window_equals_explicit_band_mask():
+    """window=W == a hand-built band mask (i-W, i] — causal and not."""
+    r = np.random.default_rng(1)
+    T, W = 10, 3
+    q = jnp.asarray(r.normal(size=(1, T, 2, 8)), jnp.float32)
+    k = jnp.asarray(r.normal(size=(1, T, 2, 8)), jnp.float32)
+    v = jnp.asarray(r.normal(size=(1, T, 2, 8)), jnp.float32)
+    i = np.arange(T)[:, None]
+    j = np.arange(T)[None, :]
+
+    band = jnp.asarray(((j <= i) & (j > i - W))[None, None])
+    np.testing.assert_allclose(
+        np.asarray(dot_product_attention(q, k, v, causal=True, window=W)),
+        np.asarray(dot_product_attention(q, k, v, mask=band)),
+        atol=1e-6,
+    )
+    sym = jnp.asarray((np.abs(i - j) < W)[None, None])
+    np.testing.assert_allclose(
+        np.asarray(dot_product_attention(q, k, v, window=W)),
+        np.asarray(dot_product_attention(q, k, v, mask=sym)),
+        atol=1e-6,
+    )
+
+
+def test_sliding_window_decode_matches_prefill():
+    """Cached single-token decode under a window reproduces the
+    windowed full-forward logits — across the boundary where old
+    tokens fall out of the window."""
+    from tensorlink_tpu.models.llama import Llama, LlamaConfig
+
+    cfg = LlamaConfig.mistral_tiny()  # window 8
+    m = Llama(cfg)
+    p = m.init(jax.random.key(0))
+    T = 20  # well past the window
+    ids = jnp.asarray(
+        np.random.default_rng(2).integers(0, cfg.vocab_size, (1, T))
+    )
+    full = m.apply(p, ids)  # [1, T, V] windowed (module carries window)
+
+    caches = m.init_caches(1, 32, dtype=jnp.float32)
+    outs = []
+    for t in range(T):
+        step, caches = m.apply(p, ids[:, t : t + 1], caches=caches)
+        outs.append(step[:, 0])
+    np.testing.assert_allclose(
+        np.asarray(jnp.stack(outs, axis=1)), np.asarray(full),
+        atol=2e-4, rtol=2e-4,
+    )
+
+
+def test_sliding_window_blockwise_decode_parity():
+    """Large cache (> DECODE_BLOCK) triggers the blockwise decode path;
+    the windowed block-skip + mask must reproduce the reference windowed
+    attention exactly."""
+    from tensorlink_tpu.nn.attention import (
+        DECODE_BLOCK,
+        decode_attention_blockwise,
+    )
+
+    r = np.random.default_rng(3)
+    B, H, D, L, W = 2, 4, 16, 2 * DECODE_BLOCK, 64
+    live = L - 17  # live prefix not block-aligned
+    q = jnp.asarray(r.normal(size=(B, 1, H, D)), jnp.float32)
+    k = jnp.asarray(r.normal(size=(B, L, H, D)), jnp.float32)
+    v = jnp.asarray(r.normal(size=(B, L, H, D)), jnp.float32)
+    kpos = np.arange(L)
+    start = max(0, live - W)
+    mask = jnp.asarray(
+        ((kpos < live) & (kpos >= start))[None, None, None, :]
+    )
+    mask = jnp.broadcast_to(mask, (B, 1, 1, L))
+
+    out = decode_attention_blockwise(
+        q, k, v, jnp.int32(live), mask=mask, start=jnp.int32(start)
+    )
+    ref = dot_product_attention(
+        q, k, v, causal=True, q_offset=live - 1, window=W
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_sliding_window_rejects_non_reference_impl():
+    from tensorlink_tpu.nn.attention import MultiHeadAttention
+
+    with pytest.raises(ValueError, match="sliding-window"):
+        MultiHeadAttention(32, 4, causal=True, attn_impl="flash", window=8)
+    with pytest.raises(ValueError, match="sliding-window"):
+        MultiHeadAttention(32, 4, causal=True, attn_impl="ring", window=8)
