@@ -40,9 +40,10 @@ class LlamaConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     # sliding-window attention (Mistral-style): each token attends the
-    # last `attn_window` positions only. Requires attn_impl="reference"
-    # (the flash/ring kernels do not window-mask; MultiHeadAttention
-    # rejects the combination loudly). None = full causal attention.
+    # last `attn_window` positions only. Supported by the reference impl
+    # and the Pallas flash kernel (in-kernel band mask + whole-block
+    # skip: O(T*window) long-seq cost); ring/ulysses reject it loudly.
+    # None = full causal attention.
     attn_window: int | None = None
 
     @classmethod
@@ -56,7 +57,7 @@ class LlamaConfig:
         return cls(vocab_size=32000, dim=4096, num_layers=32,
                    num_heads=32, num_kv_heads=8, hidden_dim=14336,
                    max_len=32768, rope_theta=10000.0,
-                   attn_impl="reference", attn_window=4096)
+                   attn_window=4096)
 
     @classmethod
     def mixtral_8x7b(cls) -> "LlamaConfig":
@@ -69,8 +70,7 @@ class LlamaConfig:
     def mistral_tiny(cls) -> "LlamaConfig":
         return cls(vocab_size=128, dim=32, num_layers=2, num_heads=4,
                    num_kv_heads=2, hidden_dim=64, max_len=64,
-                   rope_theta=10000.0, attn_impl="reference",
-                   attn_window=8)
+                   rope_theta=10000.0, attn_window=8)
 
     @classmethod
     def moe_tiny(cls) -> "LlamaConfig":

@@ -22,6 +22,25 @@ from tensorlink_tpu.nn.module import Module
 from tensorlink_tpu.nn.layers import Dense
 
 
+def band_keep(q_pos, k_pos, causal: bool, window: int | None):
+    """THE positional attend predicate (one home for the edge
+    convention — the reference path, the flash fallback's row-validity,
+    and the Pallas kernels' per-block masks all call this): attend iff
+    k <= q (causal) and k in (q-window, q]; symmetric band |q-k| <
+    window when not causal. None = no positional constraint."""
+    if not causal and window is None:
+        return None
+    keep = None
+    if causal:
+        keep = q_pos >= k_pos
+    if window is not None:
+        lo = k_pos > q_pos - window
+        keep = lo if keep is None else jnp.logical_and(keep, lo)
+        if not causal:
+            keep = jnp.logical_and(keep, k_pos < q_pos + window)
+    return keep
+
+
 def dot_product_attention(
     q: jax.Array,  # [B, Tq, H, D]
     k: jax.Array,  # [B, Tk, Hkv, D]
@@ -53,11 +72,7 @@ def dot_product_attention(
         Tk = k.shape[1]
         qpos = jnp.arange(Tq)[:, None] + q_offset
         kpos = jnp.arange(Tk)[None, :]
-        keep = jnp.ones((Tq, Tk), bool) if not causal else (qpos >= kpos)
-        if window is not None:
-            keep = jnp.logical_and(keep, kpos > qpos - window)
-            if not causal:  # symmetric band
-                keep = jnp.logical_and(keep, kpos < qpos + window)
+        keep = band_keep(qpos, kpos, causal, window)
         logits = jnp.where(keep[None, None], logits, -1e30)
     if mask is not None:
         logits = jnp.where(mask, logits, -1e30)
@@ -223,14 +238,19 @@ class MultiHeadAttention(Module):
         if window is not None:
             if window < 1:
                 raise ValueError(f"window must be >= 1, got {window}")
-            # flash/ring/ulysses swallow unknown kwargs (**_) — a window
-            # they ignore would SILENTLY widen attention to full context.
-            # Same guard pattern as the custom-scale restriction below.
-            if resolve_attn_impl(attn_impl) is not dot_product_attention:
+            # ring/ulysses swallow unknown kwargs (**_) — a window they
+            # ignore would SILENTLY widen attention to full context. The
+            # reference impl and the flash kernel (in-kernel band mask +
+            # whole-block skipping) both honor it.
+            resolved = resolve_attn_impl(attn_impl)
+            from tensorlink_tpu.ops.flash import flash_attention_impl
+
+            base = getattr(resolved, "func", resolved)  # unwrap partial
+            if base not in (dot_product_attention, flash_attention_impl):
                 raise ValueError(
-                    "sliding-window attention requires "
-                    "attn_impl='reference' (the flash/ring kernels do "
-                    "not implement window masking)"
+                    "sliding-window attention requires attn_impl "
+                    "'reference', 'flash', or 'auto' (the ring/ulysses "
+                    "kernels do not implement window masking)"
                 )
         self.window = window
         if scale is not None:

@@ -48,27 +48,46 @@ def _pick_block(T: int) -> int:
     return T  # T in (8, 16, 32, 64): single block
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def flash_attention(q, k, v, kv_mask=None, causal: bool = False, interpret: bool = False):
+@partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention(q, k, v, kv_mask=None, causal: bool = False,
+                    interpret: bool = False, window: int | None = None):
     """q: [B, T, H, D]; k, v: [B, T, Hkv, D] (Hkv divides H — GQA is read
-    in-kernel, no repeat); kv_mask: [B, Tk] bool/float (nonzero=attend).
+    in-kernel, no repeat); kv_mask: [B, Tk] bool/float (nonzero=attend);
+    window: sliding-window band — in-kernel masking plus whole-block
+    skipping, so long-seq windowed attention costs O(T*window).
     -> [B, T, H, D]."""
-    return _fwd(q, k, v, kv_mask, causal, interpret)[0]
+    return _fwd(q, k, v, kv_mask, causal, interpret, window)[0]
 
 
 def _kernel_path(q, k, interpret) -> bool:
     return _use_pallas(interpret) and _tile_ok(q.shape[1]) and _tile_ok(k.shape[1])
 
 
-def _fallback_attn(q, k, v, kv_mask, causal):
+def _fallback_attn(q, k, v, kv_mask, causal, window=None):
     """jnp reference path, matched to the kernel's convention: a row
     whose keys are ALL masked outputs exact zeros (softmax of an
     all(-1e30) row would otherwise return mean(v) — review finding)."""
     mask = None if kv_mask is None else (kv_mask[:, None, None, :] > 0)
-    out = dot_product_attention(q, k, v, causal=causal, mask=mask)
+    out = dot_product_attention(
+        q, k, v, causal=causal, mask=mask, window=window
+    )
     if kv_mask is not None:
         kvf = kv_mask > 0
-        if causal and q.shape[1] == k.shape[1]:
+        if window is not None and q.shape[1] == k.shape[1]:
+            # row i's visible keys are the band — valid iff any padding
+            # survivor falls inside it (the band always contains k=i, so
+            # window alone never empties a row; padding can)
+            from tensorlink_tpu.nn.attention import band_keep
+
+            band = band_keep(
+                jnp.arange(q.shape[1])[:, None],
+                jnp.arange(k.shape[1])[None, :],
+                causal, window,
+            )
+            row_valid = jnp.any(
+                jnp.logical_and(band[None], kvf[:, None, :]), axis=-1
+            )  # [B, Tq]
+        elif causal and q.shape[1] == k.shape[1]:
             # under causal masking row i sees keys [0, i]: valid iff any
             # of those survives the padding mask
             row_valid = jnp.cumsum(kvf, axis=-1) > 0  # [B, Tq]
@@ -78,20 +97,20 @@ def _fallback_attn(q, k, v, kv_mask, causal):
     return out
 
 
-def _fwd(q, k, v, kv_mask, causal, interpret):
+def _fwd(q, k, v, kv_mask, causal, interpret, window=None):
     if _kernel_path(q, k, interpret):
         qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))  # [B,H,T,D]
         out, lse = flash_attention_fwd_lse(
             qt, kt, vt, kv_mask, causal=causal,
             block_q=_pick_block(q.shape[1]), block_k=_pick_block(k.shape[1]),
-            interpret=interpret,
+            interpret=interpret, window=window,
         )
         return out.swapaxes(1, 2), (q, k, v, kv_mask, out, lse)
-    out = _fallback_attn(q, k, v, kv_mask, causal)
+    out = _fallback_attn(q, k, v, kv_mask, causal, window)
     return out, (q, k, v, kv_mask, None, None)
 
 
-def _bwd(causal, interpret, res, g):
+def _bwd(causal, interpret, window, res, g):
     q, k, v, kv_mask, out_t, lse = res
     if _kernel_path(q, k, interpret):  # same static decision as _fwd
         qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
@@ -99,12 +118,14 @@ def _bwd(causal, interpret, res, g):
             qt, kt, vt, out_t, lse, g.swapaxes(1, 2), kv_mask,
             causal=causal,
             block_q=_pick_block(q.shape[1]), block_k=_pick_block(k.shape[1]),
-            interpret=interpret,
+            interpret=interpret, window=window,
         )
         dq, dk, dv = (x.swapaxes(1, 2) for x in (dq, dk, dv))
     else:
         _, vjp = jax.vjp(
-            lambda q_, k_, v_: _fallback_attn(q_, k_, v_, kv_mask, causal),
+            lambda q_, k_, v_: _fallback_attn(
+                q_, k_, v_, kv_mask, causal, window
+            ),
             q, k, v,  # dot_product_attention repeats GQA heads itself and
             # its vjp sums dk/dv back over the group
         )
@@ -148,7 +169,7 @@ MIN_KERNEL_SEQ_AUTO = 1024
 
 def flash_attention_impl(
     q, k, v, *, causal=False, mask=None, q_offset=0, interpret=False,
-    min_kernel_seq: int = MIN_KERNEL_SEQ_AUTO, **_,
+    min_kernel_seq: int = MIN_KERNEL_SEQ_AUTO, window=None, **_,
 ):
     """Drop-in ``attn_impl`` for MultiHeadAttention: Pallas kernels on the
     no-cache path (plain or key-padding mask; GQA read in-kernel via the
@@ -165,7 +186,7 @@ def flash_attention_impl(
         # (jvp over custom_vjp is a TypeError — review finding)
         and _kernel_path(q, k, interpret)
     ):
-        return flash_attention(q, k, v, kv_mask, causal, interpret)
+        return flash_attention(q, k, v, kv_mask, causal, interpret, window)
     return dot_product_attention(
-        q, k, v, causal=causal, mask=mask, q_offset=q_offset
+        q, k, v, causal=causal, mask=mask, q_offset=q_offset, window=window
     )
