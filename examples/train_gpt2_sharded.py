@@ -15,6 +15,15 @@ flags (or call initialize_distributed yourself):
 
 import argparse
 
+# dev-checkout convenience: running from the repo without pip-installing
+# puts examples/ (not the root) on sys.path
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
 import jax
 import jax.numpy as jnp
 import numpy as np

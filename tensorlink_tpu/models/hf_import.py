@@ -228,7 +228,12 @@ def llama_params_from_hf(sd: Mapping[str, np.ndarray], cfg: "LlamaConfig") -> di
     """Map an HF ``LlamaForCausalLM`` state dict onto the native `Llama`
     param tree. Expects full-model keys (``model.embed_tokens...`` +
     ``lm_head.weight``). Tied-embedding checkpoints (e.g. llama-3.2-1b)
-    may omit ``lm_head.weight``; the embedding is reused then."""
+    may omit ``lm_head.weight``; the embedding is reused then.
+
+    ``MistralForCausalLM`` shares this exact layout (Mistral = Llama
+    trunk + sliding window, which is config not weights — set
+    ``LlamaConfig.attn_window``); windowed-logit parity vs HF is pinned
+    in tests/test_models.py::test_mistral_parity_vs_hf."""
     p: dict = {
         "tok_emb": {"table": _a(sd["model.embed_tokens.weight"])},
         "blocks": {},

@@ -19,7 +19,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from tensorlink_tpu.nn.attention import dot_product_attention
+from tensorlink_tpu.nn.attention import band_keep, dot_product_attention
 from tensorlink_tpu.ops.pallas.flash_attention import (
     flash_attention_bwd,
     flash_attention_fwd_lse,
@@ -77,8 +77,6 @@ def _fallback_attn(q, k, v, kv_mask, causal, window=None):
             # row i's visible keys are the band — valid iff any padding
             # survivor falls inside it (the band always contains k=i, so
             # window alone never empties a row; padding can)
-            from tensorlink_tpu.nn.attention import band_keep
-
             band = band_keep(
                 jnp.arange(q.shape[1])[:, None],
                 jnp.arange(k.shape[1])[None, :],

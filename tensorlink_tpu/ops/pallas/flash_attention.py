@@ -97,6 +97,18 @@ def _win_lo(qi, block_q: int, block_k: int, window: int):
     return jnp.maximum((qi * block_q - (window - 1)) // block_k, 0)
 
 
+def _restricted_index(restricted: bool, start, j_grid, n_full):
+    """Shared preamble of the three kernels' restricted-grid mode:
+    actual block index = band start + grid-local offset, valid while it
+    stays inside the full grid. ``start`` is _win_lo(...) for the
+    fwd/dq k-loop and the diagonal block (kj*bk)//bq for the dkv q-loop
+    — the two formulas differ, the reconstruction pattern must not."""
+    if not restricted:
+        return j_grid, True
+    actual = start + j_grid
+    return actual, actual <= n_full - 1
+
+
 def _keep_mask(mask_ref, causal, qi, kj, block_q, block_k, shape,
                window: int | None = None):
     """Combined causal/window+padding keep mask for one block
@@ -147,14 +159,15 @@ def _flash_fwd_kernel(
     qi = pl.program_id(2)
     j_grid = pl.program_id(3)  # grid-local: init/finalize key on THIS
     nk = pl.num_programs(3)
-    kj = j_grid
-    in_range = True
-    if win_grid_nk is not None:
-        # restricted grid: program 3 indexes an offset into the band's
-        # k-block range; reconstruct the ACTUAL k-block index (the same
-        # formula the BlockSpec index map used to aim the DMA)
-        kj = _win_lo(qi, block_q, block_k, window) + j_grid
-        in_range = kj <= nk_full - 1
+    # restricted grid: program 3 indexes an offset into the band's
+    # k-block range; reconstruct the ACTUAL k-block index (the same
+    # formula the BlockSpec index map used to aim the DMA)
+    kj, in_range = _restricted_index(
+        win_grid_nk is not None,
+        _win_lo(qi, block_q, block_k, window) if win_grid_nk is not None
+        else 0,
+        j_grid, nk_full,
+    )
 
     @pl.when(j_grid == 0)
     def _init():
@@ -345,11 +358,12 @@ def _flash_bwd_dq_kernel(
     qi = pl.program_id(2)
     j_grid = pl.program_id(3)
     nk = pl.num_programs(3)
-    kj = j_grid
-    in_range = True
-    if win_grid_nk is not None:
-        kj = _win_lo(qi, block_q, block_k, window) + j_grid
-        in_range = kj <= nk_full - 1
+    kj, in_range = _restricted_index(
+        win_grid_nk is not None,
+        _win_lo(qi, block_q, block_k, window) if win_grid_nk is not None
+        else 0,
+        j_grid, nk_full,
+    )
 
     @pl.when(j_grid == 0)
     def _init():
@@ -407,14 +421,12 @@ def _flash_bwd_dkv_kernel(
     kj = pl.program_id(2)
     i_grid = pl.program_id(3)
     nq = pl.num_programs(3)
-    qi = i_grid
-    in_range = True
-    if win_grid_nq is not None:
-        # causal: q-blocks below the k-block see nothing — start at the
-        # diagonal block (kj*bk // bq); the band's upper edge bounds the
-        # range at (bk + window) positions
-        qi = (kj * block_k) // block_q + i_grid
-        in_range = qi <= nq_full - 1
+    # causal: q-blocks below the k-block see nothing — start at the
+    # diagonal block (kj*bk // bq); the band's upper edge bounds the
+    # range at (bk + window) positions
+    qi, in_range = _restricted_index(
+        win_grid_nq is not None, (kj * block_k) // block_q, i_grid, nq_full,
+    )
 
     @pl.when(i_grid == 0)
     def _init():

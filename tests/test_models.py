@@ -259,3 +259,42 @@ def test_llama_parity_vs_hf(torch_mods):
         ref = hf(input_ids=torch.tensor(ids)).logits.numpy()
     logits = ours.apply(params, jnp.asarray(ids))
     np.testing.assert_allclose(np.asarray(logits), ref, atol=3e-4)
+
+
+def test_mistral_parity_vs_hf(torch_mods):
+    """MistralForCausalLM == Llama trunk + sliding window: the same
+    llama_params_from_hf mapping must load it, and windowed logits must
+    match HF's (HF applies the window via its attention mask; seq 20 >
+    window 8 so the band genuinely bites)."""
+    torch, transformers = torch_mods
+    from tensorlink_tpu.models.hf_import import llama_params_from_hf
+    from tensorlink_tpu.models.llama import Llama, LlamaConfig
+
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=128,
+        hidden_size=32,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        intermediate_size=64,
+        max_position_embeddings=64,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        attention_dropout=0.0,
+        tie_word_embeddings=False,
+        sliding_window=8,
+    )
+    torch.manual_seed(0)
+    hf = transformers.MistralForCausalLM(hf_cfg).eval()
+    sd = torch_state_dict_to_numpy(hf)
+
+    cfg = LlamaConfig.mistral_tiny()  # window 8, same trunk dims
+    ours = Llama(cfg)
+    params = llama_params_from_hf(sd, cfg)
+    assert jax.tree.structure(params) == jax.tree.structure(ours.init(KEY))
+
+    ids = np.random.default_rng(4).integers(0, 128, (2, 20))
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(ids)).logits.numpy()
+    logits = ours.apply(params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=3e-4)
