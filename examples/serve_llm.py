@@ -63,6 +63,9 @@ def main() -> None:
     eng = InferenceEngine(
         mesh, model, params, max_len=256,
         quantize="int8" if args.int8 else None,
+        # windowed models serve from a ring KV cache: O(prompt+window)
+        # memory no matter how long the generation runs
+        rolling_cache=args.window is not None,
     )
     prompts = jnp.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 8))

@@ -145,9 +145,12 @@ class GPT2(Module):
             head_per_token=True,
         )
 
-    def init_caches(self, batch: int, max_len: int, dtype=jnp.bfloat16):
+    def init_caches(self, batch: int, max_len: int, dtype=jnp.bfloat16,
+                    rolling: bool = False):
         stack = self.children["blocks"]
         return [
-            {"attn": blk.children["attn"].init_cache(batch, max_len, dtype)}
+            {"attn": blk.children["attn"].init_cache(
+                batch, max_len, dtype, rolling=rolling
+            )}
             for blk in stack.blocks()
         ]

@@ -338,10 +338,23 @@ class MultiHeadAttention(Module):
                 "WITHOUT a cache (models/t5.py greedy_decode does)"
             )
         if cache is not None:
-            ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k.astype(cache["k"].dtype), cache["index"], axis=1)
-            cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v.astype(cache["v"].dtype), cache["index"], axis=1)
+            rolling = "rolling" in cache
+            # rolling (ring-buffer) cache for sliding-window serving:
+            # write position wraps modulo capacity, so the cache stays
+            # O(window) while generation runs arbitrarily long. The
+            # caller owns slot validity/window masking (slot order is
+            # no longer logical order past the first wrap) — see
+            # parallel/inference.py rolling_cache.
+            wslot = (
+                cache["index"] % cache["k"].shape[1] if rolling
+                else cache["index"]
+            )
+            ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k.astype(cache["k"].dtype), wslot, axis=1)
+            cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v.astype(cache["v"].dtype), wslot, axis=1)
             k, v = ck, cv
             new_cache = {"k": ck, "v": cv, "index": cache["index"] + T}
+            if rolling:
+                new_cache["rolling"] = None
             # mask out cache positions beyond what's been written
             Tk = ck.shape[1]
             valid = jnp.arange(Tk)[None, None, None, :] < (cache["index"] + T)
@@ -355,6 +368,12 @@ class MultiHeadAttention(Module):
             use_blockwise = (
                 T == 1 and Tk > DECODE_BLOCK and Tk % DECODE_BLOCK == 0
                 and bias is None and getattr(self, "scale", None) is None
+                # rolling: live (index+T) exceeds capacity after the
+                # first wrap — the loop's clamped dynamic_slice would
+                # visit blocks twice and double-count their slots in the
+                # online softmax. Capacity is already window-sized, so
+                # the full einsum over it IS the intended cost.
+                and not rolling
             )
 
         window = getattr(self, "window", None)
@@ -378,12 +397,24 @@ class MultiHeadAttention(Module):
                 start=win_start,
             )
         else:
-            out = self._attn(
-                q, k.astype(q.dtype), v.astype(q.dtype),
-                causal=self.causal, mask=mask, q_offset=q_offset,
-                bias=bias, scale=getattr(self, "scale", None),
-                window=window,
-            )
+            if cache is not None and "rolling" in cache:
+                # past the first wrap slot order is not position order:
+                # slot-space causal/window masking would be wrong. The
+                # caller's mask (slot-position bookkeeping) is the sole
+                # authority; positional predicates are disabled.
+                out = self._attn(
+                    q, k.astype(q.dtype), v.astype(q.dtype),
+                    causal=False, mask=mask, q_offset=0,
+                    bias=bias, scale=getattr(self, "scale", None),
+                    window=None,
+                )
+            else:
+                out = self._attn(
+                    q, k.astype(q.dtype), v.astype(q.dtype),
+                    causal=self.causal, mask=mask, q_offset=q_offset,
+                    bias=bias, scale=getattr(self, "scale", None),
+                    window=window,
+                )
         out = out.reshape(B, T, self.num_heads * self.head_dim)
         out = self.children["o"].apply(params["o"], out)
         if cache is not None:
@@ -402,10 +433,21 @@ class MultiHeadAttention(Module):
         )
         return k, v
 
-    def init_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16):
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.bfloat16,
+                   rolling: bool = False):
+        """``rolling=True`` marks a ring-buffer cache: ``max_len`` is
+        then the ring CAPACITY (typically prompt+window, not
+        prompt+generation), writes wrap modulo it, and the caller owns
+        slot-position masking (parallel/inference.py rolling_cache)."""
         shape = (batch, max_len, self.num_kv_heads, self.head_dim)
-        return {
+        cache = {
             "k": jnp.zeros(shape, dtype),
             "v": jnp.zeros(shape, dtype),
             "index": jnp.zeros((), jnp.int32),
         }
+        if rolling:
+            # None = empty pytree subtree: the marker is STRUCTURE, not a
+            # leaf — a bool leaf would turn into a tracer inside lax.scan
+            # carries and break the static `rolling` branch in apply
+            cache["rolling"] = None
+        return cache

@@ -62,6 +62,7 @@ class InferenceEngine:
         data_axis: str = "data",
         model_axis: str = "model",
         quantize: str | None = None,  # "int8" = weight-only quantization
+        rolling_cache: bool = False,  # ring KV cache (needs attn window)
     ):
         self.mesh = mesh
         self.model = model
@@ -74,6 +75,26 @@ class InferenceEngine:
 
         self.max_len = max_len
         self.cache_len = -(-max_len // DECODE_BLOCK) * DECODE_BLOCK
+        # rolling (ring) KV cache: O(prompt + window) memory however
+        # long the generation runs — the serving win of sliding-window
+        # models (a 32k generation at window 4096 holds ~4.5k slots, not
+        # 33k). Requires the model to DECLARE a window; a windowless
+        # model would need every past token and the ring would silently
+        # drop context.
+        self.rolling = bool(rolling_cache)
+        self.window = None
+        if self.rolling:
+            try:
+                blk0 = model.children["blocks"].blocks()[0]
+                self.window = blk0.children["attn"].window
+            except (AttributeError, KeyError, IndexError):
+                self.window = None
+            if not self.window:
+                raise ValueError(
+                    "rolling_cache=True requires a sliding-window model "
+                    "(e.g. LlamaConfig(attn_window=...)); this model "
+                    "declares no attention window"
+                )
         self.cache_dtype = cache_dtype
         self.data_axis = data_axis
         self.model_axis = model_axis
@@ -143,11 +164,20 @@ class InferenceEngine:
         max_new = int(gen.max_new_tokens)
         eos = gen.eos_token_id
 
+        rolling = self.rolling
+        W = self.window
+        if rolling:
+            # ring capacity: the prompt plus one full window — decode
+            # slots wrap, memory stays put however long the generation
+            L = T0 + W
+
         def run(params, ids, pad_mask, key):
             # logical positions: pads get 0, first real token position 0
             pos = jnp.maximum(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
             n_valid = pad_mask.sum(-1)  # [B]
-            caches = model.init_caches(B, L, dtype=self.cache_dtype)
+            caches = model.init_caches(
+                B, L, dtype=self.cache_dtype, rolling=rolling
+            )
 
             # prefill attention mask over ALL cache slots [B, 1, T0, L]:
             # key slot must be a real prompt token at or before the query
@@ -156,6 +186,16 @@ class InferenceEngine:
             kslot = jnp.arange(L)[None, None, None, :]
             kreal = jnp.zeros((B, L), bool).at[:, :T0].set(pad_mask.astype(bool))
             causal = (kslot <= qslot) & kreal[:, None, None, :]
+            if rolling:
+                # rolling mode disables the module's own positional
+                # predicates (slot order != position order after a
+                # wrap), so the prefill mask must carry the window band
+                # itself, in LOGICAL positions
+                pos_k = jnp.pad(pos, ((0, 0), (0, L - T0)))
+                band = pos_k[:, None, None, :] > (
+                    pos[:, None, :, None] - W
+                )
+                causal = causal & band
             logits, caches = model.apply(
                 params, ids, caches=caches, positions=pos, mask=causal
             )
@@ -163,16 +203,35 @@ class InferenceEngine:
 
             # valid-slot mask over the cache, extended as tokens generate
             valid0 = jnp.zeros((B, L), bool).at[:, :T0].set(pad_mask.astype(bool))
+            if rolling:
+                # slot -> logical position bookkeeping (-1 = never
+                # written / pad): the ONLY masking authority once writes
+                # wrap — replaces the monotone valid-slot mask
+                slot_pos0 = jnp.where(
+                    valid0, jnp.pad(pos, ((0, 0), (0, L - T0))), -1
+                ).astype(jnp.int32)
+            else:
+                slot_pos0 = valid0  # same carry slot, mode-specific type
 
             def step(carry, i):
                 # the carried token was generated at loop index i-1: it is
-                # written to cache slot T0+i-1 and has logical position
-                # n_valid+i-1
+                # written to cache slot T0+i-1 (mod L when rolling) and
+                # has logical position n_valid+i-1
                 caches, valid, tok, key, done = carry
                 key, sub = jax.random.split(key)
                 positions = (n_valid + i - 1)[:, None]  # [B, 1]
-                valid = valid.at[:, T0 + i - 1].set(True)
-                mask = valid[:, None, None, :]
+                if rolling:
+                    wslot = (T0 + i - 1) % L
+                    valid = jax.lax.dynamic_update_slice_in_dim(
+                        valid, positions.astype(jnp.int32), wslot, axis=1
+                    )
+                    mask = (
+                        (valid >= 0)
+                        & (valid > (positions - W))
+                    )[:, None, None, :]
+                else:
+                    valid = valid.at[:, T0 + i - 1].set(True)
+                    mask = valid[:, None, None, :]
                 logits, caches = model.apply(
                     params, tok[:, None], caches=caches,
                     positions=positions, mask=mask,
@@ -187,7 +246,7 @@ class InferenceEngine:
             done0 = (
                 (tok0 == eos) if eos is not None else jnp.zeros((B,), bool)
             )
-            carry = (caches, valid0, tok0, key, done0)
+            carry = (caches, slot_pos0, tok0, key, done0)
             (_, _, _, _, _), toks = jax.lax.scan(
                 step, carry, jnp.arange(1, max_new)
             )
