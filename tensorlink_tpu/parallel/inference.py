@@ -166,6 +166,14 @@ class InferenceEngine:
 
         rolling = self.rolling
         W = self.window
+        if rolling and T0 + W >= self.cache_len:
+            # a ring of prompt+window slots would be LARGER than the
+            # full monotone cache (window >= max_len - prompt): fall
+            # back to the full cache — it never wraps within max_len,
+            # outputs are identical, and memory is strictly smaller
+            # (review finding: the example's window could otherwise
+            # multiply KV memory through the feature meant to cut it)
+            rolling = False
         if rolling:
             # ring capacity: the prompt plus one full window — decode
             # slots wrap, memory stays put however long the generation
@@ -175,8 +183,12 @@ class InferenceEngine:
             # logical positions: pads get 0, first real token position 0
             pos = jnp.maximum(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
             n_valid = pad_mask.sum(-1)  # [B]
+            # rolling= passed only when on: the documented model contract
+            # is init_caches(batch, max_len, dtype); custom decoders
+            # written to it must keep working on the default path
             caches = model.init_caches(
-                B, L, dtype=self.cache_dtype, rolling=rolling
+                B, L, dtype=self.cache_dtype,
+                **({"rolling": True} if rolling else {}),
             )
 
             # prefill attention mask over ALL cache slots [B, 1, T0, L]:

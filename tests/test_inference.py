@@ -353,3 +353,24 @@ def test_rolling_cache_requires_window(tiny_llama):
         InferenceEngine(
             make_mesh(MeshConfig()), m, p, max_len=32, rolling_cache=True
         )
+
+
+def test_rolling_cache_falls_back_when_ring_would_be_larger():
+    """window >= cache capacity: a prompt+window ring would EXCEED the
+    full cache (review finding — the memory feature multiplying memory);
+    the engine silently uses the monotone cache, outputs unchanged."""
+    cfg = LlamaConfig(
+        vocab_size=128, dim=32, num_layers=2, num_heads=4,
+        num_kv_heads=2, hidden_dim=64, max_len=64,
+        rope_theta=10000.0, attn_window=300,  # wider than the cache
+    )
+    m = Llama(cfg)
+    p = m.init(jax.random.key(9))
+    ids = np.asarray(jax.random.randint(KEY, (2, 4), 0, cfg.vocab_size))
+    gen = GenerationConfig(max_new_tokens=8)
+    kw = dict(max_len=64, cache_dtype=jnp.float32, param_dtype=jnp.float32)
+    full = InferenceEngine(make_mesh(MeshConfig()), m, p, **kw).generate(ids, gen)
+    ring = InferenceEngine(
+        make_mesh(MeshConfig()), m, p, rolling_cache=True, **kw
+    ).generate(ids, gen)
+    np.testing.assert_array_equal(full, ring)
