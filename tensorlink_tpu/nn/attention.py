@@ -351,14 +351,26 @@ class MultiHeadAttention(Module):
             )
             ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k.astype(cache["k"].dtype), wslot, axis=1)
             cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v.astype(cache["v"].dtype), wslot, axis=1)
-            k, v = ck, cv
             new_cache = {"k": ck, "v": cv, "index": cache["index"] + T}
             if rolling:
                 new_cache["rolling"] = None
-            # mask out cache positions beyond what's been written
+            # fresh-keys prefill contract: a multi-token write whose mask
+            # covers exactly the T fresh keys attends the JUST-projected
+            # k/v, not the (mostly empty) cache — a 4k-prompt prefill
+            # into an 8k cache otherwise scores 2x the keys and builds a
+            # 2x mask for slots that hold nothing (measured r4: the ring
+            # engine's 6.4x serving win over the full cache was mostly
+            # this waste). The cache is still written for the decode
+            # steps that follow.
+            fresh = (
+                T > 1 and mask is not None and mask.shape[-1] == T
+            )
             Tk = ck.shape[1]
-            valid = jnp.arange(Tk)[None, None, None, :] < (cache["index"] + T)
-            mask = valid if mask is None else jnp.logical_and(mask, valid)
+            if not fresh:
+                k, v = ck, cv
+                # mask out cache positions beyond what's been written
+                valid = jnp.arange(Tk)[None, None, None, :] < (cache["index"] + T)
+                mask = valid if mask is None else jnp.logical_and(mask, valid)
             # single-token decode over a large cache: length-bounded
             # blockwise attention so cost tracks the live prefix, not
             # capacity. The valid mask already enforces causality for the
@@ -366,7 +378,8 @@ class MultiHeadAttention(Module):
             # Additive biases (T5 rel-pos) and custom scales stay on the
             # full path — the blockwise kernel hardcodes 1/sqrt(D).
             use_blockwise = (
-                T == 1 and Tk > DECODE_BLOCK and Tk % DECODE_BLOCK == 0
+                not fresh
+                and T == 1 and Tk > DECODE_BLOCK and Tk % DECODE_BLOCK == 0
                 and bias is None and getattr(self, "scale", None) is None
                 # rolling: live (index+T) exceeds capacity after the
                 # first wrap — the loop's clamped dynamic_slice would
@@ -415,6 +428,15 @@ class MultiHeadAttention(Module):
                     bias=bias, scale=getattr(self, "scale", None),
                     window=window,
                 )
+        if cache is not None and T > 1 and mask is not None \
+                and mask.shape[-1] == T:
+            # fresh-keys guard: the contract only holds for an EMPTY
+            # cache (prefill) — a chunked-prefill/speculative caller at
+            # index>0 would silently drop all cached context. The index
+            # is traced, so the misuse can't raise at trace time;
+            # poisoning the output makes it loud downstream instead
+            # (same standard as the LoRA composition guards).
+            out = jnp.where(cache["index"] == 0, out, jnp.nan)
         out = out.reshape(B, T, self.num_heads * self.head_dim)
         out = self.children["o"].apply(params["o"], out)
         if cache is not None:

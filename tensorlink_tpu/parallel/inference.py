@@ -191,22 +191,23 @@ class InferenceEngine:
                 **({"rolling": True} if rolling else {}),
             )
 
-            # prefill attention mask over ALL cache slots [B, 1, T0, L]:
-            # key slot must be a real prompt token at or before the query
-            # slot (left padding => slot order == logical order)
+            # prefill attention mask over the T0 FRESH keys [B,1,T0,T0]
+            # (the attention module's fresh-keys contract: a multi-token
+            # write with a T-wide mask attends the just-projected k/v,
+            # not the mostly-empty cache — at a 4k prompt in an 8k cache
+            # that halves prefill score work and mask memory). Key must
+            # be a real prompt token at or before the query (left
+            # padding => slot order == logical order).
             qslot = jnp.arange(T0)[None, None, :, None]
-            kslot = jnp.arange(L)[None, None, None, :]
-            kreal = jnp.zeros((B, L), bool).at[:, :T0].set(pad_mask.astype(bool))
+            kslot = jnp.arange(T0)[None, None, None, :]
+            kreal = pad_mask.astype(bool)
             causal = (kslot <= qslot) & kreal[:, None, None, :]
             if rolling:
                 # rolling mode disables the module's own positional
                 # predicates (slot order != position order after a
                 # wrap), so the prefill mask must carry the window band
                 # itself, in LOGICAL positions
-                pos_k = jnp.pad(pos, ((0, 0), (0, L - T0)))
-                band = pos_k[:, None, None, :] > (
-                    pos[:, None, :, None] - W
-                )
+                band = pos[:, None, None, :] > (pos[:, None, :, None] - W)
                 causal = causal & band
             logits, caches = model.apply(
                 params, ids, caches=caches, positions=pos, mask=causal

@@ -169,3 +169,23 @@ def test_module_config_serializable():
     cfg = blk.config()
     s = json.dumps(cfg)
     assert "TransformerBlock" in s
+
+
+def test_fresh_prefill_guard_poisons_nonempty_cache():
+    """The fresh-keys prefill contract (T-wide mask => attend projected
+    k/v) holds only for an EMPTY cache; a chunked-prefill caller at
+    index>0 would silently drop cached context, so the output is
+    NaN-poisoned there instead (the index is traced — no trace-time
+    raise possible)."""
+    from tensorlink_tpu.nn.attention import MultiHeadAttention
+
+    mha = MultiHeadAttention(32, 4, causal=True, attn_impl="reference")
+    params = mha.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 4, 32))
+    cache = mha.init_cache(2, 16, dtype=jnp.float32)
+    m = jnp.tril(jnp.ones((1, 1, 4, 4), bool))
+
+    ok, cache1 = mha.apply(params, x, cache=cache, mask=m)
+    assert np.isfinite(np.asarray(ok)).all()  # index 0: legit prefill
+    bad, _ = mha.apply(params, x, cache=cache1, mask=m)  # index 4
+    assert np.isnan(np.asarray(bad)).all()
