@@ -556,6 +556,60 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             out["decode_error"] = str(e)[:200]
 
+    # -- secondary: long-prefix serving (fresh-keys prefill + sliding
+    # window + rolling ring cache, the r4 serving work). End-to-end
+    # generate() = prefill + 64-step decode at prefix 3968 in an 8192
+    # cache; failure-tolerant like the other secondaries.
+    if os.environ.get("BENCH_SERVING", "1") == "1" and _BERT == "base":
+        try:
+            from tensorlink_tpu.config import MeshConfig
+            from tensorlink_tpu.models.llama import Llama, LlamaConfig
+            from tensorlink_tpu.parallel.inference import (
+                GenerationConfig,
+                InferenceEngine,
+            )
+            from tensorlink_tpu.runtime.mesh import make_mesh
+
+            Bs, Ps, Ns = 4, 3968, 64
+            sbase = dict(
+                vocab_size=8192, dim=512, num_layers=4, num_heads=8,
+                num_kv_heads=8, hidden_dim=1024, max_len=8192,
+                rope_theta=10000.0,
+            )
+            rs = np.random.default_rng(0)
+            sids = jnp.asarray(rs.integers(0, 8192, (Bs, Ps)))
+            sgen = GenerationConfig(max_new_tokens=Ns)
+
+            def serving_tps(cfg_kw, **eng_kw):
+                sm = Llama(LlamaConfig(**sbase, **cfg_kw))
+                sp = sm.init(jax.random.key(0))
+                eng = InferenceEngine(
+                    make_mesh(MeshConfig()), sm, sp, max_len=8192, **eng_kw
+                )
+                t = eng.generate(sids, sgen)
+                int(np.asarray(t)[0, -1])  # sync (compile + first call)
+                t0 = time.perf_counter()
+                for _ in range(3):
+                    t = eng.generate(sids, sgen)
+                int(np.asarray(t)[0, -1])
+                return Bs * Ns / ((time.perf_counter() - t0) / 3)
+
+            out["serving_long_prefix_tokens_per_sec"] = round(
+                serving_tps({}), 1
+            )
+            out["serving_windowed_tokens_per_sec"] = round(
+                serving_tps({"attn_window": 512}), 1
+            )
+            out["serving_ring_cache_tokens_per_sec"] = round(
+                serving_tps({"attn_window": 512}, rolling_cache=True), 1
+            )
+            out["serving_config"] = (
+                f"Llama d512/L4 bf16, batch {Bs}, prefix {Ps}, {Ns} new "
+                "tokens, max_len 8192; windowed/ring at window 512"
+            )
+        except Exception as e:  # noqa: BLE001 — must not sink the headline
+            out["serving_error"] = str(e)[:200]
+
     # -- secondary: MoE/EP training throughput + router drop fraction
     # (VERDICT r3 weak #9: EP had zero perf evidence). Single-chip
     # measurement of a Mixtral-style MoE-GPT; failure-tolerant.
