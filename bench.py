@@ -306,27 +306,15 @@ def _bubble_child() -> None:
     bub["tick_over_dispatch"] = (
         bub["tick_s"] / dispatch_s if dispatch_s > 0 else None
     )
-    # A host with fewer cores than stages SERIALIZES the virtual
-    # devices: warmup/drain slots (stages idle in them) cost no wall
-    # time, so the schedule bubble is structurally unobservable — the
-    # intercept measures ~0 regardless of the true bubble (found live
-    # r4: a clean r2=0.98 fit reported 0.036 vs the 0.273 closed form;
-    # the r3-era claim that this host "recovered" the bubble was noise
-    # landing in the intercept). The fit's tick-linearity and the
-    # dispatch floor are still meaningful; the fraction is not.
+    # serialization validity (cores < stages => bubble unobservable) is
+    # decided INSIDE ShardedTrainer.measure_bubble, so the dryrun and
+    # this child cannot diverge; host_cores is recorded here for the
+    # artifact reader
     try:
         cores = len(os.sched_getaffinity(0))  # cgroup/affinity-aware
     except AttributeError:  # non-Linux
         cores = os.cpu_count() or 1
     bub["host_cores"] = cores
-    if cores < S:
-        bub["valid"] = False
-        bub["invalid_reason"] = (
-            f"host serializes stages ({cores} cores < {S} stages): "
-            "idle pipeline slots cost no wall time, bubble "
-            "unobservable; closed_form_bubble_fraction is the honest "
-            "figure on this hardware"
-        )
     print(json.dumps({k: (v if not isinstance(v, float) or np.isfinite(v)
                           else None) for k, v in bub.items()}))
 
