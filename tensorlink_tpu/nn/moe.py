@@ -5,14 +5,22 @@ absent"); this is TPU-native from scratch. Design:
 
 - Experts are ONE stacked param tree with a leading [E, ...] axis, sharded
   over the mesh's ``model`` axis (`P(model, ...)`) — expert parallelism is
-  just tensor sharding on that axis. Under the XLA SPMD partitioner the
-  dispatch/combine einsums compile to **all-gather (tokens to the expert
-  shards) + all-reduce (partial combine outputs)** — verified against the
-  compiled HLO on an 8-device EP mesh (tests/test_moe.py HLO-evidence
-  test; an earlier claim here of an `all_to_all` lowering was wrong: XLA
-  only emits all-to-all when the [E, C, D] dispatched tensor carries an
-  explicit sharding annotation, which would tie this mesh-agnostic module
-  to an ambient mesh). No per-expert Python modules, no host-side routing.
+  just tensor sharding on that axis. No per-expert Python modules, no
+  host-side routing.
+- **Collective lowering** (both verified against compiled HLO in
+  tests/test_moe.py): with no ambient mesh the partitioner falls back to
+  all-gather (tokens to the expert shards) + all-reduce (partial combine
+  outputs) — O(E)-redundant ICI traffic and compute. When an ambient mesh
+  (``jax.set_mesh``) carries ``ep_axis``, `apply` additionally shards the
+  token-group dim over (data, ep_axis) and pins the dispatched [E, G, C, D]
+  tensor to `P(ep_axis, ...)`: the group->expert reshard then compiles to
+  **all_to_all** over ``ep_axis`` (t5x/GShard-style), each device routes
+  and computes only its 1/N token slice, and the redundant gather/reduce
+  pair disappears. The module stays mesh-agnostic: the ambient mesh is
+  read at trace time (`jax.sharding.get_abstract_mesh()`), only
+  Auto-partitioned axes are used (so it composes inside the pipeline
+  shard_map, where ``pipe``/``seq`` are Manual), and with no mesh in
+  context behavior is bit-identical to the fallback.
 - Token-choice top-k routing (Switch/GShard style) with a capacity
   factor: position-in-expert comes from a cumulative sum over the token
   axis, overflow tokens are dropped (their residual path carries them).
@@ -34,6 +42,23 @@ from tensorlink_tpu.nn.module import Module, register_module_type
 from tensorlink_tpu.nn.layers import _lecun_normal, _normal
 
 
+def _auto_ambient_axes() -> tuple:
+    """Names of ambient-mesh axes the SPMD partitioner controls (Auto).
+
+    Manual axes (bound by an enclosing shard_map — the engine's ``pipe``/
+    ``seq``) must not appear in a with_sharding_constraint spec; Explicit
+    axes would need explicit-sharding plumbing this module doesn't do.
+    Empty when no ``jax.set_mesh`` context is active."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh.empty:
+        return ()
+    return tuple(
+        name
+        for name, t in zip(mesh.axis_names, mesh.axis_types)
+        if t == jax.sharding.AxisType.Auto
+    )
+
+
 @register_module_type
 class MoEFeedForward(Module):
     """Drop-in replacement for FeedForward: [B, T, D] -> [B, T, D].
@@ -52,6 +77,7 @@ class MoEFeedForward(Module):
         gated: bool = True,
         router_noise: float = 0.0,
         activation: str = "gelu",
+        ep_axis: str | None = "model",
     ):
         super().__init__()
         self.dim = dim
@@ -62,6 +88,9 @@ class MoEFeedForward(Module):
         self.gated = gated
         self.router_noise = router_noise
         self.activation = activation
+        # mesh axis the all_to_all dispatch rides (module docstring);
+        # engages only when an ambient mesh carries it as an Auto axis
+        self.ep_axis = ep_axis
 
     def init(self, key):
         E, D, H = self.num_experts, self.dim, self.hidden_dim
@@ -139,17 +168,44 @@ class MoEFeedForward(Module):
         aux = E * jnp.mean(jnp.sum(frac * mean_prob, axis=-1))
         return dispatch, combine, aux
 
+    def _ep_plan(self):
+        """(group_spec_axes, ep_axis) for the all_to_all dispatch path, or
+        (None, None) when no usable ambient mesh — see module docstring.
+        Token groups (= batch rows; routing/capacity is per row) co-shard
+        over ``data`` when present, so EP composes with DP: the reshard is
+        an all_to_all over ``ep_axis`` inside each data slice."""
+        if not self.ep_axis:
+            return None, None
+        axes = _auto_ambient_axes()
+        if self.ep_axis not in axes:
+            return None, None
+        groups = tuple(a for a in ("data", self.ep_axis) if a in axes)
+        return groups, self.ep_axis
+
     def apply_with_aux(self, params, x, *, rng=None, train=False, **_):
         B, T, D = x.shape
+        groups, ep = self._ep_plan()
+        wsc = jax.lax.with_sharding_constraint
+        if ep is not None:
+            # each device routes only its token-group slice
+            x = wsc(x, P(groups, None, None))
         logits = x.astype(jnp.float32) @ params["router"]["w"].astype(jnp.float32)
         dispatch, combine, aux = self._route(logits, rng=rng, train=train)
         dispatch = dispatch.astype(x.dtype)
         combine = combine.astype(x.dtype)
+        if ep is not None:
+            dispatch = wsc(dispatch, P(groups, None, None, None))
 
         # dispatch -> [E, B, C, D]; under SPMD with `up`/`down` sharded
         # on E each device computes this einsum only for its expert
-        # shard (tokens reach it via all-gather; see docstring)
+        # shard (tokens reach it via all_to_all when the ambient-mesh
+        # constraint below engages, else all-gather; see docstring)
         expert_in = jnp.einsum("btec,btd->ebcd", dispatch, x)
+        if ep is not None:
+            # group-sharded -> expert-sharded over the SAME mesh axis:
+            # this is the pin that compiles to all_to_all
+            data = tuple(a for a in groups if a != ep) or None
+            expert_in = wsc(expert_in, P(ep, data, None, None))
         up = jnp.einsum("ebcd,edh->ebch", expert_in, params["up"].astype(x.dtype))
         if self.gated:
             g = jnp.einsum(
@@ -161,6 +217,10 @@ class MoEFeedForward(Module):
 
             h = ACTIVATIONS[self.activation](up)
         expert_out = jnp.einsum("ebch,ehd->ebcd", h, params["down"].astype(x.dtype))
+        if ep is not None:
+            # all_to_all back: every group re-collects its tokens, the
+            # combine einsum below is then device-local per group
+            expert_out = wsc(expert_out, P(None, groups, None, None))
         out = jnp.einsum("btec,ebcd->btd", combine, expert_out)
         return out, aux
 

@@ -499,13 +499,19 @@ class ShardedTrainer:
             self._step_fn = jax.jit(self._step, static_argnums=(), donate_argnums=(0,))
         batch = jax.device_put(batch, self._batch_sh)
         # rng=None traces the step-derived-rng variant; an explicit key
-        # traces a second variant — both cached by jit
-        return self._step_fn(state, batch, rng)
+        # traces a second variant — both cached by jit.
+        # set_mesh makes the trainer's mesh ambient during tracing so
+        # modules that pin intermediate shardings on Auto axes (MoE's
+        # all_to_all dispatch, nn/moe.py) can engage; everything else is
+        # unaffected (all axes here are Auto outside the pipe shard_map).
+        with jax.set_mesh(self.mesh):
+            return self._step_fn(state, batch, rng)
 
     def eval_fn(self, state: TrainState, batch):
         if self._eval_fn is None:
             self._eval_fn = jax.jit(self._loss)
-        return self._eval_fn(state.params, batch, None)
+        with jax.set_mesh(self.mesh):
+            return self._eval_fn(state.params, batch, None)
 
     # -- reporting ------------------------------------------------------
     @property
