@@ -45,6 +45,9 @@ class LlamaConfig:
     # skip: O(T*window) long-seq cost); ring/ulysses reject it loudly.
     # None = full causal attention.
     attn_window: int | None = None
+    # fused q/k/v projection (nn/attention.py qkv_fused): decode-perf
+    # option; per-kv-group layout keeps TP head-aligned
+    qkv_fused: bool = False
 
     @classmethod
     def llama3_8b(cls) -> "LlamaConfig":
@@ -125,6 +128,7 @@ class Llama(Module):
                 moe_top_k=cfg.moe_top_k,
                 moe_capacity_factor=cfg.moe_capacity_factor,
                 attn_window=cfg.attn_window,
+                qkv_fused=cfg.qkv_fused,
             ),
         )
         self.child("norm_f", RMSNorm(cfg.dim, eps=cfg.rms_eps))

@@ -83,6 +83,10 @@ def dot_product_attention(
 
 
 DECODE_BLOCK = 256
+# windowless decode takes the bounded-blockwise loop only above this
+# cache size (slots): below it, one full-width einsum beats the loop's
+# per-layer launch overhead (see MultiHeadAttention.apply decode notes)
+DECODE_BLOCKWISE_MIN_WINDOWLESS = 8 * DECODE_BLOCK
 
 
 def decode_attention_blockwise(
@@ -157,6 +161,39 @@ def decode_attention_blockwise(
     return (acc / l_safe.transpose(0, 2, 1, 3)).astype(q.dtype)
 
 
+def fuse_qkv_params(attn_params: dict, num_heads: int, num_kv_heads: int,
+                    head_dim: int) -> dict:
+    """Convert one attention param dict {"q","k","v","o"[...]} to the
+    fused layout {"qkv","o"[...]}: per-kv-group interleave
+    [D, G, (hq q-heads | k | v), Dh] flattened on the output dim —
+    exactly MultiHeadAttention(qkv_fused=True)'s expectation, so
+    separately-imported HF weights (or a trained separate-layout
+    checkpoint) can serve through the fused projection. Extra keys
+    (e.g. LoRA adapters) are not supported — fuse before surgery."""
+    import numpy as _np
+
+    G, hq = num_kv_heads, num_heads // num_kv_heads
+    extra = set(attn_params) - {"q", "k", "v", "o"}
+    if extra:
+        raise ValueError(f"cannot fuse attention params with extras {extra}")
+
+    def cat(name):
+        qw = _np.asarray(attn_params["q"][name])
+        kw = _np.asarray(attn_params["k"][name])
+        vw = _np.asarray(attn_params["v"][name])
+        lead = qw.shape[:-1]  # (D,) for w, () for b
+        qw = qw.reshape(*lead, G, hq, head_dim)
+        kw = kw.reshape(*lead, G, 1, head_dim)
+        vw = vw.reshape(*lead, G, 1, head_dim)
+        f = _np.concatenate([qw, kw, vw], axis=-2)
+        return jnp.asarray(f.reshape(*lead, G * (hq + 2) * head_dim))
+
+    qkv = {"w": cat("w")}
+    if "b" in attn_params["q"]:
+        qkv["b"] = cat("b")
+    return {"qkv": qkv, "o": attn_params["o"]}
+
+
 def apply_rope(x: jax.Array, positions: jax.Array, theta: float = 10000.0) -> jax.Array:
     """Rotary position embedding over the last dim. x: [B, T, H, D]."""
     D = x.shape[-1]
@@ -225,6 +262,7 @@ class MultiHeadAttention(Module):
         attn_impl: str | Callable = "auto",
         scale: float | None = None,  # None = 1/sqrt(head_dim); T5 = 1.0
         window: int | None = None,  # sliding-window attention (Mistral)
+        qkv_fused: bool = False,  # one fused projection (see below)
     ):
         super().__init__()
         self.dim = dim
@@ -273,10 +311,43 @@ class MultiHeadAttention(Module):
         self._attn = resolve_attn_impl(attn_impl)
         qdim = self.num_heads * self.head_dim
         kvdim = self.num_kv_heads * self.head_dim
-        self.child("q", Dense(dim, qdim, use_bias=use_bias, shard="col"))
-        self.child("k", Dense(dim, kvdim, use_bias=use_bias, shard="col"))
-        self.child("v", Dense(dim, kvdim, use_bias=use_bias, shard="col"))
+        self.qkv_fused = qkv_fused
+        if qkv_fused:
+            # One matmul instead of three: at decode (T=1, tiny batch)
+            # each projection kernel is launch-bound, and fusing q/k/v
+            # removed ~2 convolution launches + their bias/reshape
+            # fusions per layer per token (measured r5 on v5e — see
+            # BASELINE.md decode entry). Layout is Megatron-style
+            # PER-KV-GROUP interleave [.., G, (H/G q | 1 k | 1 v), Dh]
+            # so a column TP split stays head-aligned whenever the model
+            # axis divides num_kv_heads (the same alignment plain GQA TP
+            # already requires). Self-attention decoders only: cross
+            # attention projects k/v from a different source.
+            # fuse_qkv_params converts a q/k/v param tree to this layout.
+            if self.num_heads % self.num_kv_heads:
+                raise ValueError("qkv_fused requires num_kv_heads | num_heads")
+            G = self.num_kv_heads
+            hq = self.num_heads // G
+            self.child(
+                "qkv",
+                Dense(dim, G * (hq + 2) * self.head_dim,
+                      use_bias=use_bias, shard="col"),
+            )
+        else:
+            self.child("q", Dense(dim, qdim, use_bias=use_bias, shard="col"))
+            self.child("k", Dense(dim, kvdim, use_bias=use_bias, shard="col"))
+            self.child("v", Dense(dim, kvdim, use_bias=use_bias, shard="col"))
         self.child("o", Dense(qdim, dim, use_bias=use_bias, shard="row"))
+
+    def _project_qkv_fused(self, params, x):
+        """Fused projection -> (q [B,T,H,Dh], k/v [B,T,G,Dh])."""
+        B, T, _ = x.shape
+        G = self.num_kv_heads
+        hq = self.num_heads // G
+        f = self.children["qkv"].apply(params["qkv"], x)
+        f = f.reshape(B, T, G, hq + 2, self.head_dim)
+        q = f[:, :, :, :hq].reshape(B, T, self.num_heads, self.head_dim)
+        return q, f[:, :, :, hq], f[:, :, :, hq + 1]
 
     def apply(
         self,
@@ -298,15 +369,25 @@ class MultiHeadAttention(Module):
             raise NotImplementedError(
                 "additive attention bias requires attn_impl='reference'"
             )
-        q = self.children["q"].apply(params["q"], x).reshape(B, T, self.num_heads, self.head_dim)
-        if precomputed_kv is not None:
-            # decode-loop cross-attention: the encoder's k/v were
-            # projected ONCE via project_kv (rope, if any, must have been
-            # applied there — T5 has none)
-            k, v = precomputed_kv
+        if self.qkv_fused:
+            if kv is not None or precomputed_kv is not None:
+                raise NotImplementedError(
+                    "qkv_fused projects q/k/v from ONE source — "
+                    "cross-attention needs the separate q/k/v layout"
+                )
+            q, k, v = self._project_qkv_fused(params, x)
         else:
-            # one projection path for cached and uncached callers
-            k, v = self.project_kv(params, x if kv is None else kv)
+            q = self.children["q"].apply(params["q"], x).reshape(
+                B, T, self.num_heads, self.head_dim
+            )
+            if precomputed_kv is not None:
+                # decode-loop cross-attention: the encoder's k/v were
+                # projected ONCE via project_kv (rope, if any, must have
+                # been applied there — T5 has none)
+                k, v = precomputed_kv
+            else:
+                # one projection path for cached and uncached callers
+                k, v = self.project_kv(params, x if kv is None else kv)
 
         q_offset = 0
         if cache is not None:
@@ -377,9 +458,22 @@ class MultiHeadAttention(Module):
             # lone query (every slot < live_len is at or before it).
             # Additive biases (T5 rel-pos) and custom scales stay on the
             # full path — the blockwise kernel hardcodes 1/sqrt(D).
+            # Thresholds (windowed vs not) below: the fori_loop costs
+            # ~12 launch-bound op groups per layer per step, so it must
+            # buy real HBM savings. A window skips straight to the band
+            # (huge at window << prefix); windowless, the live prefix
+            # grows toward capacity and the loop only pays off when the
+            # cache is large enough that early-step savings dominate —
+            # measured r5 on v5e, a tight 256-slot cache decodes 2x
+            # faster on the full einsum than through the loop.
+            win = getattr(self, "window", None)
+            blocks_min = (
+                DECODE_BLOCK if win is not None
+                else DECODE_BLOCKWISE_MIN_WINDOWLESS
+            )
             use_blockwise = (
                 not fresh
-                and T == 1 and Tk > DECODE_BLOCK and Tk % DECODE_BLOCK == 0
+                and T == 1 and Tk > blocks_min and Tk % DECODE_BLOCK == 0
                 and bias is None and getattr(self, "scale", None) is None
                 # rolling: live (index+T) exceeds capacity after the
                 # first wrap — the loop's clamped dynamic_slice would
@@ -446,6 +540,11 @@ class MultiHeadAttention(Module):
     def project_kv(self, params, src):
         """Project a cross-attention source ONCE: (k, v) [B, Tk, Hkv, D]
         for reuse across a decode loop via ``precomputed_kv=``."""
+        if self.qkv_fused:
+            raise NotImplementedError(
+                "qkv_fused has no standalone k/v projection (build "
+                "cross-attention modules with qkv_fused=False)"
+            )
         B, Ts, _ = src.shape
         k = self.children["k"].apply(params["k"], src).reshape(
             B, Ts, self.num_kv_heads, self.head_dim

@@ -179,7 +179,11 @@ class MoEFeedForward(Module):
         axes = _auto_ambient_axes()
         if self.ep_axis not in axes:
             return None, None
-        groups = tuple(a for a in ("data", self.ep_axis) if a in axes)
+        # dict.fromkeys dedupes while keeping order: ep_axis="data"
+        # (EP over the DP axis) must not produce a duplicate-axis spec
+        groups = tuple(
+            a for a in dict.fromkeys(("data", self.ep_axis)) if a in axes
+        )
         return groups, self.ep_axis
 
     def apply_with_aux(self, params, x, *, rng=None, train=False, **_):

@@ -87,6 +87,7 @@ class TransformerBlock(Module):
         moe_top_k: int = 2,
         moe_capacity_factor: float = 1.25,
         attn_window: int | None = None,  # sliding window (Mistral)
+        qkv_fused: bool = False,  # fused q/k/v projection (decode perf)
     ):
         super().__init__()
         self.dim = dim
@@ -110,6 +111,7 @@ class TransformerBlock(Module):
         self.moe_top_k = moe_top_k
         self.moe_capacity_factor = moe_capacity_factor
         self.attn_window = attn_window
+        self.qkv_fused = qkv_fused
         norm_cls = RMSNorm if norm == "rms" else LayerNorm
         self.child("norm1", norm_cls(dim, eps=norm_eps))
         self.child("norm2", norm_cls(dim, eps=norm_eps))
@@ -125,6 +127,7 @@ class TransformerBlock(Module):
                 rope_theta=rope_theta,
                 attn_impl=attn_impl,
                 window=attn_window,
+                qkv_fused=qkv_fused,
             ),
         )
         if moe_experts:

@@ -353,6 +353,88 @@ def test_ring_attention_grad_parity(devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+def test_qkv_fused_parity_and_roundtrip():
+    """Fused q/k/v projection (decode-perf option): fuse_qkv_params
+    converts a separate-layout tree and the fused module reproduces the
+    separate module bitwise-close, incl. GQA interleave, cache decode,
+    and config() round-trip."""
+    from tensorlink_tpu.nn.attention import (
+        MultiHeadAttention, fuse_qkv_params,
+    )
+    from tensorlink_tpu.nn.module import module_from_config
+
+    for H, Hkv in ((4, 4), (4, 2), (4, 1)):
+        sep = MultiHeadAttention(32, H, num_kv_heads=Hkv, causal=True,
+                                 rope=True, use_bias=True)
+        fus = MultiHeadAttention(32, H, num_kv_heads=Hkv, causal=True,
+                                 rope=True, use_bias=True, qkv_fused=True)
+        p = sep.init(KEY)
+        pf = fuse_qkv_params(p, H, Hkv, sep.head_dim)
+        assert pf["qkv"]["w"].shape == (32, Hkv * (H // Hkv + 2) * sep.head_dim)
+        x = jax.random.normal(jax.random.key(1), (2, 16, 32))
+        np.testing.assert_allclose(
+            np.asarray(fus.apply(pf, x)), np.asarray(sep.apply(p, x)),
+            atol=1e-5,
+        )
+        # cached decode step parity
+        cache = sep.init_cache(2, 16, dtype=jnp.float32)
+        o1, c1 = sep.apply(p, x[:, :4], cache=cache)
+        o1f, c1f = fus.apply(pf, x[:, :4], cache=cache)
+        np.testing.assert_allclose(np.asarray(o1f), np.asarray(o1), atol=1e-5)
+        step = x[:, 4:5]
+        o2, _ = sep.apply(p, step, cache=c1)
+        o2f, _ = fus.apply(pf, step, cache=c1f)
+        np.testing.assert_allclose(np.asarray(o2f), np.asarray(o2), atol=1e-5)
+
+    # config round trip preserves the flag and layout
+    rebuilt = module_from_config(fus.config())
+    assert rebuilt.qkv_fused
+    np.testing.assert_allclose(
+        np.asarray(rebuilt.apply(pf, x)), np.asarray(fus.apply(pf, x)),
+        atol=0,
+    )
+    # cross-attention refuses the fused layout loudly
+    with pytest.raises(NotImplementedError, match="cross"):
+        fus.apply(pf, x, kv=x)
+    with pytest.raises(NotImplementedError):
+        fus.project_kv(pf, x)
+
+
+def test_qkv_fused_tp_spec_and_engine_decode(devices):
+    """The fused projection column-shards head-aligned under TP, and an
+    InferenceEngine decode on a fused GPT-2 matches the separate-layout
+    engine token-for-token."""
+    from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config
+    from tensorlink_tpu.nn.attention import fuse_qkv_params
+    from tensorlink_tpu.parallel.inference import (
+        GenerationConfig, InferenceEngine,
+    )
+
+    cfgs = GPT2Config.tiny()
+    import dataclasses
+    cfgf = dataclasses.replace(cfgs, qkv_fused=True)
+    ms, mf = GPT2(cfgs), GPT2(cfgf)
+    ps = ms.init(KEY)
+    spec = mf.param_spec()
+    blk0 = spec["blocks"]["0"]["attn"]
+    assert blk0["qkv"]["w"] == P(None, "model")
+
+    # convert every block's attention params to the fused layout
+    import copy
+    pf = copy.deepcopy(jax.tree.map(np.asarray, ps))
+    for name, bp in pf["blocks"].items():
+        bp["attn"] = fuse_qkv_params(
+            bp["attn"], cfgs.num_heads, cfgs.num_heads, 32 // cfgs.num_heads
+        )
+    mesh = make_mesh(MeshConfig())
+    kw = dict(max_len=32, cache_dtype=jnp.float32, param_dtype=jnp.float32)
+    es = InferenceEngine(mesh, ms, ps, **kw)
+    ef = InferenceEngine(mesh, mf, pf, **kw)
+    ids = np.asarray(jax.random.randint(KEY, (2, 5), 0, cfgs.vocab_size))
+    gen = GenerationConfig(max_new_tokens=6)
+    np.testing.assert_array_equal(es.generate(ids, gen), ef.generate(ids, gen))
+
+
 def test_attn_impl_pluggable():
     """flash_attention_impl drops into MultiHeadAttention unchanged."""
     from tensorlink_tpu import nn

