@@ -81,17 +81,13 @@ def run(steps: int = 200) -> dict:
             losses.append(float(loss))
         eval_b = batch_at(0, np.random.default_rng(2))
         eval_loss = float(loss_fn(state.params, eval_b))
-        # drop fraction on what layer-0's router sees after training
+        # drop fraction on what layer-0's router sees after training —
+        # via the block's own wiring (TransformerBlock.routing_stats)
         blk = model.children["blocks"].children["0"]
-        bp0 = state.params["blocks"]["0"]
         emb = model.children["tok_emb"].apply(
             state.params["tok_emb"], eval_b["input_ids"]
         )
-        a = blk.children["attn"].apply(
-            bp0["attn"], blk.children["norm1"].apply(bp0["norm1"], emb)
-        )
-        router_in = blk.children["norm2"].apply(bp0["norm2"], emb + a)
-        stats = blk.children["mlp"].routing_stats(bp0["mlp"], router_in)
+        stats = blk.routing_stats(state.params["blocks"]["0"], emb)
         results[label] = {
             "capacity_factor": cf,
             "final_train_loss": round(float(np.mean(losses[-10:])), 4),

@@ -213,6 +213,32 @@ class TransformerBlock(Module):
         x, _, aux = self._run(params, x, mask, None, positions, rng, train)
         return x, aux
 
+    def router_input(self, params, x, *, mask=None, positions=None):
+        """The tensor this block's MLP/router actually sees, per the
+        block's OWN norm-style wiring — probes (bench MoE leg, the
+        capacity-sweep example) must measure routing stats on this, not
+        on a hand-reassembled forward that silently drifts when the
+        wiring changes (review finding)."""
+        attn = self.children["attn"]
+        n1, n2 = self.children["norm1"], self.children["norm2"]
+        if self.norm_style == "pre":
+            h = n1.apply(params["norm1"], x)
+            a = attn.apply(params["attn"], h, mask=mask, positions=positions)
+            return n2.apply(params["norm2"], x + a)
+        a = attn.apply(params["attn"], x, mask=mask, positions=positions)
+        return n1.apply(params["norm1"], x + a)
+
+    def routing_stats(self, params, x, *, mask=None, positions=None) -> dict:
+        """MoE router telemetry on the input this block's router sees.
+        Raises for dense blocks (no router to probe)."""
+        mlp = self.children["mlp"]
+        if not hasattr(mlp, "routing_stats"):
+            raise ValueError("routing_stats: this block's MLP is dense")
+        return mlp.routing_stats(
+            params["mlp"], self.router_input(params, x, mask=mask,
+                                             positions=positions)
+        )
+
 
 class TransformerStack(Module):
     """N homogeneous blocks. params: {"0": block0, ...}."""

@@ -388,7 +388,13 @@ def ring_attention_impl(q, k, v, *, causal=False, mask=None, q_offset=0,
     columns. KV caches are not expressible on the ring path (decode runs
     unsharded)."""
     if not (isinstance(q_offset, int) and q_offset == 0):
-        raise NotImplementedError("ring attention does not support caches")
+        raise NotImplementedError(
+            "ring attention does not support caches — sequence-"
+            "sharded SERVING goes through InferenceEngine("
+            "kv_seq_shard=True), which shards the KV cache's slot "
+            "dim over the seq axis and lets the SPMD partitioner "
+            "derive the online-softmax merge collectives"
+        )
     _reject_unsupported("ring", window=window, bias=bias, scale=scale)
     S = jax.lax.axis_size("seq")
     if mask is not None and mask.shape[3] != S * k.shape[1]:
@@ -520,7 +526,11 @@ def ulysses_attention_impl(q, k, v, *, causal=False, mask=None, q_offset=0,
     engine's extras channel ships it that way; a token-SHARDED mask
     cannot be applied to the post-swap full-sequence logits."""
     if not (isinstance(q_offset, int) and q_offset == 0):
-        raise NotImplementedError("ulysses attention does not support caches")
+        raise NotImplementedError(
+            "ulysses attention does not support caches — see "
+            "InferenceEngine(kv_seq_shard=True) for sequence-"
+            "sharded serving"
+        )
     _reject_unsupported("ulysses", window=window, bias=bias, scale=scale)
     if mask is not None:
         S = jax.lax.axis_size("seq")

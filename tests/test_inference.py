@@ -393,3 +393,73 @@ def test_rolling_cache_falls_back_when_ring_would_be_larger():
         make_mesh(MeshConfig()), m, p, rolling_cache=True, **kw
     ).generate(ids, gen)
     np.testing.assert_array_equal(full, ring)
+
+
+def test_kv_seq_sharded_serving_parity_and_memory(tiny_llama):
+    """Sequence-sharded serving (VERDICT r4 next #6): the engine shards
+    the KV cache's slot dim over the ``seq`` mesh axis. Token-for-token
+    parity with the unsharded engine, and the compiled program's temp
+    bytes shrink (each device holds 1/S of the cache), so a prompt can
+    exceed one device's cache memory."""
+    cfg, m, p = tiny_llama
+    ids = np.asarray(jax.random.randint(KEY, (2, 5), 0, cfg.vocab_size))
+    gen = GenerationConfig(max_new_tokens=6)
+
+    plain = InferenceEngine(
+        make_mesh(MeshConfig()), m, p, max_len=32,
+        cache_dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    ref = plain.generate(ids, gen)
+
+    mesh = make_mesh(MeshConfig(seq=4))
+    eng = InferenceEngine(
+        mesh, m, p, max_len=32, cache_dtype=jnp.float32,
+        param_dtype=jnp.float32, kv_seq_shard=True,
+    )
+    out = eng.generate(ids, gen)
+    np.testing.assert_array_equal(out, ref)
+
+    # memory evidence at a CACHE-dominated shape (short prompt, long
+    # horizon, fat kv dims — prefill scores stay tiny): same model,
+    # same program, the ONLY difference is the sharding constraint.
+    # Compile-only: the 3k-step scan never executes.
+    # 8 layers: the partitioner may transiently all-gather ONE layer's
+    # k/v per step; with enough layers the persistent sharded cache
+    # dominates and the per-device saving approaches 1/S
+    big_cfg = LlamaConfig(
+        vocab_size=64, dim=256, num_layers=8, num_heads=4, num_kv_heads=4,
+        hidden_dim=256, max_len=4096,
+    )
+    bm = Llama(big_cfg)
+    bp = bm.init(KEY)
+    long_gen = GenerationConfig(max_new_tokens=3500)
+
+    def temp_bytes(engine, B, T0):
+        fn = engine._build(B, T0, long_gen)
+        pm = jnp.ones((B, T0), jnp.int32)
+        compiled = fn.lower(
+            engine.params, jnp.asarray(np.zeros((B, T0), np.int64)), pm,
+            jax.random.key(0),
+        ).compile()
+        return int(compiled.memory_analysis().temp_size_in_bytes)
+
+    big_plain = InferenceEngine(
+        mesh, bm, bp, max_len=4096, cache_dtype=jnp.float32,
+        param_dtype=jnp.float32,
+    )
+    big_shard = InferenceEngine(
+        mesh, bm, bp, max_len=4096, cache_dtype=jnp.float32,
+        param_dtype=jnp.float32, kv_seq_shard=True,
+    )
+    tb_plain = temp_bytes(big_plain, 2, 64)
+    tb_shard = temp_bytes(big_shard, 2, 64)
+    # seq=4 shards the slot dim: the cache term drops to ~1/4
+    assert tb_shard < 0.6 * tb_plain, (tb_shard, tb_plain)
+
+
+def test_kv_seq_shard_requires_seq_axis(tiny_llama):
+    cfg, m, p = tiny_llama
+    with pytest.raises(ValueError, match="seq"):
+        InferenceEngine(
+            make_mesh(MeshConfig()), m, p, max_len=32, kv_seq_shard=True,
+        )

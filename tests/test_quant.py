@@ -128,3 +128,50 @@ def test_quantized_engine_generates_close_to_fp(devices):
     # not exactness (ties can flip)
     agree = float((q8 == fp).mean())
     assert agree >= 0.75, (agree, q8, fp)
+
+
+def test_quantized_random_init_serves():
+    """quantized_random_init builds a serving-form tree WITHOUT float
+    weights (the 8B capacity path): Dense 2-D weights are int8+scale,
+    router/norm/embedding leaves stay float, and an InferenceEngine
+    accepts the pre-quantized tree directly (quantize='int8' skips the
+    re-quantization pass) and decodes finite tokens."""
+    from tensorlink_tpu.config import MeshConfig
+    from tensorlink_tpu.models.llama import Llama, LlamaConfig
+    from tensorlink_tpu.ops.quant import is_quantized, quantized_random_init
+    from tensorlink_tpu.parallel.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from tensorlink_tpu.runtime.mesh import make_mesh
+
+    cfg = LlamaConfig(
+        vocab_size=64, dim=32, num_layers=2, num_heads=4, num_kv_heads=2,
+        hidden_dim=64, max_len=32, moe_experts=2, moe_top_k=1,
+    )
+    m = Llama(cfg)
+    qp = quantized_random_init(m, KEY, dtype=jnp.float32)
+    assert is_quantized(qp)
+    attn = qp["blocks"]["0"]["attn"]
+    assert attn["q"]["w"]["q"].dtype == jnp.int8
+    assert attn["q"]["w"]["s"].shape == (32,)
+    # non-Dense leaves stayed plain arrays (router would crash serving
+    # if quantized; embedding is gathered, not matmul'd)
+    assert not isinstance(qp["blocks"]["0"]["mlp"]["router"]["w"], dict)
+    assert not isinstance(qp["tok_emb"]["table"], dict)
+    # effective weight std tracks LeCun 1/sqrt(fan_in) within 20%
+    import numpy as np_
+
+    eff = np_.asarray(attn["q"]["w"]["q"], np_.float32) * np_.asarray(
+        attn["q"]["w"]["s"]
+    )
+    assert 0.8 / np_.sqrt(32) < eff.std() < 1.2 / np_.sqrt(32)
+
+    eng = InferenceEngine(
+        make_mesh(MeshConfig()), m, qp, max_len=32,
+        cache_dtype=jnp.float32, param_dtype=jnp.float32, quantize="int8",
+    )
+    ids = np.asarray(jax.random.randint(KEY, (2, 5), 0, cfg.vocab_size))
+    out = eng.generate(ids, GenerationConfig(max_new_tokens=6))
+    assert out.shape == (2, 6)
+    assert (out >= 0).all() and (out < cfg.vocab_size).all()
