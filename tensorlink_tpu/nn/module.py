@@ -135,6 +135,109 @@ class Lambda(Module):
         return self._fn(x)
 
 
+class Parallel(Module):
+    """N branches over the same input, combined elementwise or by
+    feature concat — the native container for BRANCHING architectures
+    (reference parse_model walks arbitrary nn.Module trees,
+    src/roles/user.py:316-425; our equivalent is partition_tree in
+    roles/user.py, which linearizes this container into a placeable
+    chain of carry-packed stages). params: {"0": ..., "N-1": ...}."""
+
+    COMBINES = ("add", "mul", "concat")
+
+    def __init__(self, branches: Sequence[Module], combine: str = "add"):
+        super().__init__()
+        if combine not in self.COMBINES:
+            raise ValueError(f"combine must be one of {self.COMBINES}")
+        self.combine = combine
+        self.branches = list(branches)
+        for i, b in enumerate(self.branches):
+            self.child(str(i), b)
+
+    def apply(self, params, x, **kwargs):
+        rng = kwargs.pop("rng", None)
+        outs = []
+        for i, b in enumerate(self.branches):
+            r = None if rng is None else jax.random.fold_in(rng, i)
+            outs.append(b.apply(params[str(i)], x, rng=r, **kwargs))
+        if self.combine == "concat":
+            return jnp.concatenate(outs, axis=-1)
+        acc = outs[0]
+        for o in outs[1:]:
+            acc = acc + o if self.combine == "add" else acc * o
+        return acc
+
+
+class AppendTail(Module):
+    """z -> concat([z, z[..., :width]], -1): re-append the carried input
+    x at the tail so the NEXT branch's chain can consume it. Part of
+    partition_tree's carry packing (see Parallel)."""
+
+    def __init__(self, width: int):
+        super().__init__()
+        self.width = width
+
+    def init(self, key):
+        return {}
+
+    def param_spec(self, model_axis: str = "model"):
+        return {}
+
+    def apply(self, params, z, **kwargs):
+        return jnp.concatenate([z, z[..., : self.width]], axis=-1)
+
+
+class TailMap(Module):
+    """z = [prefix | h] -> [prefix | inner(h)]: run one chain unit on
+    the tail segment while carrying the prefix (the original input plus
+    already-computed branch outputs) through the stage boundary.
+    params: {"inner": ...}."""
+
+    def __init__(self, inner: Module, head_width: int):
+        super().__init__()
+        self.head_width = head_width
+        self.child("inner", inner)
+
+    def apply(self, params, z, **kwargs):
+        head = z[..., : self.head_width]
+        h = self.children["inner"].apply(
+            params["inner"], z[..., self.head_width :], **kwargs
+        )
+        return jnp.concatenate([head, h], axis=-1)
+
+
+class CombineTail(Module):
+    """z = [x | a_1 .. a_n] -> combine(a_i): drop the carried input and
+    merge the branch outputs (Parallel.combine semantics)."""
+
+    def __init__(self, combine: str, x_width: int, widths: Sequence[int]):
+        super().__init__()
+        if combine not in Parallel.COMBINES:
+            raise ValueError(f"combine must be one of {Parallel.COMBINES}")
+        self.combine = combine
+        self.x_width = x_width
+        self.widths = tuple(widths)
+
+    def init(self, key):
+        return {}
+
+    def param_spec(self, model_axis: str = "model"):
+        return {}
+
+    def apply(self, params, z, **kwargs):
+        outs = []
+        off = self.x_width
+        for w in self.widths:
+            outs.append(z[..., off : off + w])
+            off += w
+        if self.combine == "concat":
+            return jnp.concatenate(outs, axis=-1)
+        acc = outs[0]
+        for o in outs[1:]:
+            acc = acc + o if self.combine == "add" else acc * o
+        return acc
+
+
 # ----------------------------------------------------------------- specs
 # Module reconstruction from config() dicts — the receiving end of
 # spec-shipping. The sender transmits `module.config()` (plain data) +
@@ -156,6 +259,13 @@ def register_activation(name: str, fn: Callable) -> None:
     _ACTIVATION_FNS[name] = fn
 
 
+# carry-packing wrappers are defined above the registry (class order
+# follows the dataflow story); registered here
+for _cls in (AppendTail, CombineTail):
+    register_module_type(_cls)
+del _cls
+
+
 def module_from_config(cfg: Mapping[str, Any]) -> Module:
     """Rebuild a module from its config() dict. Composite modules that
     construct their own children in __init__ are rebuilt by constructor
@@ -168,6 +278,18 @@ def module_from_config(cfg: Mapping[str, Any]) -> Module:
         children = cfg.get("__children__", {})
         order = sorted(children, key=int)
         return Sequential([module_from_config(children[i]) for i in order])
+    if t == "Parallel":
+        children = cfg.get("__children__", {})
+        order = sorted(children, key=int)
+        return Parallel(
+            [module_from_config(children[i]) for i in order],
+            combine=cfg.get("combine", "add"),
+        )
+    if t == "TailMap":
+        return TailMap(
+            module_from_config(cfg["__children__"]["inner"]),
+            head_width=cfg["head_width"],
+        )
     if t == "Lambda":
         name = cfg.get("name", "")
         if name not in _ACTIVATION_FNS:

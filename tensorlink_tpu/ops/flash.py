@@ -160,9 +160,12 @@ def _as_kv_mask(mask, B: int, Tk: int):
 # Below this sequence length the XLA einsum path beats the Pallas kernel
 # on v5e: the [T,T] score tile fits comfortably and XLA's fusion wins,
 # while the kernel pays its blockwise-recompute overhead for memory it
-# doesn't need to save (measured fwd+bwd, B*S tokens held constant:
-# 128->0.8-1.0x, 512->~1.0x, 1024->1.2x, growing with S thereafter).
-MIN_KERNEL_SEQ_AUTO = 1024
+# doesn't need to save. r2 measured the crossover at ~1024 (B*S tokens
+# held constant: 128->0.8-1.0x, 512->~1.0x, 1024->1.2x); the r5 re-sweep
+# on full BERT-base train steps moved it DOWN — at T=512 the kernel wins
+# at every batch (b8 1.09x, b32 1.12x, b64 1.25x end-to-end step time):
+# the einsum path's [B,H,T,T] f32 score/softmax buffers are the drag.
+MIN_KERNEL_SEQ_AUTO = 512
 
 
 def flash_attention_impl(

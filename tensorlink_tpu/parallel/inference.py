@@ -239,7 +239,12 @@ class InferenceEngine:
                 # shard the slot dim of every [B, L, Hkv, D] cache leaf;
                 # scan carries propagate the layout, so one constraint
                 # here shards the whole generation loop
-                kv_sh = NamedSharding(self.mesh, P(None, self.seq_axis))
+                # batch stays sharded over data (a P(None, seq) spec
+                # would pin it REPLICATED — data-times the cache memory
+                # on DP+SP meshes, review finding)
+                kv_sh = NamedSharding(
+                    self.mesh, P(self.data_axis, self.seq_axis)
+                )
                 caches = jax.tree.map(
                     lambda c: jax.lax.with_sharding_constraint(c, kv_sh)
                     if getattr(c, "ndim", 0) == 4 else c,

@@ -39,22 +39,113 @@ def partition_sequential(
     """Greedy size-capped partition of a Sequential into stages
     (reference: parse_model's recursive size cap, user.py:316-425).
     Returns [(sub_module, sub_params), ...]."""
+    return _chunk_units(
+        ((layer, params[str(i)]) for i, layer in enumerate(seq.layers)),
+        max_stage_bytes,
+    )
+
+
+def _chunk_units(units, max_stage_bytes: float):
+    """Greedy size-capped chunking of (module, params) units into
+    [(Sequential, params)] stages — shared tail of both partitioners."""
     stages: list[tuple[Sequential, dict]] = []
     cur: list[Module] = []
     cur_params: dict = {}
     cur_bytes = 0
-    for i, layer in enumerate(seq.layers):
-        p = params[str(i)]
+    for mod, p in units:
         b = tree_bytes(p)
         if cur and cur_bytes + b > max_stage_bytes:
             stages.append((Sequential(cur), cur_params))
             cur, cur_params, cur_bytes = [], {}, 0
         cur_params[str(len(cur))] = p
-        cur.append(layer)
+        cur.append(mod)
         cur_bytes += b
     if cur:
         stages.append((Sequential(cur), cur_params))
     return stages
+
+
+def partition_tree(
+    module: Module,
+    params: dict,
+    max_stage_bytes: float,
+    example: "jax.ShapeDtypeStruct | None" = None,
+) -> list[tuple[Sequential, dict]]:
+    """Memory-capped partition of an ARBITRARY module tree — including
+    branching ``Parallel`` containers — into a placeable CHAIN of
+    stages (the TPU-native answer to the reference's recursive
+    parse_model walk, src/roles/user.py:316-425, which descends any
+    nn.Module tree by memory).
+
+    A ``Parallel`` that exceeds the budget is linearized with carry
+    packing: the input x rides the activation's feature tail through
+    each branch's stages (TailMap), finished branch outputs accumulate
+    in the prefix, and a CombineTail stage merges them — so branch
+    stages place on DIFFERENT workers while the wire still carries one
+    array per hop. ``example`` (a ShapeDtypeStruct or array of the
+    model input) is required when a Parallel must split: packing
+    offsets come from eval_shape through the tree. Sequential trees
+    reduce to partition_sequential's greedy chunks exactly."""
+
+    def out_aval(mod, p, aval):
+        return jax.eval_shape(
+            lambda pp, xx: mod.apply(pp, xx), p,
+            jax.ShapeDtypeStruct(aval.shape, aval.dtype),
+        )
+
+    def linearize(mod, p, aval):
+        """-> (units [(module, params)], out_aval)."""
+        if isinstance(mod, Sequential):
+            units = []
+            for i, layer in enumerate(mod.layers):
+                u, aval = linearize(layer, p[str(i)], aval)
+                units.extend(u)
+            return units, aval
+        from tensorlink_tpu.nn.module import (
+            AppendTail,
+            CombineTail,
+            Parallel,
+            TailMap,
+        )
+
+        if isinstance(mod, Parallel) and tree_bytes(p) > max_stage_bytes:
+            if aval is None:
+                raise ValueError(
+                    "partition_tree needs `example` to split a Parallel "
+                    "container (packing offsets come from eval_shape)"
+                )
+            x_width = aval.shape[-1]
+            units: list = []
+            prefix = x_width
+            widths = []
+            branch_out = None
+            for i, branch in enumerate(mod.branches):
+                units.append((AppendTail(x_width), {}))
+                bunits, b_aval = linearize(branch, p[str(i)], aval)
+                for bu, bp in bunits:
+                    units.append((TailMap(bu, prefix), {"inner": bp}))
+                widths.append(b_aval.shape[-1])
+                prefix += b_aval.shape[-1]
+                branch_out = b_aval
+            units.append(
+                (CombineTail(mod.combine, x_width, widths), {})
+            )
+            if mod.combine == "concat":
+                out = jax.ShapeDtypeStruct(
+                    (*branch_out.shape[:-1], sum(widths)), branch_out.dtype
+                )
+            else:
+                out = branch_out
+            return units, out
+        # atomic unit (fits, or indivisible — the greedy chunker gives
+        # an oversized atom its own stage, same as partition_sequential)
+        return [(mod, p)], None if aval is None else out_aval(mod, p, aval)
+
+    aval = None
+    if example is not None:
+        aval = jax.ShapeDtypeStruct(example.shape, example.dtype)
+    units, _ = linearize(module, params, aval)
+    return _chunk_units(units, max_stage_bytes)
 
 
 class StepEndFailure(RuntimeError):
@@ -950,6 +1041,8 @@ class UserNode(Node):
         obfuscate: bool = False,
         obfuscate_key: jax.Array | None = None,
         relay: bool | None = None,
+        example=None,  # model-input ShapeDtypeStruct/array: enables
+        # partition_tree's branch splitting (Parallel containers)
     ) -> DistributedJob:
         """Partition -> JOB_REQ -> connect workers -> ship specs+weights ->
         LOADED acks -> DistributedJob (reference call stack §3.1).
@@ -975,7 +1068,20 @@ class UserNode(Node):
             # would train in the rotated basis while lora_merge later
             # adds them in the clear one — silently wrong weights
             raise ValueError("obfuscation is incompatible with train_only='lora'")
-        stage_parts = partition_sequential(model, params, max_stage_bytes)
+        from tensorlink_tpu.nn.module import Parallel
+
+        def has_parallel(m) -> bool:
+            return isinstance(m, Parallel) or any(
+                has_parallel(c) for c in getattr(m, "children", {}).values()
+            )
+
+        if example is not None or has_parallel(model):
+            # branching trees linearize via carry packing (partition_tree)
+            stage_parts = partition_tree(
+                model, params, max_stage_bytes, example=example
+            )
+        else:
+            stage_parts = partition_sequential(model, params, max_stage_bytes)
         plan = None
         key = None
         if obfuscate:

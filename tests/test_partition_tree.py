@@ -1,0 +1,163 @@
+"""partition_tree: branching-module partitioning into placeable chains
+(VERDICT r4 next #10 — the reference's parse_model walks ANY nn.Module
+tree by memory, src/roles/user.py:316-425; our Parallel container +
+carry packing is the TPU-native equivalent)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.nn.layers import Dense
+from tensorlink_tpu.nn.module import (
+    Lambda,
+    Parallel,
+    Sequential,
+    _ACTIVATION_FNS,
+    module_from_config,
+)
+from tensorlink_tpu.roles.user import partition_sequential, partition_tree
+
+KEY = jax.random.key(0)
+
+
+def _relu():
+    return Lambda(_ACTIVATION_FNS["relu"], name="relu")
+
+
+def _two_branch(dim=16, hidden=32, combine="add"):
+    """x -> branchA(2-layer MLP) (+|*|cat) branchB(1-layer)."""
+    a = Sequential([Dense(dim, hidden), _relu(), Dense(hidden, dim)])
+    b = Sequential([Dense(dim, dim)])
+    model = Sequential([
+        Dense(dim, dim), _relu(),
+        Parallel([a, b], combine=combine),
+        Dense(dim if combine != "concat" else 2 * dim, 4),
+    ])
+    return model, model.init(KEY)
+
+
+def test_parallel_module_combines():
+    for combine in ("add", "mul", "concat"):
+        m, p = _two_branch(combine=combine)
+        x = jax.random.normal(jax.random.key(1), (4, 16))
+        out = m.apply(p, x)
+        assert out.shape == (4, 4)
+    # config round trip
+    rebuilt = module_from_config(m.config())
+    np.testing.assert_allclose(
+        np.asarray(rebuilt.apply(p, x)), np.asarray(m.apply(p, x)), atol=0
+    )
+
+
+@pytest.mark.parametrize("combine", ["add", "concat"])
+def test_partition_tree_splits_branches_chain_parity(combine):
+    """An over-budget Parallel linearizes into carry-packed stages whose
+    chained application equals the direct tree forward."""
+    m, p = _two_branch(combine=combine)
+    x = jax.random.normal(jax.random.key(2), (4, 16))
+    ref = np.asarray(m.apply(p, x))
+    # budget below the Parallel's total bytes forces the split
+    from tensorlink_tpu.utils.trees import tree_bytes
+
+    par_bytes = tree_bytes(p["2"])
+    stages = partition_tree(
+        m, p, max_stage_bytes=par_bytes * 0.7,
+        example=jax.ShapeDtypeStruct((4, 16), jnp.float32),
+    )
+    assert len(stages) >= 2
+    h = x
+    for smod, sp in stages:
+        h = smod.apply(sp, h)
+    np.testing.assert_allclose(np.asarray(h), ref, atol=1e-5)
+    # every stage SHIPS: rebuild each from config() and run the chain
+    h2 = x
+    for smod, sp in stages:
+        h2 = module_from_config(smod.config()).apply(sp, h2)
+    np.testing.assert_allclose(np.asarray(h2), ref, atol=1e-5)
+
+
+def test_partition_tree_reduces_to_sequential_chunks():
+    """On a plain Sequential the unit chunking matches
+    partition_sequential (same stage boundaries, same parity)."""
+    m = Sequential([Dense(8, 32), _relu(), Dense(32, 32), _relu(),
+                    Dense(32, 4)])
+    p = m.init(KEY)
+    budget = 8 * 32 * 4 + 200
+    a = partition_sequential(m, p, budget)
+    b = partition_tree(m, p, budget)
+    assert [len(s.layers) for s, _ in a] == [len(s.layers) for s, _ in b]
+    x = jax.random.normal(jax.random.key(3), (2, 8))
+    ha = x
+    for smod, sp in a:
+        ha = smod.apply(sp, ha)
+    hb = x
+    for smod, sp in b:
+        hb = smod.apply(sp, hb)
+    np.testing.assert_allclose(np.asarray(ha), np.asarray(hb), atol=0)
+
+
+def test_partition_tree_needs_example_for_split():
+    m, p = _two_branch()
+    with pytest.raises(ValueError, match="example"):
+        partition_tree(m, p, max_stage_bytes=100)
+
+
+@pytest.mark.asyncio
+async def test_two_branch_model_trains_over_two_workers():
+    """VERDICT r4 next #10 done-criterion: a two-branch model places and
+    trains over 2 workers."""
+    from tensorlink_tpu.config import NodeConfig
+    from tensorlink_tpu.roles.registry import InMemoryRegistry
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    m, p = _two_branch(dim=16, hidden=32)
+    from tensorlink_tpu.utils.trees import tree_bytes
+
+    def cfg(role):
+        return NodeConfig(role=role, host="127.0.0.1", port=0)
+
+    reg = InMemoryRegistry()
+    validator = ValidatorNode(cfg("validator"), registry=reg)
+    await validator.start()
+    workers = []
+    for _ in range(2):
+        w = WorkerNode(cfg("worker"))
+        await w.start()
+        await w.connect("127.0.0.1", validator.port)
+        workers.append(w)
+    user = UserNode(cfg("user"))
+    await user.start()
+    v_peer = await user.connect("127.0.0.1", validator.port)
+    try:
+        job = await user.request_job(
+            m, p, v_peer,
+            max_stage_bytes=tree_bytes(p) * 0.6, micro_batches=2,
+            train={"optimizer": "sgd", "learning_rate": 0.05},
+            example=jax.ShapeDtypeStruct((8, 16), jnp.float32),
+        )
+        assert len(job.stages) == 2
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 16)).astype(np.float32)
+        y = rng.integers(0, 4, 8)
+
+        def lg(logits, micro):
+            lj = jnp.asarray(logits)
+            yj = jnp.asarray(np.array_split(y, 2)[micro])
+
+            def f(l):
+                return jnp.mean(
+                    jax.nn.logsumexp(l, -1)
+                    - jnp.take_along_axis(l, yj[:, None], -1)[..., 0]
+                )
+
+            val, g = jax.value_and_grad(f)(lj)
+            return float(val), np.asarray(g)
+
+        losses = [await job.train_step(x, lg) for _ in range(8)]
+        assert losses[-1] < losses[0]
+    finally:
+        for n in (user, validator, *workers):
+            await n.stop()

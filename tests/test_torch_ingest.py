@@ -143,3 +143,129 @@ async def test_ingested_torch_model_trains_distributed():
     finally:
         for n in (user, validator, *workers):
             await n.stop()
+
+
+def test_multihead_attention_parity():
+    """torch nn.MultiheadAttention (self-attention) converts to the
+    native module with exact in_proj unpacking (VERDICT r4 next #9)."""
+    tn = torch.nn
+    torch.manual_seed(4)
+    tm = tn.MultiheadAttention(32, 4, batch_first=True)
+    tm.eval()
+    native, params = from_torch(tm)
+    x = np.random.default_rng(3).normal(size=(2, 10, 32)).astype(np.float32)
+    with torch.no_grad():
+        ref, _ = tm(torch.tensor(x), torch.tensor(x), torch.tensor(x))
+    out = np.asarray(native.apply(params, jnp.asarray(x)))
+    np.testing.assert_allclose(out, ref.numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("norm_first", [False, True])
+def test_transformer_encoder_parity(norm_first):
+    """A full torch TransformerEncoder (the 'not in the HF zoo' case)
+    converts structurally: logit parity <= 1e-4, both norm styles."""
+    tn = torch.nn
+    torch.manual_seed(5)
+    layer = tn.TransformerEncoderLayer(
+        d_model=32, nhead=4, dim_feedforward=64, dropout=0.1,
+        batch_first=True, norm_first=norm_first,
+    )
+    tm = tn.Sequential(
+        tn.TransformerEncoder(layer, num_layers=2, norm=tn.LayerNorm(32)),
+        tn.Linear(32, 5),
+    )
+    tm.eval()
+    native, params = from_torch(tm)
+    # 2 blocks + final norm + linear
+    assert len(native) == 4
+    x = np.random.default_rng(4).normal(size=(3, 12, 32)).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.tensor(x)).numpy()
+    out = np.asarray(native.apply(params, jnp.asarray(x)))
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_mha_unsupported_forms_raise():
+    tn = torch.nn
+    with pytest.raises(UnsupportedTorchModule, match="batch_first"):
+        from_torch(tn.MultiheadAttention(16, 2))
+    with pytest.raises(UnsupportedTorchModule, match="dropout"):
+        from_torch(tn.MultiheadAttention(16, 2, dropout=0.2, batch_first=True))
+
+
+@pytest.mark.asyncio
+async def test_ingested_torch_transformer_finetunes_distributed():
+    """VERDICT r4 next #9 done-criterion: a torch TransformerEncoder not
+    in the HF zoo fine-tunes via request_job after structural conversion
+    (and its pre-training logits match torch <= 1e-4)."""
+    from tensorlink_tpu.config import NodeConfig
+    from tensorlink_tpu.roles.registry import InMemoryRegistry
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    tn = torch.nn
+    torch.manual_seed(6)
+    layer = tn.TransformerEncoderLayer(
+        d_model=16, nhead=2, dim_feedforward=32, dropout=0.0,
+        batch_first=True,
+    )
+    tm = tn.Sequential(
+        tn.TransformerEncoder(layer, num_layers=2),
+        tn.Linear(16, 4),
+    )
+    tm.eval()
+    native, params = from_torch(tm)
+    x = np.random.default_rng(5).normal(size=(8, 6, 16)).astype(np.float32)
+    with torch.no_grad():
+        ref = tm(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(
+        np.asarray(native.apply(params, jnp.asarray(x))), ref, atol=1e-4
+    )
+
+    def cfg(role):
+        return NodeConfig(role=role, host="127.0.0.1", port=0)
+
+    reg = InMemoryRegistry()
+    validator = ValidatorNode(cfg("validator"), registry=reg)
+    await validator.start()
+    workers = []
+    for _ in range(2):
+        w = WorkerNode(cfg("worker"))
+        await w.start()
+        await w.connect("127.0.0.1", validator.port)
+        workers.append(w)
+    user = UserNode(cfg("user"))
+    await user.start()
+    v_peer = await user.connect("127.0.0.1", validator.port)
+    try:
+        job = await user.request_job(
+            native, params, v_peer,
+            # one encoder block is ~8.5 KB of f32; budget one block per
+            # stage so the two blocks split across the two workers
+            max_stage_bytes=10000, micro_batches=2,
+            train={"optimizer": "sgd", "learning_rate": 0.05},
+        )
+        assert len(job.stages) >= 2
+        y = np.random.default_rng(6).integers(0, 4, 8)
+
+        def lg(logits, micro):
+            lj = jnp.asarray(logits).mean(axis=1)  # pool tokens
+            yj = jnp.asarray(np.array_split(y, 2)[micro])
+
+            def f(l):
+                return jnp.mean(
+                    jax.nn.logsumexp(l, -1)
+                    - jnp.take_along_axis(l, yj[:, None], -1)[..., 0]
+                )
+
+            val, g = jax.value_and_grad(
+                lambda l: f(l.mean(axis=1))
+            )(jnp.asarray(logits))
+            return float(val), np.asarray(g)
+
+        losses = [await job.train_step(x, lg) for _ in range(8)]
+        assert losses[-1] < losses[0]
+    finally:
+        for n in (user, validator, *workers):
+            await n.stop()
