@@ -283,12 +283,24 @@ class MultiHeadAttention(Module):
             resolved = resolve_attn_impl(attn_impl)
             from tensorlink_tpu.ops.flash import flash_attention_impl
 
-            base = getattr(resolved, "func", resolved)  # unwrap partial
-            if base not in (dot_product_attention, flash_attention_impl):
+            # unwrap partials all the way down (advisor r4: a doubly
+            # wrapped partial defeated a single .func hop), and let a
+            # user-supplied callable DECLARE window support instead of
+            # relying on identity alone
+            base = resolved
+            while hasattr(base, "func"):
+                base = base.func
+            declares = getattr(resolved, "supports_window", False) or getattr(
+                base, "supports_window", False
+            )
+            if base not in (dot_product_attention, flash_attention_impl) \
+                    and not declares:
                 raise ValueError(
                     "sliding-window attention requires attn_impl "
                     "'reference', 'flash', or 'auto' (the ring/ulysses "
-                    "kernels do not implement window masking)"
+                    "kernels do not implement window masking), or a "
+                    "callable marked `supports_window = True` that "
+                    "honors the window kwarg"
                 )
         self.window = window
         if scale is not None:
@@ -360,6 +372,7 @@ class MultiHeadAttention(Module):
         kv=None,  # cross-attention: keys/values from THIS source (enc out)
         precomputed_kv=None,  # (k, v) [B,Tk,Hkv,D]: skip k/v projections
         bias=None,  # additive attention bias [1|B, H, Tq, Tk] (T5 rel-pos)
+        fresh_keys=None,  # None = infer from mask width (see below)
         **kw,
     ):
         B, T, _ = x.shape
@@ -426,12 +439,41 @@ class MultiHeadAttention(Module):
             # caller owns slot validity/window masking (slot order is
             # no longer logical order past the first wrap) — see
             # parallel/inference.py rolling_cache.
-            wslot = (
-                cache["index"] % cache["k"].shape[1] if rolling
-                else cache["index"]
-            )
-            ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k.astype(cache["k"].dtype), wslot, axis=1)
-            cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v.astype(cache["v"].dtype), wslot, axis=1)
+            cap = cache["k"].shape[1]
+            wslot = cache["index"] % cap if rolling else cache["index"]
+            if rolling and T > cap:
+                # duplicate wrapped slots: scatter order for duplicate
+                # indices is implementation-defined — never silent
+                raise ValueError(
+                    f"rolling write of {T} tokens exceeds ring capacity "
+                    f"{cap}: later tokens would overwrite earlier ones "
+                    "in undefined order; chunk the write"
+                )
+            if rolling and T > 1:
+                # a multi-token write can CROSS the ring edge (advisor
+                # r4: dynamic_update_slice silently CLAMPS there, landing
+                # tokens in wrong slots). lax.cond keeps the engine's
+                # hot prefill path (index 0, never wraps) on the single
+                # contiguous dynamic_update_slice; the wrapping case
+                # (chunked-prefill/speculative at index > 0) takes a
+                # true modular scatter.
+                slots = (wslot + jnp.arange(T)) % cap  # [T]
+
+                def write(c, val):
+                    return jax.lax.cond(
+                        wslot + T <= cap,
+                        lambda cc: jax.lax.dynamic_update_slice_in_dim(
+                            cc, val, wslot, axis=1
+                        ),
+                        lambda cc: cc.at[:, slots].set(val),
+                        c,
+                    )
+
+                ck = write(cache["k"], k.astype(cache["k"].dtype))
+                cv = write(cache["v"], v.astype(cache["v"].dtype))
+            else:
+                ck = jax.lax.dynamic_update_slice_in_dim(cache["k"], k.astype(cache["k"].dtype), wslot, axis=1)
+                cv = jax.lax.dynamic_update_slice_in_dim(cache["v"], v.astype(cache["v"].dtype), wslot, axis=1)
             new_cache = {"k": ck, "v": cv, "index": cache["index"] + T}
             if rolling:
                 new_cache["rolling"] = None
@@ -443,9 +485,39 @@ class MultiHeadAttention(Module):
             # engine's 6.4x serving win over the full cache was mostly
             # this waste). The cache is still written for the decode
             # steps that follow.
+            # contract (advisor r4: the inference was mask-shape-only):
+            # an EXPLICIT fresh_keys wins; None infers "prefill over an
+            # empty cache" from a T-wide mask at T > 1. The inference is
+            # unambiguous for the engine (its tight cache capacity is
+            # always > T0, so a full-cache mask can't alias a prompt
+            # mask). A chunked-prefill/speculative caller at index > 0
+            # attends the CACHE and must therefore carry a CACHE-width
+            # mask — the non-fresh path masks cache slots, so a T-wide
+            # mask cannot express it; fresh_keys=False with a T-wide
+            # mask raises here instead of failing in a broadcast deep
+            # below (review finding). The index>0 NaN-poison further
+            # down still catches silent fresh-path misuse, since the
+            # traced index can't gate a branch.
             fresh = (
-                T > 1 and mask is not None and mask.shape[-1] == T
+                fresh_keys if fresh_keys is not None
+                else T > 1 and mask is not None and mask.shape[-1] == T
             )
+            if fresh and (mask is None or mask.shape[-1] != T):
+                raise ValueError(
+                    "fresh_keys=True needs a T-wide mask over the "
+                    f"just-projected keys (got mask "
+                    f"{None if mask is None else mask.shape}, T={T})"
+                )
+            if (
+                not fresh and mask is not None
+                and mask.shape[-1] not in (1, ck.shape[1])
+            ):
+                raise ValueError(
+                    "cache attention needs a cache-width mask (last dim "
+                    f"{ck.shape[1]}), got {mask.shape}; a prompt-width "
+                    "mask is the fresh-keys prefill form (fresh_keys="
+                    "True / the T-wide inference)"
+                )
             Tk = ck.shape[1]
             if not fresh:
                 k, v = ck, cv
@@ -522,8 +594,7 @@ class MultiHeadAttention(Module):
                     bias=bias, scale=getattr(self, "scale", None),
                     window=window,
                 )
-        if cache is not None and T > 1 and mask is not None \
-                and mask.shape[-1] == T:
+        if cache is not None and fresh:
             # fresh-keys guard: the contract only holds for an EMPTY
             # cache (prefill) — a chunked-prefill/speculative caller at
             # index>0 would silently drop all cached context. The index
