@@ -26,6 +26,7 @@ class MockRegistryContract:
 
     def __init__(self):
         self._validators: dict[str, dict] = {}  # nodeId -> record, insertion-ordered
+        self._jobs: list[dict] = []  # on-chain job records (1-based ids)
         self._clock = 1_700_000_000  # deterministic "block time"
 
     def execute(self, calldata: bytes) -> bytes:
@@ -60,6 +61,38 @@ class MockRegistryContract:
             if node_id in self._validators:
                 self._validators[node_id]["reputation_milli"] = rep
             return b""
+        # --- job/payment records (reference carried requestJob only as
+        # commented-out intent, src/roles/user.py:50-64,171-199; here the
+        # write path is live end to end against this contract)
+        if sel == selector("jobCount()"):
+            return abi.encode(["uint256"], [len(self._jobs)])
+        if sel == selector("requestJob(string,uint256,uint256)"):
+            user_id, capacity, payment = abi.decode(
+                ["string", "uint256", "uint256"], args
+            )
+            self._clock += 1
+            self._jobs.append({
+                "user_id": user_id, "capacity": capacity,
+                "payment_milli": payment, "completed": False,
+                "requested_at": self._clock,
+            })
+            return abi.encode(["uint256"], [len(self._jobs)])
+        if sel == selector("completeJob(uint256)"):
+            [job_id] = abi.decode(["uint256"], args)
+            if not 1 <= job_id <= len(self._jobs):
+                raise ValueError(f"unknown job {job_id}")
+            self._jobs[job_id - 1]["completed"] = True
+            return b""
+        if sel == selector("jobAt(uint256)"):
+            [job_id] = abi.decode(["uint256"], args)
+            if not 1 <= job_id <= len(self._jobs):
+                raise ValueError(f"unknown job {job_id}")
+            rec = self._jobs[job_id - 1]
+            return abi.encode(
+                ["string", "uint256", "uint256", "bool"],
+                [rec["user_id"], rec["capacity"], rec["payment_milli"],
+                 rec["completed"]],
+            )
         raise ValueError(f"unknown selector {sel.hex()}")
 
 

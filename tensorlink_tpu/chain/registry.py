@@ -17,8 +17,21 @@ Contract interface (minimal, defined by this framework — the reference's
     function registerValidator(string nodeId, string host, uint256 port)
     function deregisterValidator(string nodeId)
     function setReputation(string nodeId, uint256 reputationMilli)
+    function jobCount() view returns (uint256)
+    function requestJob(string userId, uint256 capacityBytes,
+                        uint256 paymentMilli) returns (uint256 jobId)
+    function completeJob(uint256 jobId)
+    function jobAt(uint256 jobId) view returns
+        (string userId, uint256 capacityBytes, uint256 paymentMilli,
+         bool completed)
 
-Reputation rides as milli-units (uint) since the EVM has no floats.
+Reputation and payment ride as milli-units (uint) since the EVM has no
+floats. The job functions are the ON-CHAIN job/payment records the
+reference only carried as commented-out intent (src/roles/user.py:
+50-64, 171-199; the whitepaper anchors payments on-chain) — here the
+write path is live: UserNode.request_job(chain_registry=...) records
+the request before placement and DistributedJob.complete_onchain()
+closes it.
 """
 
 from __future__ import annotations
@@ -40,6 +53,10 @@ _SEL = {
     "registerValidator": selector("registerValidator(string,string,uint256)"),
     "deregisterValidator": selector("deregisterValidator(string)"),
     "setReputation": selector("setReputation(string,uint256)"),
+    "jobCount": selector("jobCount()"),
+    "requestJob": selector("requestJob(string,uint256,uint256)"),
+    "completeJob": selector("completeJob(uint256)"),
+    "jobAt": selector("jobAt(uint256)"),
 }
 
 
@@ -166,3 +183,32 @@ class Web3Registry(Registry):
             "setReputation", ["string", "uint256"],
             [node_id, max(0, round(rep * 1000))],
         )
+
+    # -- on-chain job/payment records (module docstring) ----------------
+    def request_job_onchain(
+        self, user_id: str, capacity_bytes: int, payment_milli: int
+    ) -> int:
+        """Record a job request; -> its on-chain job id. A transaction
+        cannot return a value over JSON-RPC (real deployments read the
+        event log), so the id is read back as jobCount() after the
+        receipt — safe while one user submits at a time; concurrent
+        submitters on a real chain would parse the JobRequested event."""
+        self._transact(
+            "requestJob", ["string", "uint256", "uint256"],
+            [user_id, int(capacity_bytes), int(payment_milli)],
+        )
+        [count] = self._read("jobCount", ["uint256"], [], [])
+        return int(count)
+
+    def complete_job_onchain(self, job_id: int) -> None:
+        self._transact("completeJob", ["uint256"], [int(job_id)])
+
+    def job_onchain(self, job_id: int) -> dict:
+        user_id, cap, pay, done = self._read(
+            "jobAt", ["string", "uint256", "uint256", "bool"],
+            ["uint256"], [int(job_id)],
+        )
+        return {
+            "user_id": user_id, "capacity_bytes": int(cap),
+            "payment_milli": int(pay), "completed": bool(done),
+        }

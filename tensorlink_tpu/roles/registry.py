@@ -50,6 +50,20 @@ class Registry(abc.ABC):
         """Re-fetch any cached view. Blocking I/O allowed — callers on the
         event loop wrap this in asyncio.to_thread. Default: no-op."""
 
+    # -- on-chain job/payment records (chain/registry.py docstring): the
+    # reference carried requestJob only as commented-out intent; backends
+    # without a job ledger return None and callers skip the recording
+    def request_job_onchain(
+        self, user_id: str, capacity_bytes: int, payment_milli: int
+    ) -> int | None:
+        return None
+
+    def complete_job_onchain(self, job_id: int) -> None:
+        pass
+
+    def job_onchain(self, job_id: int) -> dict | None:
+        return None
+
     def sample_validators(self, k: int = 6) -> list[ValidatorEntry]:
         """Bootstrap sampling (reference: <=6 random contract validators,
         smart_node.py:539-585)."""
@@ -79,3 +93,26 @@ class InMemoryRegistry(Registry):
     def set_reputation(self, node_id: str, rep: float) -> None:
         if node_id in self._validators:
             self._validators[node_id].reputation = rep
+
+    # job ledger (same semantics as the chain contract, memory-backed so
+    # role tests can assert the request->complete lifecycle hermetically)
+    def request_job_onchain(
+        self, user_id: str, capacity_bytes: int, payment_milli: int
+    ) -> int:
+        jobs = getattr(self, "_jobs", None)
+        if jobs is None:
+            jobs = self._jobs = []
+        jobs.append({
+            "user_id": user_id, "capacity_bytes": int(capacity_bytes),
+            "payment_milli": int(payment_milli), "completed": False,
+        })
+        return len(jobs)
+
+    def complete_job_onchain(self, job_id: int) -> None:
+        self._jobs[job_id - 1]["completed"] = True
+
+    def job_onchain(self, job_id: int) -> dict | None:
+        jobs = getattr(self, "_jobs", [])
+        if not 1 <= job_id <= len(jobs):
+            return None
+        return dict(jobs[job_id - 1])

@@ -203,6 +203,11 @@ class DistributedJob:
             raise ValueError("relay transfer is incompatible with obfuscation")
         self.stage_modules = stage_modules
         self.obfuscate_key = None  # set by request_job/reattach_job
+        # on-chain job record (request_job(chain_registry=...)): the
+        # ledger id this job was requested under, completed by
+        # complete_onchain() when the user is done
+        self.chain_registry = None
+        self.chain_job_id: int | None = None
         self.step = 0
         # last-known params per stage, used to re-ship on stage recovery
         # (seeded with the initial shipment; refreshed by checkpoint_stages)
@@ -391,6 +396,18 @@ class DistributedJob:
             if self.plan is not None:
                 g = self.plan.backward_out(st.index, g)
         return g
+
+    async def complete_onchain(self) -> None:
+        """Mark this job's on-chain record completed (releases the
+        payment escrow in a real deployment; see chain/registry.py).
+        No-op when the job was not requested with a chain_registry."""
+        if self.chain_registry is None or self.chain_job_id is None:
+            return
+        import asyncio as _asyncio
+
+        await _asyncio.to_thread(
+            self.chain_registry.complete_job_onchain, self.chain_job_id
+        )
 
     async def train_step(
         self,
@@ -1043,6 +1060,10 @@ class UserNode(Node):
         relay: bool | None = None,
         example=None,  # model-input ShapeDtypeStruct/array: enables
         # partition_tree's branch splitting (Parallel containers)
+        chain_registry=None,  # Registry with a job ledger: record the
+        # request on-chain before placement (reference intent,
+        # src/roles/user.py:50-64,171-199; chain/registry.py docstring)
+        chain_payment_milli: int = 0,
     ) -> DistributedJob:
         """Partition -> JOB_REQ -> connect workers -> ship specs+weights ->
         LOADED acks -> DistributedJob (reference call stack §3.1).
@@ -1082,6 +1103,15 @@ class UserNode(Node):
             )
         else:
             stage_parts = partition_sequential(model, params, max_stage_bytes)
+        chain_job_id = None
+        if chain_registry is not None:
+            # record BEFORE placement (the reference's requestJob intent
+            # preceded recruitment); blocking RPC off the event loop
+            chain_job_id = await asyncio.to_thread(
+                chain_registry.request_job_onchain,
+                self.node_id, int(tree_bytes(params)),
+                int(chain_payment_milli),
+            )
         plan = None
         key = None
         if obfuscate:
@@ -1163,6 +1193,8 @@ class UserNode(Node):
             self, job, remote, validator=validator, plan=plan,
             stage_modules=[seq for seq, _ in stage_parts], relay=relay,
         )
+        dj.chain_registry = chain_registry
+        dj.chain_job_id = chain_job_id
         dj.backup_validators = list(resp.get("validators", []))
         # mirror the replica validators' IDS into our record (addresses
         # live in backup_validators; after a checkpoint resume the fresh
