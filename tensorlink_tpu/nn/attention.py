@@ -16,7 +16,6 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 from tensorlink_tpu.nn.module import Module
 from tensorlink_tpu.nn.layers import Dense

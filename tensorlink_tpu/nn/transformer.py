@@ -8,9 +8,8 @@ src/roles/user.py:316-425)."""
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 
-from tensorlink_tpu.nn.module import Module, Sequential
+from tensorlink_tpu.nn.module import Module
 from tensorlink_tpu.nn.layers import Dense, Dropout, LayerNorm, RMSNorm
 from tensorlink_tpu.nn.attention import MultiHeadAttention
 

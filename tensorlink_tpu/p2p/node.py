@@ -37,6 +37,10 @@ class Peer:
     msgs_in: int = 0
     msgs_out: int = 0
     connected_at: float = field(default_factory=time.time)
+    # wall time of the last frame received from this peer: the straggler
+    # report's heartbeat age (a slow stage whose heartbeat is also stale
+    # is dead, not slow)
+    last_seen: float = field(default_factory=time.time)
 
     @property
     def node_id(self) -> str:
@@ -81,6 +85,19 @@ class Node:
         from tensorlink_tpu.runtime.metrics import Metrics
 
         self.metrics = Metrics()  # published via GET /metrics
+        # runtime.* imports stay out of module scope on purpose (same as
+        # Metrics above): the runtime package re-exports mesh, which
+        # imports jax — module-level would make `import p2p.node` pay
+        # jax's full load for jax-free tooling (review finding)
+        from tensorlink_tpu.runtime.tracing import (
+            Tracer,
+            current_trace_context,
+        )
+
+        # span buffer published via GET /spans (runtime/tracing.py);
+        # spans propagate to peers through the _trace envelope field
+        self.tracer = Tracer(service=f"{cfg.role}:{self.node_id[:8]}")
+        self._trace_ctx = current_trace_context  # hot-path binding (send)
         self.register_handlers()
 
     # ------------------------------------------------------------ lifecycle
@@ -136,7 +153,7 @@ class Node:
     # ------------------------------------------------------ NAT traversal
     # (reference: miniupnpc IGD mapping at node start, smart_node.py:787-816)
     async def _init_upnp(self) -> None:
-        from tensorlink_tpu.p2p.nat import UpnpError, UpnpGateway
+        from tensorlink_tpu.p2p.nat import UpnpGateway
 
         try:
             gw = await asyncio.to_thread(
@@ -694,6 +711,7 @@ class Node:
                     self._penalize(peer)
                     continue
                 peer.msgs_in += 1
+                peer.last_seen = time.time()
                 self.metrics.incr("msgs_in")
                 # only known types get their own counter: a peer spraying
                 # random type strings must not grow the registry (and the
@@ -723,7 +741,20 @@ class Node:
             self._penalize(peer)
             return
         try:
-            reply = await handler(self, peer, msg)
+            ctx = msg.get("_trace")
+            if isinstance(ctx, dict):  # hostile peers may send junk here
+                # the sender had a span open: continue ITS trace — this
+                # server-side span's parent_id is the requester's span id
+                # on the other node, which is what stitches one job's
+                # RPC chain into a single cross-node trace
+                with self.tracer.span(
+                    f"rpc.{msg['type']}",
+                    {"peer": peer.node_id[:8], "peer_role": peer.role},
+                    remote=ctx,
+                ):
+                    reply = await handler(self, peer, msg)
+            else:
+                reply = await handler(self, peer, msg)
         except Exception as e:  # noqa: BLE001
             self.log.warning("handler %s failed: %s", msg["type"], e)
             reply = {"type": "ERROR", "error": str(e)}
@@ -766,6 +797,14 @@ class Node:
     async def send(self, peer: Peer, msg: dict) -> None:
         peer.msgs_out += 1
         self.metrics.incr("msgs_out")
+        if "_trace" not in msg:
+            # trace-context propagation: only while a span is active —
+            # an untraced node's messages carry no envelope overhead
+            # (one ContextVar read decides). Copy before injecting: the
+            # caller's dict may be reused (retries re-send it).
+            ctx = self._trace_ctx()
+            if ctx is not None:
+                msg = dict(msg, _trace=ctx)
         await peer.stream.send(encode_message(msg))
 
     async def request(
@@ -777,11 +816,19 @@ class Node:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[msg["id"]] = fut
         self._pending_peer[msg["id"]] = peer.node_id
+        t0 = time.perf_counter()
         try:
             await self.send(peer, msg)
-            return await asyncio.wait_for(
+            resp = await asyncio.wait_for(
                 fut, timeout or self.cfg.request_timeout_s
             )
+            # request/response round-trip latency histogram — the p50/
+            # p90/p99 behind /metrics?format=prom (only successful
+            # round-trips: a timeout is a liveness event, not a latency)
+            self.metrics.observe_hist(
+                "rpc_seconds", time.perf_counter() - t0
+            )
+            return resp
         finally:
             self._pending.pop(msg["id"], None)
             self._pending_peer.pop(msg["id"], None)
@@ -934,9 +981,19 @@ class Node:
                     "msgs_in": p.msgs_in,
                     "msgs_out": p.msgs_out,
                     "ghosts": p.ghosts,
+                    "last_seen_age_s": round(time.time() - p.last_seen, 3),
                 }
                 for p in self.peers.values()
             },
             "dht_keys": len(self.dht.store),
             "routing_peers": len(self.dht.table),
+            # per-stage step-time skew + heartbeat age (runtime/tracing):
+            # populated from the stage{i}_fwd_s/_bwd_s series the master
+            # and workers record per micro-batch
+            "stragglers": self._straggler_report(),
         }
+
+    def _straggler_report(self) -> dict:
+        from tensorlink_tpu.runtime.tracing import straggler_report
+
+        return straggler_report(self.metrics, self.peers)

@@ -15,7 +15,7 @@ ever executable:
 
 from __future__ import annotations
 
-from typing import Any, Mapping, Sequence
+from typing import Any, Mapping
 
 import msgpack
 import numpy as np

@@ -22,6 +22,7 @@ single program launch per step.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Callable
 
@@ -132,6 +133,8 @@ class ShardedTrainer:
         embed_module: Module | None = None,
         head_module: Module | None = None,
         loss_reduction: str = "uniform_mean",
+        tracer=None,
+        metrics=None,
     ):
         """``loss_reduction`` declares how loss_fn reduces over the batch:
 
@@ -147,6 +150,23 @@ class ShardedTrainer:
         self.cfg = cfg
         self.parts = parts
         self.loss_fn = loss_fn
+        # observability (optional): engine.compile_step / engine.step
+        # spans + step_s series + step_seconds histogram per train_step
+        # dispatch. Per-stage timing inside the single XLA program is the
+        # profiler's job (runtime/profiling.op_breakdown); the schedule-
+        # level skew lives in measure_bubble and — on the socket path —
+        # in the master's stage{i}_fwd_s series (tracing.straggler_report).
+        self.tracer = tracer
+        self.metrics = metrics
+        self._telemetry = None
+        if tracer is not None or metrics is not None:
+            from tensorlink_tpu.runtime.tracing import StepTelemetry
+
+            self._telemetry = StepTelemetry(
+                tracer, metrics, "engine",
+                # num_stages is derived further down — read the mesh here
+                {"stages": mesh.shape["pipe"], "micros": cfg.micro_batches},
+            )
         if loss_reduction not in ("uniform_mean", "batch_normalized"):
             raise ValueError(
                 f"unknown loss_reduction {loss_reduction!r}; declare "
@@ -498,13 +518,20 @@ class ShardedTrainer:
         if self._step_fn is None:
             self._step_fn = jax.jit(self._step, static_argnums=(), donate_argnums=(0,))
         batch = jax.device_put(batch, self._batch_sh)
+        # telemetry keys on (shape, dtype, rng-variant) — a retrace is
+        # labeled compile_step and kept out of the latency histogram
+        cm = (
+            self._telemetry.step(batch, rng)
+            if self._telemetry is not None
+            else contextlib.nullcontext()
+        )
         # rng=None traces the step-derived-rng variant; an explicit key
         # traces a second variant — both cached by jit.
         # set_mesh makes the trainer's mesh ambient during tracing so
         # modules that pin intermediate shardings on Auto axes (MoE's
         # all_to_all dispatch, nn/moe.py) can engage; everything else is
         # unaffected (all axes here are Auto outside the pipe shard_map).
-        with jax.set_mesh(self.mesh):
+        with cm, jax.set_mesh(self.mesh):
             return self._step_fn(state, batch, rng)
 
     def eval_fn(self, state: TrainState, batch):

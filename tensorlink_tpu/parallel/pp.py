@@ -25,7 +25,6 @@ inputs and TP-sharded stage weights pass straight through.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Any, Callable
 
 import jax

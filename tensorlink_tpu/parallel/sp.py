@@ -18,7 +18,6 @@ triangular mask, and blocks behind are unmasked. Differentiable end-to-end
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -32,13 +32,12 @@ hides the activation/weight basis; it is not cryptographic secrecy
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tensorlink_tpu.nn.module import Module, Sequential
+from tensorlink_tpu.nn.module import Sequential
 from tensorlink_tpu.nn.layers import Dense
 
 

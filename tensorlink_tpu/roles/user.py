@@ -12,7 +12,6 @@ drives pipelined micro-batch training over typed FORWARD/BACKWARD messages
 from __future__ import annotations
 
 import asyncio
-import math
 import time
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -310,29 +309,39 @@ class DistributedJob:
             key, expected=exit_st.peer.node_id,
             members={st.peer.node_id for st in chain},
         )
+        t0 = time.perf_counter()
         try:
-            ack = await self.user.request(
-                entry.peer,
-                {
-                    "type": "RELAY_BACKWARD" if backward else "RELAY_FORWARD",
-                    "job_id": self.job.job_id,
-                    "stage": entry.index,
-                    "step": step,
-                    "micro": micro,
-                    "fence": self._fence,
-                    "origin": self.user.node_id,
-                    "route": [placement_wire(st) for st in order[1:]],
-                    "train": self._train_flag,
-                    "infer": infer,
-                    "data": pack_arrays({arr_key: np.asarray(arr)}),
-                },
-                timeout=60.0,
-            )
-            if ack.get("type") != "RELAY_ACCEPTED":
-                raise RuntimeError(
-                    f"stage {entry.index} relay rejected: {ack}"
+            # one span for the whole chain traversal (the per-stage split
+            # lives in each worker's stageN spans, stitched by _trace)
+            with self.user.tracer.span(
+                f"relay.{'bwd' if backward else 'fwd'}",
+                {"step": step, "micro": micro, "stages": len(chain)},
+            ):
+                ack = await self.user.request(
+                    entry.peer,
+                    {
+                        "type": "RELAY_BACKWARD" if backward else "RELAY_FORWARD",
+                        "job_id": self.job.job_id,
+                        "stage": entry.index,
+                        "step": step,
+                        "micro": micro,
+                        "fence": self._fence,
+                        "origin": self.user.node_id,
+                        "route": [placement_wire(st) for st in order[1:]],
+                        "train": self._train_flag,
+                        "infer": infer,
+                        "data": pack_arrays({arr_key: np.asarray(arr)}),
+                    },
+                    timeout=60.0,
                 )
-            blob = await asyncio.wait_for(fut, timeout=60.0 * len(chain))
+                if ack.get("type") != "RELAY_ACCEPTED":
+                    raise RuntimeError(
+                        f"stage {entry.index} relay rejected: {ack}"
+                    )
+                blob = await asyncio.wait_for(fut, timeout=60.0 * len(chain))
+            self.user.metrics.observe(
+                f"relay_{kind}_s", time.perf_counter() - t0
+            )
             return unpack_arrays(blob)[arr_key]
         finally:
             self.user.drop_relay_waiter(key)
@@ -348,23 +357,33 @@ class DistributedJob:
         for st in chain:
             if self.plan is not None:
                 x = self.plan.forward_in(st.index, x)
-            resp = await self.user.request(
-                st.peer,
-                {
-                    "type": "FORWARD",
-                    "job_id": self.job.job_id,
-                    "stage": st.index,
-                    "step": step,
-                    "micro": micro,
-                    "fence": self._fence,
-                    "train": self._train_flag,
-                    "infer": infer,
-                    "data": pack_arrays({"x": np.asarray(x)}),
-                },
-                timeout=60.0,
-            )
+            # per-(stage, micro) span + rolling series: the master-side
+            # observation (compute + wire + queue) that feeds
+            # tracing.straggler_report — surfaced at this node's /node
+            t0 = time.perf_counter()
+            with self.user.tracer.span(
+                f"stage{st.index}.fwd.rpc", {"step": step, "micro": micro}
+            ):
+                resp = await self.user.request(
+                    st.peer,
+                    {
+                        "type": "FORWARD",
+                        "job_id": self.job.job_id,
+                        "stage": st.index,
+                        "step": step,
+                        "micro": micro,
+                        "fence": self._fence,
+                        "train": self._train_flag,
+                        "infer": infer,
+                        "data": pack_arrays({"x": np.asarray(x)}),
+                    },
+                    timeout=60.0,
+                )
             if resp.get("type") != "ACTIVATION":
                 raise RuntimeError(f"stage {st.index} forward failed: {resp}")
+            self.user.metrics.observe(
+                f"stage{st.index}_fwd_s", time.perf_counter() - t0
+            )
             x = unpack_arrays(resp["data"])["x"]
             if self.plan is not None:
                 x = self.plan.forward_out(st.index, x)
@@ -377,21 +396,28 @@ class DistributedJob:
         for st in reversed(chain):
             if self.plan is not None:
                 g = self.plan.backward_in(st.index, g)
-            resp = await self.user.request(
-                st.peer,
-                {
-                    "type": "BACKWARD",
-                    "job_id": self.job.job_id,
-                    "stage": st.index,
-                    "step": step,
-                    "micro": micro,
-                    "fence": self._fence,
-                    "data": pack_arrays({"g": np.asarray(g)}),
-                },
-                timeout=60.0,
-            )
+            t0 = time.perf_counter()
+            with self.user.tracer.span(
+                f"stage{st.index}.bwd.rpc", {"step": step, "micro": micro}
+            ):
+                resp = await self.user.request(
+                    st.peer,
+                    {
+                        "type": "BACKWARD",
+                        "job_id": self.job.job_id,
+                        "stage": st.index,
+                        "step": step,
+                        "micro": micro,
+                        "fence": self._fence,
+                        "data": pack_arrays({"g": np.asarray(g)}),
+                    },
+                    timeout=60.0,
+                )
             if resp.get("type") != "INPUT_GRAD":
                 raise RuntimeError(f"stage {st.index} backward failed: {resp}")
+            self.user.metrics.observe(
+                f"stage{st.index}_bwd_s", time.perf_counter() - t0
+            )
             g = unpack_arrays(resp["data"])["g"]
             if self.plan is not None:
                 g = self.plan.backward_out(st.index, g)
@@ -506,6 +532,16 @@ class DistributedJob:
         raise AssertionError("unreachable")
 
     async def _try_train_step(self, batch_x, loss_grad_fn) -> float:
+        # root span of the step's trace: every micro's stage RPC — and,
+        # through the _trace envelope, every worker-side span it causes —
+        # stitches under this one trace_id
+        with self.user.tracer.span(
+            "user.train_step",
+            {"step": self.step, "micros": self.job.micro_batches},
+        ):
+            return await self._try_train_step_traced(batch_x, loss_grad_fn)
+
+    async def _try_train_step_traced(self, batch_x, loss_grad_fn) -> float:
         t_start = time.perf_counter()
         m = self.job.micro_batches
         micros = np.array_split(np.asarray(batch_x), m)

@@ -291,14 +291,25 @@ class ValidatorNode(Node):
         if peer.reputation <= 0.0:
             return {"type": "DECLINE_JOB", "reason": "reputation"}
 
-        stats = await self._poll_worker_stats()
+        # spans nest under the rpc.JOB_REQ dispatch span when the user is
+        # tracing, so placement latency splits into poll vs recruit on
+        # the same cross-node timeline
+        with self.tracer.span(
+            "validator.poll_stats", {"job_id": job.job_id[:16]}
+        ):
+            stats = await self._poll_worker_stats()
         taken: set[str] = set()
         placements: list[dict | None] = []
-        for r in range(job.dp_factor):
-            for i in range(job.n_stages):  # sequential: taken-set must grow
-                placements.append(
-                    await self._recruit_stage(job, i, stats, taken, replica=r)
-                )
+        with self.tracer.span(
+            "validator.recruit",
+            {"job_id": job.job_id[:16], "stages": job.n_stages,
+             "dp": job.dp_factor},
+        ):
+            for r in range(job.dp_factor):
+                for i in range(job.n_stages):  # sequential: taken-set must grow
+                    placements.append(
+                        await self._recruit_stage(job, i, stats, taken, replica=r)
+                    )
         if any(p is None for p in placements):
             return {
                 "type": "DECLINE_JOB",
@@ -483,12 +494,16 @@ class ValidatorNode(Node):
         # can never race a live optimizer step (review finding: the old
         # two-request flow was inconclusive for every busy honest worker,
         # and three in a row slashed them to zero)
-        proof = await self.request(
-            peer,
-            {**base, "type": "POL_CHALLENGE", "seed": seed,
-             "shape": list(in_shape), "include_params": True},
-            timeout=60.0,
-        )
+        with self.tracer.span(
+            "validator.audit_stage",
+            {"job_id": job_id[:16], "stage": stage_index, "worker": wid[:8]},
+        ):
+            proof = await self.request(
+                peer,
+                {**base, "type": "POL_CHALLENGE", "seed": seed,
+                 "shape": list(in_shape), "include_params": True},
+                timeout=60.0,
+            )
         record: dict[str, Any] = {
             "job_id": job_id, "stage": stage_index, "worker": wid,
             "seed": seed, "at": time.time(),

@@ -27,7 +27,6 @@ import numpy as np
 
 from tensorlink_tpu.config import NodeConfig
 from tensorlink_tpu.nn.module import Module, module_from_config
-from tensorlink_tpu.p2p.dht import PeerInfo
 from tensorlink_tpu.p2p.node import Node, Peer
 from tensorlink_tpu.p2p.serialization import (
     pack_arrays,
@@ -526,6 +525,13 @@ class WorkerNode(Node):
         self.on("UNLOAD", self._h_unload)
         self.register_stream_kind("module_spec", self._stream_module_spec)
 
+    def _observe_stage(self, stage: int, kind: str, dt: float) -> None:
+        """Per-stage local compute time: the stage{i}_fwd_s/_bwd_s series
+        tracing.straggler_report reads (this worker's own /node view),
+        plus a latency histogram for /metrics?format=prom."""
+        self.metrics.observe(f"stage{stage}_{kind}_s", dt)
+        self.metrics.observe_hist(f"stage_{kind}_seconds", dt)
+
     def capacity_bytes(self) -> int:
         dev_free = 0
         for d in local_device_info():
@@ -852,14 +858,22 @@ class WorkerNode(Node):
         if int(msg.get("fence", 0)) < runner.fence:
             return {"type": "ERROR", "error": "stale fence (aborted step)"}
         x = unpack_arrays(msg["data"])["x"]
+        t0 = time.perf_counter()
         try:
-            out = await asyncio.to_thread(
-                runner.forward, int(msg["step"]), int(msg["micro"]), x,
-                int(msg.get("fence", 0)), bool(msg.get("train", False)),
-                not bool(msg.get("infer", False)),
-            )
+            # child of the rpc.FORWARD dispatch span when the master is
+            # tracing: isolates this stage's compute from wire+queue time
+            with self.tracer.span(
+                f"stage{runner.stage_index}.fwd",
+                {"step": int(msg["step"]), "micro": int(msg["micro"])},
+            ):
+                out = await asyncio.to_thread(
+                    runner.forward, int(msg["step"]), int(msg["micro"]), x,
+                    int(msg.get("fence", 0)), bool(msg.get("train", False)),
+                    not bool(msg.get("infer", False)),
+                )
         except StaleFenceError:
             return {"type": "ERROR", "error": "stale fence (aborted step)"}
+        self._observe_stage(runner.stage_index, "fwd", time.perf_counter() - t0)
         reply = {
             "type": "ACTIVATION",
             "job_id": msg["job_id"],
@@ -877,13 +891,19 @@ class WorkerNode(Node):
         if int(msg.get("fence", 0)) < runner.fence:
             return {"type": "ERROR", "error": "stale fence (aborted step)"}
         g = unpack_arrays(msg["data"])["g"]
+        t0 = time.perf_counter()
         try:
-            gx = await asyncio.to_thread(
-                runner.backward, int(msg["step"]), int(msg["micro"]), g,
-                int(msg.get("fence", 0)),
-            )
+            with self.tracer.span(
+                f"stage{runner.stage_index}.bwd",
+                {"step": int(msg["step"]), "micro": int(msg["micro"])},
+            ):
+                gx = await asyncio.to_thread(
+                    runner.backward, int(msg["step"]), int(msg["micro"]), g,
+                    int(msg.get("fence", 0)),
+                )
         except StaleFenceError:
             return {"type": "ERROR", "error": "stale fence (aborted step)"}
+        self._observe_stage(runner.stage_index, "bwd", time.perf_counter() - t0)
         return {
             "type": "INPUT_GRAD",
             "job_id": msg["job_id"],
@@ -948,6 +968,7 @@ class WorkerNode(Node):
         return the final result to the origin."""
         arr_key = "g" if backward else "x"
         kind = "grad" if backward else "act"
+        t0 = time.perf_counter()
         try:
             # unpack inside the try: a malformed hop payload must flow to
             # the master as RELAY_ERROR, not stall its waiter to timeout
@@ -957,15 +978,24 @@ class WorkerNode(Node):
                 not bool(msg.get("infer", False)),
             )
             fn = runner.backward if backward else runner.forward
-            out = await asyncio.to_thread(
-                fn, int(msg["step"]), int(msg["micro"]), data,
-                int(msg.get("fence", 0)), *extra,
-            )
+            with self.tracer.span(
+                f"stage{runner.stage_index}.{'bwd' if backward else 'fwd'}",
+                {"step": int(msg["step"]), "micro": int(msg["micro"]),
+                 "relay": True},
+            ):
+                out = await asyncio.to_thread(
+                    fn, int(msg["step"]), int(msg["micro"]), data,
+                    int(msg.get("fence", 0)), *extra,
+                )
         except StaleFenceError:
             return  # aborted step attempt: drop silently
         except Exception as e:  # noqa: BLE001 — surfaced to the master
             await self._relay_error(dict(msg, kind=kind), f"stage {runner.stage_index}: {e}")
             return
+        self._observe_stage(
+            runner.stage_index, "bwd" if backward else "fwd",
+            time.perf_counter() - t0,
+        )
         route = list(msg.get("route") or [])
         blob = pack_arrays({arr_key: np.asarray(out)})
         if route:

@@ -93,7 +93,11 @@ class CheckpointManager:
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
         if target is None:
-            return self._mgr.restore(int(step))
+            # explicit StandardRestore: a FRESH manager (job re-attach
+            # after a master death) has no handler registered for the
+            # saved "default" item, and argless restore() raises KeyError
+            # on current orbax instead of inferring one
+            return self._mgr.restore(int(step), args=ocp.args.StandardRestore())
         abstract = jax.tree.map(_abstractify, target)
         return self._mgr.restore(
             int(step), args=ocp.args.StandardRestore(abstract)

@@ -7,10 +7,15 @@ node can serve status: a dependency-free asyncio HTTP/1.1 responder with
 
     GET /node     -> node.status()               (reference parity)
     GET /metrics  -> node.metrics snapshot       (loss, throughput, ...)
+                     ?format=prom -> Prometheus text exposition
     GET /jobs     -> validator job table         (when the node has one)
+    GET /spans    -> tracer span buffer as Chrome-trace JSON
+                     (open in Perfetto / chrome://tracing)
     GET /healthz  -> {"ok": true}
 
-JSON only, read only, bound to the node's host.
+Read only, bound to the node's host; HEAD is answered with headers only.
+Every response carries ``Cache-Control: no-store`` — a proxy caching
+``/metrics`` would serve stale telemetry silently.
 """
 
 from __future__ import annotations
@@ -18,13 +23,17 @@ from __future__ import annotations
 import asyncio
 import json
 from typing import Any, Callable
+from urllib.parse import parse_qsl
 
 
 class StatusServer:
-    def __init__(self, node: Any, host: str, port: int):
+    def __init__(
+        self, node: Any, host: str, port: int, timeout_s: float = 5.0
+    ):
         self.node = node
         self.host = host
         self.port = port
+        self.timeout_s = timeout_s
         self._server: asyncio.AbstractServer | None = None
 
     @property
@@ -33,17 +42,29 @@ class StatusServer:
             return None
         return self._server.sockets[0].getsockname()[1]
 
-    def _routes(self) -> dict[str, Callable[[], Any]]:
+    def _routes(self) -> dict[str, Callable[[dict], Any]]:
+        """path -> handler(query_params) -> body. A handler returns a
+        JSON-serializable object, or ``(content_type, text)`` for
+        non-JSON payloads (the Prometheus exposition)."""
         node = self.node
-        routes: dict[str, Callable[[], Any]] = {
-            "/healthz": lambda: {"ok": True},
-            "/node": node.status,
+        routes: dict[str, Callable[[dict], Any]] = {
+            "/healthz": lambda q: {"ok": True},
+            "/node": lambda q: node.status(),
         }
         metrics = getattr(node, "metrics", None)
         if metrics is not None:
-            routes["/metrics"] = metrics.snapshot
+
+            def metrics_route(q: dict):
+                if q.get("format") == "prom" and hasattr(metrics, "to_prometheus"):
+                    return ("text/plain; version=0.0.4", metrics.to_prometheus())
+                return metrics.snapshot()
+
+            routes["/metrics"] = metrics_route
+        tracer = getattr(node, "tracer", None)
+        if tracer is not None:
+            routes["/spans"] = lambda q: tracer.to_chrome_trace()
         if hasattr(node, "jobs"):
-            routes["/jobs"] = lambda: {
+            routes["/jobs"] = lambda q: {
                 jid: {
                     "author": j.author,
                     "stages": j.n_stages,
@@ -56,44 +77,65 @@ class StatusServer:
             }
         return routes
 
+    @staticmethod
+    async def _read_request(reader: asyncio.StreamReader) -> list[str]:
+        request = await reader.readline()
+        parts = request.decode("latin1").split()
+        # drain headers
+        while True:
+            line = await reader.readline()
+            if line in (b"\r\n", b"\n", b""):
+                break
+        return parts
+
     async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         try:
             # one overall deadline for the whole request (a per-line
             # timeout would let a client trickle header lines and pin a
-            # task forever — review finding)
-            async with asyncio.timeout(5.0):
-                request = await reader.readline()
-                parts = request.decode("latin1").split()
-                path = parts[1] if len(parts) >= 2 else "/"
-                # drain headers
-                while True:
-                    line = await reader.readline()
-                    if line in (b"\r\n", b"\n", b""):
-                        break
-            handler = self._routes().get(path.split("?")[0])
-            if parts and parts[0] != "GET":
+            # task forever — review finding). wait_for, not the 3.11-only
+            # asyncio.timeout: the runtime floor is 3.10 (pyproject).
+            parts = await asyncio.wait_for(
+                self._read_request(reader), self.timeout_s
+            )
+            target = parts[1] if len(parts) >= 2 else "/"
+            method = parts[0] if parts else ""
+            path, _, rawq = target.partition("?")
+            query = dict(parse_qsl(rawq))
+            handler = self._routes().get(path)
+            if method not in ("GET", "HEAD"):
                 status, body = "405 Method Not Allowed", {"error": "GET only"}
             elif handler is None:
                 status, body = "404 Not Found", {"error": f"no route {path}"}
             else:
                 try:
-                    status, body = "200 OK", handler()
+                    status, body = "200 OK", handler(query)
                 except Exception as e:  # noqa: BLE001 — must answer 500
                     status, body = "500 Internal Server Error", {
                         "error": type(e).__name__
                     }
-            payload = json.dumps(body, default=str).encode()
+            if isinstance(body, tuple):  # (content_type, text) non-JSON
+                ctype, payload = body[0], body[1].encode()
+            else:
+                ctype = "application/json"
+                payload = json.dumps(body, default=str).encode()
             # no CORS header: a wildcard ACAO would let any web page the
             # operator's browser visits read this unauthenticated endpoint
             # cross-origin, defeating the loopback-bind default
-            writer.write(
+            head = (
                 f"HTTP/1.1 {status}\r\n"
-                f"Content-Type: application/json\r\n"
+                f"Content-Type: {ctype}\r\n"
                 f"Content-Length: {len(payload)}\r\n"
-                f"Connection: close\r\n\r\n".encode() + payload
-            )
+                f"Cache-Control: no-store\r\n"
+                f"Connection: close\r\n\r\n"
+            ).encode()
+            # HEAD gets the same status line + headers (including the
+            # Content-Length a GET would produce) and no body
+            writer.write(head if method == "HEAD" else head + payload)
             await writer.drain()
-        except (asyncio.TimeoutError, ConnectionError, OSError):
+        except (asyncio.TimeoutError, ConnectionError, OSError, ValueError):
+            # ValueError: StreamReader.readline raises it for a request
+            # line beyond the 64 KiB reader limit — drop the connection
+            # rather than kill the handler task with a traceback
             pass
         finally:
             try:

@@ -9,8 +9,8 @@ optimizer state.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
-from functools import partial
 from typing import Any, Callable
 
 import jax
@@ -70,10 +70,24 @@ class Trainer:
         cfg: TrainConfig = TrainConfig(),
         optimizer: Optimizer | None = None,
         donate: bool = True,
+        tracer=None,
+        metrics=None,
     ):
         self.module = module
         self.loss_fn = loss_fn
         self.cfg = cfg
+        # observability (runtime/tracing.Tracer + runtime/metrics.Metrics,
+        # both optional): train_step emits trainer.compile_step /
+        # trainer.step spans and step_s / step_seconds metrics; wrap the
+        # batch fetch in data_span() to see input-pipeline stalls on the
+        # same timeline
+        self.tracer = tracer
+        self.metrics = metrics
+        self._telemetry = None
+        if tracer is not None or metrics is not None:
+            from tensorlink_tpu.runtime.tracing import StepTelemetry
+
+            self._telemetry = StepTelemetry(tracer, metrics, "trainer")
         if cfg.fsdp:
             # same convention as the train_only guard: a mode this class
             # cannot honor must fail loudly, not run silently replicated
@@ -171,9 +185,20 @@ class Trainer:
     def _eval(self, params, batch, rng):
         return self._loss_for_grad(params, batch, rng)
 
+    # -- observability ---------------------------------------------------
+    def data_span(self):
+        """Wrap the batch fetch: a ``trainer.data`` span + ``data_s``
+        series, so input-pipeline stalls show on the step timeline."""
+        if self._telemetry is None:
+            return contextlib.nullcontext()
+        return self._telemetry.data()
+
     # -- public ----------------------------------------------------------
     def train_step(self, state: TrainState, batch, rng):
-        return self._train_step(state, batch, rng)
+        if self._telemetry is None:
+            return self._train_step(state, batch, rng)
+        with self._telemetry.step(batch, rng):
+            return self._train_step(state, batch, rng)
 
     def eval_loss(self, state: TrainState, batch, rng=None):
         return self._eval_step(state.params, batch, rng)

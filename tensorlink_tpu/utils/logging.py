@@ -15,7 +15,23 @@ import time
 _CONFIGURED = False
 
 
+# Attributes every LogRecord carries (plus the two the logging module
+# adds after construction): anything else on the record arrived via
+# ``extra={...}`` and belongs in the JSON payload.
+_RECORD_DEFAULTS = frozenset(vars(logging.makeLogRecord({}))) | {
+    "message",
+    "asctime",
+    "taskName",  # added by 3.12 asyncio logging
+}
+
+
 class JsonFormatter(logging.Formatter):
+    """One JSON object per record. ``extra={...}`` fields are included
+    (the stdlib stores them as record attributes; dropping them silently
+    was the round-0 behavior), and when a tracing span is active the
+    record is stamped with its trace_id/span_id so logs join traces —
+    grep a trace id across node logs and the /spans timeline."""
+
     def format(self, record: logging.LogRecord) -> str:
         out = {
             "ts": time.time(),
@@ -23,9 +39,23 @@ class JsonFormatter(logging.Formatter):
             "logger": record.name,
             "msg": record.getMessage(),
         }
+        for k, v in record.__dict__.items():
+            if k not in _RECORD_DEFAULTS and not k.startswith("_"):
+                out.setdefault(k, v)
+        # only consult the tracer if its module is ALREADY loaded: no
+        # span can be active otherwise, and importing it here would drag
+        # the runtime package (and jax) into jax-free logging consumers
+        tracing = sys.modules.get("tensorlink_tpu.runtime.tracing")
+        if tracing is not None:
+            span = tracing.current_span()
+            if span is not None:
+                out["trace_id"] = span.trace_id
+                out["span_id"] = span.span_id
         if record.exc_info:
             out["exc"] = self.formatException(record.exc_info)
-        return json.dumps(out)
+        # default=str: extras are arbitrary objects; a log line must
+        # never raise from serialization
+        return json.dumps(out, default=str)
 
 
 def get_logger(

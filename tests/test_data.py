@@ -2,7 +2,6 @@
 device prefetch (the reference has none — plain Python loops,
 tests/ml/test_full_train.py:56-175)."""
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest

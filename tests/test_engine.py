@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from tensorlink_tpu.config import MeshConfig, TrainConfig
-from tensorlink_tpu.models.bert import Bert, BertClassifier, BertConfig, bert_pipeline_parts
+from tensorlink_tpu.models.bert import BertClassifier, BertConfig, bert_pipeline_parts
 from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config
 from tensorlink_tpu.parallel.engine import ShardedTrainer
 from tensorlink_tpu.runtime.mesh import make_mesh

@@ -2,7 +2,6 @@
 stage TP-sharded by the module's own PartitionSpecs (SURVEY §7.2,
 VERDICT missing #1 — round 2's StageRunner was single-device jit)."""
 
-import asyncio
 
 import jax
 import jax.numpy as jnp

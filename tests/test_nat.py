@@ -7,7 +7,6 @@ cover the same byte path a consumer router sees (reference capability:
 miniupnpc mapping at node start, src/p2p/smart_node.py:787-816).
 """
 
-import asyncio
 import socket
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer

@@ -7,7 +7,7 @@ import pytest
 
 from tensorlink_tpu.config import NodeConfig
 from tensorlink_tpu.p2p.crypto import Identity
-from tensorlink_tpu.p2p.dht import DHT, PeerInfo, RoutingTable, xor_distance
+from tensorlink_tpu.p2p.dht import DHT, PeerInfo, RoutingTable
 from tensorlink_tpu.p2p.node import Node
 from tensorlink_tpu.p2p.serialization import (
     decode_message,

@@ -2,7 +2,6 @@
 workers compute on rotated activations/weights; the master's secret
 rotations make the composition exact."""
 
-import asyncio
 
 import jax
 import jax.numpy as jnp

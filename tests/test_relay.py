@@ -8,7 +8,6 @@ the two data planes, routing authorization, DP chains, and that elastic
 recovery still works when the data plane is worker-to-worker.
 """
 
-import asyncio
 
 import jax
 import jax.numpy as jnp
