@@ -1,0 +1,43 @@
+"""tlint: dependency-free AST static analysis for this codebase's bug classes.
+
+The reference implementation's defect catalog (SURVEY.md §2.9) is dominated
+by statically detectable failures: handlers for messages nobody sends, calls
+to methods that exist nowhere, shared state mutated across concurrent paths,
+and host syncs silently serializing jitted code. ``tensorlink_tpu.analysis``
+is a purpose-built linter for exactly those classes — four checker families
+over a shared package index:
+
+- **jit hygiene** (``TL0xx``, `jit_hygiene.py`): host syncs, state mutation,
+  and retrace hazards inside ``jax.jit``/``pjit``/``shard_map``/``lax`` loop
+  bodies.
+- **asyncio safety** (``TL1xx``, `async_safety.py`): blocking calls inside
+  ``async def`` and read-modify-write of shared attributes across ``await``.
+- **RPC schema** (``TL2xx``, `rpc_schema.py`): cross-file consistency of the
+  p2p envelope — every sent message type has a registered handler and every
+  registered handler has a sender.
+- **API existence** (``TL3xx``, `api_exists.py`): ``self.method()`` and
+  ``module.func()`` calls that resolve to nothing.
+
+Run ``python -m tensorlink_tpu.analysis tensorlink_tpu/`` (or the ``tlint``
+console script). Accepted findings live in a committed baseline
+(``tlint.baseline.json``) so CI fails only on regressions; line-level
+``# tlint: disable=TLxxx`` comments suppress single sites.
+"""
+
+from tensorlink_tpu.analysis.core import (
+    ALL_CHECKERS,
+    Finding,
+    PackageIndex,
+    load_baseline,
+    rule_explanation,
+    run_analysis,
+)
+
+__all__ = [
+    "ALL_CHECKERS",
+    "Finding",
+    "PackageIndex",
+    "load_baseline",
+    "rule_explanation",
+    "run_analysis",
+]

@@ -1,0 +1,7 @@
+"""`python -m tensorlink_tpu.analysis` entry point."""
+
+import sys
+
+from tensorlink_tpu.analysis.cli import main
+
+sys.exit(main())

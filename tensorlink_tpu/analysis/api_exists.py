@@ -1,0 +1,292 @@
+"""API existence: attribute calls that resolve to nothing in the package.
+
+The reference's defect catalog includes handlers calling methods that
+exist nowhere in the tree (survey §2.9) — Python happily imports such
+code and only fails at the call site, often in a rarely-exercised error
+path. This checker resolves ``self.method()`` calls against the class's
+full surface (methods, class vars, dataclass fields, every ``self.x =``
+in any method, package-resolvable base classes) and ``module.func()``
+calls against the imported module's top level.
+
+Classes with dynamic surfaces are skipped outright: any ``__getattr__``/
+``__setattr__``, any ``setattr(self, ...)``, or an unresolvable non-
+allowlisted base makes the static surface unknowable. Precision over
+recall — a finding from this checker should be a real missing symbol.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tensorlink_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    PackageIndex,
+    checker,
+)
+
+_RULES = {
+    "TL301": (
+        "Call to a `self.` method that exists nowhere on the class.\n\n"
+        "The name is not a method, property, class var, dataclass field,\n"
+        "or `self.x =` assignment on the class or any package-resolvable\n"
+        "base — the call raises AttributeError when (if ever) reached.\n"
+        "Typically a rename that missed a call site or an error path that\n"
+        "was never run."
+    ),
+    "TL302": (
+        "Call to a module attribute the module does not define.\n\n"
+        "`mod.func()` where the imported package module has no top-level\n"
+        "`func`: raises AttributeError at call time. Usually a stale name\n"
+        "after a refactor."
+    ),
+}
+
+# external bases whose attribute surface adds nothing a subclass would
+# call as `self.x()` beyond dunders the checker never flags
+_INERT_BASES = {
+    "object",
+    "abc.ABC",
+    "ABC",
+    "Exception",
+    "RuntimeError",
+    "ValueError",
+    "TypeError",
+    "KeyError",
+    "BaseException",
+}
+
+
+@dataclass
+class _ClassSurface:
+    name: str
+    module: str  # dotted module
+    bases: list[str] = field(default_factory=list)  # resolved dotted or raw
+    members: set[str] = field(default_factory=set)
+    dynamic: bool = False  # __getattr__/setattr(self,...)/unknown base
+
+
+def _walk_own(cls: ast.ClassDef):
+    """Walk a class body without descending into NESTED classes — a class
+    defined inside a method (the mock server's request Handler) has its
+    own `self`, and attributing its calls/assignments to the outer class
+    produces both false members and false missing-method findings."""
+    stack: list[ast.AST] = list(cls.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                continue
+            stack.append(child)
+
+
+def _base_key(mod: ModuleInfo, node: ast.expr) -> str | None:
+    """Resolve a base-class expression to 'pkg.module.Class' when the name
+    came in through an import, else the raw dotted text."""
+    from tensorlink_tpu.analysis.core import dotted_name
+
+    name = dotted_name(node)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    if head in mod.from_imports:
+        src, orig = mod.from_imports[head]
+        return f"{src}.{orig}" + (f".{rest}" if rest else "")
+    if head in mod.imports:
+        return f"{mod.imports[head]}" + (f".{rest}" if rest else "")
+    # same-module class reference
+    return f"{mod.dotted}.{name}" if rest == "" else name
+
+
+def _class_surface(mod: ModuleInfo, cls: ast.ClassDef) -> _ClassSurface:
+    surf = _ClassSurface(name=cls.name, module=mod.dotted)
+    for b in cls.bases:
+        key = _base_key(mod, b)
+        surf.bases.append(key if key is not None else "<expr>")
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            surf.members.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    surf.members.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            surf.members.add(node.target.id)  # dataclass fields
+    for node in _walk_own(cls):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in ("__getattr__", "__getattribute__"):
+                surf.dynamic = True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id == "setattr":
+                surf.dynamic = True
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                surf.members.add(t.attr)
+    return surf
+
+
+def _module_toplevel(mod: ModuleInfo) -> set[str]:
+    names: set[str] = set()
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            names.add(node.target.id)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                names.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name != "*":
+                    names.add(a.asname or a.name)
+        elif isinstance(node, (ast.If, ast.Try)):
+            # conditionally-defined names (try/except import fallbacks,
+            # platform gates) still exist on the happy path
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                    names.add(sub.name)
+                elif isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            names.add(t.id)
+                elif isinstance(sub, ast.Import):
+                    for a in sub.names:
+                        names.add((a.asname or a.name).split(".")[0])
+                elif isinstance(sub, ast.ImportFrom):
+                    for a in sub.names:
+                        if a.name != "*":
+                            names.add(a.asname or a.name)
+    return names
+
+
+def _module_dynamic(mod: ModuleInfo) -> bool:
+    return any(
+        isinstance(n, ast.FunctionDef) and n.name == "__getattr__"
+        for n in mod.tree.body
+    )
+
+
+def _resolve_surface(
+    key: str,
+    surfaces: dict[str, _ClassSurface],
+    cache: dict[str, tuple[set[str], bool] | None],
+) -> tuple[set[str], bool] | None:
+    """Full member set of class `key` incl. bases; None if unknowable."""
+    if key in cache:
+        return cache[key]
+    surf = surfaces.get(key)
+    if surf is None:
+        return None
+    cache[key] = None  # cycle guard
+    members = set(surf.members)
+    ok = not surf.dynamic
+    for b in surf.bases:
+        if b.split(".")[-1] in _INERT_BASES or b in _INERT_BASES:
+            continue
+        base = _resolve_surface(b, surfaces, cache)
+        if base is None:
+            ok = False
+            break
+        bm, bok = base
+        members |= bm
+        ok = ok and bok
+    cache[key] = (members, ok)
+    return cache[key]
+
+
+def _package_prefix(index: PackageIndex) -> str | None:
+    for m in index.modules:
+        if m.dotted:
+            return m.dotted.split(".")[0]
+    return None
+
+
+@checker("api_exists", _RULES)
+def check(index: PackageIndex) -> list[Finding]:
+    surfaces: dict[str, _ClassSurface] = {}
+    for mod in index.modules:
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                surf = _class_surface(mod, node)
+                surfaces[f"{mod.dotted}.{node.name}"] = surf
+    cache: dict[str, tuple[set[str], bool] | None] = {}
+    out: list[Finding] = []
+    prefix = _package_prefix(index)
+
+    for mod in index.modules:
+        for node in mod.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            resolved = _resolve_surface(
+                f"{mod.dotted}.{node.name}", surfaces, cache
+            )
+            if resolved is None:
+                continue
+            members, complete = resolved
+            if not complete:
+                continue  # dynamic surface somewhere in the MRO: skip
+            for sub in _walk_own(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = sub.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "self"
+                    and fn.attr not in members
+                    and not (fn.attr.startswith("__") and fn.attr.endswith("__"))
+                ):
+                    out.append(Finding(
+                        "TL301", mod.path, sub.lineno,
+                        f"`self.{fn.attr}()` in class `{node.name}`: no such "
+                        "method/attribute on the class or its bases",
+                        symbol=f"{node.name}.{fn.attr}",
+                    ))
+
+        # module attribute calls: mod_alias.func(...)
+        for sub in ast.walk(mod.tree):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if not (
+                isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
+            ):
+                continue
+            alias = fn.value.id
+            dotted = None
+            if alias in mod.from_imports:
+                src, orig = mod.from_imports[alias]
+                dotted = f"{src}.{orig}"
+            elif alias in mod.imports:
+                dotted = mod.imports[alias]
+            if dotted is None or prefix is None:
+                continue
+            if not dotted.startswith(prefix + ".") and dotted != prefix:
+                continue  # external modules: unknown surface
+            target_mod = index.by_dotted.get(dotted)
+            if target_mod is None or _module_dynamic(target_mod):
+                continue
+            if fn.attr not in _module_toplevel(target_mod):
+                out.append(Finding(
+                    "TL302", mod.path, sub.lineno,
+                    f"`{alias}.{fn.attr}()` resolves to module "
+                    f"`{dotted}` which defines no `{fn.attr}`",
+                    symbol=f"{dotted}.{fn.attr}",
+                ))
+    return out

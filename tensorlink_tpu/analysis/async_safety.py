@@ -1,0 +1,231 @@
+"""Asyncio safety: blocking calls in coroutines and await-straddling races.
+
+The p2p roles run everything on one event loop; a single synchronous
+sleep/IO call freezes heartbeats, handshakes, and every peer's dispatch
+for its duration. And because handlers interleave at every ``await``, a
+read-modify-write of shared ``self.`` state that straddles an await is the
+exact race shape that bites ``roles/`` and ``p2p/node.py`` — two handlers
+both observe the stale value, both write, one update is lost.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tensorlink_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    PackageIndex,
+    checker,
+    dotted_name,
+    resolve_call,
+)
+
+_RULES = {
+    "TL101": (
+        "Blocking call inside `async def`.\n\n"
+        "`time.sleep`, synchronous socket/HTTP/subprocess calls, and file\n"
+        "IO block the whole event loop: every peer's heartbeat, handshake\n"
+        "and dispatch stalls until the call returns. Use the asyncio\n"
+        "equivalent (`asyncio.sleep`, streams) or push the call off-loop\n"
+        "with `asyncio.to_thread(fn, ...)`."
+    ),
+    "TL102": (
+        "Read-modify-write of shared `self.` state straddling an `await`.\n\n"
+        "Handlers interleave at every await: checking `self.x` and then\n"
+        "writing it after an await lets a concurrent handler observe the\n"
+        "same stale value — the lost-update/double-init race. Recheck the\n"
+        "attribute after the await, or hold an `asyncio.Lock` (`async with\n"
+        "self._lock:`) across the read-modify-write."
+    ),
+    "TL103": (
+        "`asyncio.get_event_loop()` in library code.\n\n"
+        "Deprecated since 3.10 and wrong in threads without a running\n"
+        "loop: it can create a SECOND loop whose futures never resolve.\n"
+        "Use `asyncio.get_running_loop()` inside coroutines."
+    ),
+}
+
+# direct calls that block the loop (module-resolved through import aliases)
+_BLOCKING_CALLS = {
+    "time.sleep": "asyncio.sleep",
+    "subprocess.run": "asyncio.create_subprocess_exec",
+    "subprocess.call": "asyncio.create_subprocess_exec",
+    "subprocess.check_call": "asyncio.create_subprocess_exec",
+    "subprocess.check_output": "asyncio.create_subprocess_exec",
+    "subprocess.Popen": "asyncio.create_subprocess_exec",
+    "socket.create_connection": "asyncio.open_connection",
+    "socket.getaddrinfo": "loop.getaddrinfo",
+    "socket.gethostbyname": "loop.getaddrinfo",
+    "urllib.request.urlopen": "asyncio.to_thread(urlopen, ...)",
+    "requests.get": "asyncio.to_thread",
+    "requests.post": "asyncio.to_thread",
+    "requests.request": "asyncio.to_thread",
+    "os.system": "asyncio.create_subprocess_shell",
+    "open": "asyncio.to_thread(open/read, ...)",
+}
+
+
+def _iter_own_nodes(fn: ast.AST):
+    """Walk a function body WITHOUT descending into nested defs/lambdas —
+    their bodies run on someone else's schedule (often a worker thread via
+    to_thread), so their calls don't block THIS coroutine."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.append(child)
+
+
+def _check_blocking(mod: ModuleInfo, fn: ast.AsyncFunctionDef, out: list):
+    for node in _iter_own_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        target = resolve_call(mod, node.func)
+        alt = _BLOCKING_CALLS.get(target or "")
+        if alt is not None:
+            out.append(Finding(
+                "TL101", mod.path, node.lineno,
+                f"blocking `{dotted_name(node.func)}` in async "
+                f"`{fn.name}` stalls the event loop (use {alt})",
+                symbol=f"{fn.name}.{target}",
+            ))
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _reads_of_self(node: ast.AST) -> set[str]:
+    return {
+        a for sub in ast.walk(node) if (a := _self_attr(sub)) is not None
+    }
+
+
+def _contains_await(node: ast.AST) -> bool:
+    return any(isinstance(sub, ast.Await) for sub in ast.walk(node))
+
+
+def _under_lock(node: ast.AST, parents: list[ast.AST]) -> bool:
+    """Lexically inside `[async] with <something lock-ish>:`?"""
+    for p in parents:
+        if isinstance(p, (ast.With, ast.AsyncWith)):
+            for item in p.items:
+                name = dotted_name(item.context_expr) or ast.dump(
+                    item.context_expr
+                )
+                if "lock" in name.lower():
+                    return True
+    return False
+
+
+def _check_straddle(mod: ModuleInfo, fn: ast.AsyncFunctionDef, out: list):
+    """Two concrete race shapes, kept narrow on purpose (low noise):
+
+    1. check-then-act: `if <reads self.x>:` whose body awaits and then
+       assigns the same `self.x` — double-init/lost-update;
+    2. `self.x = ...await...` / `self.x += await ...` where the value also
+       reads `self.x` — the read and write straddle the await directly.
+    """
+
+    def visit(node: ast.AST, parents: list[ast.AST]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return  # nested scope: analyzed separately if async
+        if isinstance(node, ast.If) and not _under_lock(node, parents):
+            tested = _reads_of_self(node.test)
+            if tested:
+                body_awaits = any(_contains_await(s) for s in node.body)
+                if body_awaits:
+                    await_line = None
+                    for s in node.body:
+                        for sub in ast.walk(s):
+                            if isinstance(sub, ast.Await):
+                                await_line = sub.lineno
+                                break
+                        if await_line is not None:
+                            break
+                    for s in node.body:
+                        for sub in ast.walk(s):
+                            targets = []
+                            if isinstance(sub, ast.Assign):
+                                targets = sub.targets
+                            elif isinstance(sub, ast.AugAssign):
+                                targets = [sub.target]
+                            for t in targets:
+                                attr = _self_attr(t)
+                                # >= : an await in the assignment's OWN
+                                # value still completes before the store,
+                                # so the check-to-write window is open
+                                if (
+                                    attr in tested
+                                    and await_line is not None
+                                    and sub.lineno >= await_line
+                                ):
+                                    out.append(Finding(
+                                        "TL102", mod.path, sub.lineno,
+                                        f"`self.{attr}` checked before an "
+                                        "await and written after it in "
+                                        f"async `{fn.name}` — a concurrent "
+                                        "handler can interleave (lost "
+                                        "update/double init)",
+                                        symbol=f"{fn.name}.self.{attr}",
+                                    ))
+        if isinstance(node, (ast.Assign, ast.AugAssign)) and not _under_lock(
+            node, parents
+        ):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            value = node.value
+            if _contains_await(value):
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    rmw = isinstance(node, ast.AugAssign) or attr in _reads_of_self(value)
+                    if rmw:
+                        out.append(Finding(
+                            "TL102", mod.path, node.lineno,
+                            f"`self.{attr}` read-modify-write spans an "
+                            f"`await` in async `{fn.name}` — the value can "
+                            "be stale when written back",
+                            symbol=f"{fn.name}.self.{attr}=await",
+                        ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, parents + [node])
+
+    for stmt in fn.body:
+        visit(stmt, [])
+
+
+def _check_get_event_loop(mod: ModuleInfo, out: list):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            if resolve_call(mod, node.func) == "asyncio.get_event_loop":
+                out.append(Finding(
+                    "TL103", mod.path, node.lineno,
+                    "`asyncio.get_event_loop()` is deprecated and can bind "
+                    "a dead second loop — use `asyncio.get_running_loop()`",
+                    symbol="asyncio.get_event_loop",
+                ))
+
+
+@checker("async_safety", _RULES)
+def check(index: PackageIndex) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in index.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                _check_blocking(mod, node, out)
+                _check_straddle(mod, node, out)
+        _check_get_event_loop(mod, out)
+    return out

@@ -1,0 +1,151 @@
+"""`tlint` command line: run the checkers, apply the baseline, report.
+
+Exit codes: 0 clean (or every finding baselined/suppressed), 1 findings,
+2 usage error. `--write-baseline` accepts the current findings as the new
+baseline — the triage workflow is: run, read, fix what's real, baseline
+what's accepted, commit the baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tensorlink_tpu.analysis.core import (
+    ALL_CHECKERS,
+    BASELINE_NAME,
+    PackageIndex,
+    all_rules,
+    find_default_baseline,
+    load_baseline,
+    rule_explanation,
+    run_analysis,
+    write_baseline,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tlint",
+        description=(
+            "AST static analysis for JAX retrace/host-sync hazards, "
+            "asyncio races, p2p RPC schema drift, and missing APIs."
+        ),
+    )
+    p.add_argument(
+        "paths", nargs="*", default=["tensorlink_tpu"],
+        help="files or directories to analyze (default: tensorlink_tpu)",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format",
+    )
+    p.add_argument(
+        "--baseline", metavar="FILE", default=None,
+        help=(
+            f"baseline file of accepted fingerprints (default: nearest "
+            f"{BASELINE_NAME} above the first path; 'none' disables)"
+        ),
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="write the current findings to the baseline file and exit 0",
+    )
+    p.add_argument(
+        "--family", action="append", choices=sorted(ALL_CHECKERS) or None,
+        help="run only these checker families (repeatable)",
+    )
+    p.add_argument(
+        "--explain", metavar="RULE",
+        help="print the full explanation for a rule id and exit",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="list every rule id with its one-line summary and exit",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    # importing the families fills the rule/checker registries the parser
+    # and --explain/--list-rules read
+    from tensorlink_tpu.analysis import (  # noqa: F401
+        api_exists,
+        async_safety,
+        jit_hygiene,
+        rpc_schema,
+    )
+
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in sorted(all_rules()):
+            print(f"{rule}  {rule_explanation(rule, first_line=True)}")
+        return 0
+    if args.explain:
+        doc = rule_explanation(args.explain)
+        if not doc:
+            print(f"unknown rule {args.explain}", file=sys.stderr)
+            return 2
+        print(f"{args.explain}: {doc}")
+        return 0
+
+    try:
+        index = PackageIndex.from_paths(args.paths)
+    except (OSError, SyntaxError) as e:
+        print(f"tlint: cannot analyze: {e}", file=sys.stderr)
+        return 2
+    if not index.modules:
+        print("tlint: no python files found", file=sys.stderr)
+        return 2
+
+    findings = run_analysis(index, families=args.family)
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        baseline_path = find_default_baseline(args.paths[0])
+    elif baseline_path == "none":
+        baseline_path = None
+
+    if args.write_baseline:
+        path = baseline_path or BASELINE_NAME
+        write_baseline(path, findings)
+        print(f"tlint: wrote {len(findings)} fingerprints to {path}")
+        return 0
+
+    suppressed: set[str] = set()
+    if baseline_path is not None:
+        try:
+            suppressed = load_baseline(baseline_path)
+        except (OSError, ValueError) as e:
+            print(f"tlint: bad baseline: {e}", file=sys.stderr)
+            return 2
+    fresh = [f for f in findings if f.fingerprint not in suppressed]
+    known = len(findings) - len(fresh)
+
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "findings": [f.to_json() for f in fresh],
+                "baselined": known,
+                "files": len(index.modules),
+            },
+            indent=2,
+        ))
+    else:
+        for f in fresh:
+            print(f)
+            hint = rule_explanation(f.rule, first_line=True)
+            if hint:
+                print(f"    {hint}")
+        tail = f" ({known} baselined)" if known else ""
+        print(
+            f"tlint: {len(fresh)} finding(s) in {len(index.modules)} "
+            f"file(s){tail}"
+        )
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,319 @@
+"""Shared analysis infrastructure: package index, findings, baseline.
+
+Checkers never re-parse: one :class:`PackageIndex` holds every module's AST
+plus the small cross-file tables (import aliases, per-line suppression
+comments) all four families share. Findings are fingerprinted WITHOUT line
+numbers so a committed baseline survives unrelated edits above a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Callable, Iterable
+
+BASELINE_NAME = "tlint.baseline.json"
+_DISABLE_MARK = "tlint: disable="
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: stable rule id + location + human message.
+
+    ``symbol`` is the line-independent identity component (a function name,
+    message type, attribute, ...) so the fingerprint — what baselines match
+    on — does not churn when code moves within a file.
+    """
+
+    rule: str
+    path: str  # as given on the command line (normalized to posix)
+    line: int
+    message: str
+    symbol: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol or self.message}"
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "file": self.path,
+            "line": self.line,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+            "explanation": rule_explanation(self.rule, first_line=True),
+        }
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed module + the lookups every checker wants."""
+
+    path: str  # normalized relative posix path (fingerprint basis)
+    tree: ast.Module
+    source: str
+    # import alias -> dotted module it names ("np" -> "numpy",
+    # "pol" -> "tensorlink_tpu.roles.pol", "jax.numpy" -> itself)
+    imports: dict[str, str] = field(default_factory=dict)
+    # names bound by `from X import name [as alias]`: alias -> (X, name)
+    from_imports: dict[str, tuple[str, str]] = field(default_factory=dict)
+    # line -> set of rule ids disabled by a trailing tlint comment
+    # (empty set = blanket `# tlint: disable` for every rule)
+    disabled: dict[int, set[str]] = field(default_factory=dict)
+
+    @property
+    def dotted(self) -> str:
+        """Best-effort dotted module name derived from the path."""
+        p = self.path[:-3] if self.path.endswith(".py") else self.path
+        parts = p.split("/")
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        root = parts.index("tensorlink_tpu") if "tensorlink_tpu" in parts else 0
+        return ".".join(parts[root:])
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        rules = self.disabled.get(line)
+        return rules is not None and (not rules or rule in rules)
+
+
+def _collect_imports(mod: ModuleInfo) -> None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mod.imports[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+                if a.asname is None and "." in a.name:
+                    # `import jax.numpy` binds "jax" but makes the dotted
+                    # path referencable; remember it for attr resolution
+                    mod.imports.setdefault(a.name, a.name)
+        elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                mod.from_imports[a.asname or a.name] = (node.module, a.name)
+
+
+def _collect_disables(mod: ModuleInfo) -> None:
+    """Per-line `# tlint: disable=TL001[,TL002]` suppression comments."""
+    try:
+        tokens = tokenize.generate_tokens(StringIO(mod.source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            text = tok.string
+            if "tlint:" not in text:
+                continue
+            if _DISABLE_MARK in text:
+                spec = text.split(_DISABLE_MARK, 1)[1].split("#")[0]
+                rules = {r.strip() for r in spec.split(",") if r.strip()}
+                mod.disabled[tok.start[0]] = rules
+            elif text.split("tlint:", 1)[1].strip() == "disable":
+                mod.disabled[tok.start[0]] = set()
+    except tokenize.TokenizeError:  # pragma: no cover - parse already passed
+        pass
+
+
+class PackageIndex:
+    """Every analyzed module, parsed once, plus cross-file context."""
+
+    def __init__(self, modules: list[ModuleInfo]):
+        self.modules = modules
+        self.by_path = {m.path: m for m in modules}
+        self.by_dotted = {m.dotted: m for m in modules}
+
+    @classmethod
+    def from_paths(cls, paths: Iterable[str]) -> "PackageIndex":
+        files: list[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                for root, dirs, names in os.walk(p):
+                    dirs[:] = sorted(
+                        d for d in dirs
+                        if not d.startswith(".") and d != "__pycache__"
+                    )
+                    files.extend(
+                        os.path.join(root, n)
+                        for n in sorted(names)
+                        if n.endswith(".py")
+                    )
+            elif p.endswith(".py"):
+                files.append(p)
+        modules = []
+        for f in files:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+            modules.append(cls._parse(cls._canonical_path(f), src))
+        return cls(modules)
+
+    @staticmethod
+    def _canonical_path(f: str) -> str:
+        """Path keyed from the file's PACKAGE ROOT, not the process CWD.
+
+        A CWD-relative path breaks two things at once: ModuleInfo.dotted
+        loses the package prefix when tlint runs from inside the package
+        (silently no-opping every cross-module lookup), and baseline
+        fingerprints — which embed the path — stop matching when the tool
+        runs from anywhere else. Walking up through ``__init__.py``
+        parents anchors both to the same string regardless of invocation
+        directory. Non-package files fall back to the CWD relpath
+        (absolute if outside it): ad-hoc targets, not baseline material.
+        """
+        f = os.path.abspath(f)
+        d = os.path.dirname(f)
+        root = None
+        while os.path.exists(os.path.join(d, "__init__.py")):
+            root = d
+            d = os.path.dirname(d)
+        if root is not None:
+            rel = os.path.relpath(f, os.path.dirname(root))
+        else:
+            rel = os.path.relpath(f)
+            if rel.startswith(".."):
+                rel = f
+        return rel.replace(os.sep, "/")
+
+    @classmethod
+    def from_sources(cls, sources: dict[str, str]) -> "PackageIndex":
+        """Build an index from in-memory sources (fixture tests)."""
+        return cls([cls._parse(path, src) for path, src in sources.items()])
+
+    @staticmethod
+    def _parse(path: str, source: str) -> ModuleInfo:
+        mod = ModuleInfo(path=path, tree=ast.parse(source), source=source)
+        _collect_imports(mod)
+        _collect_disables(mod)
+        return mod
+
+
+# --------------------------------------------------------------- checkers
+# A checker is `fn(index) -> list[Finding]`; registration keeps the CLI,
+# docs (`--list-rules`), and tests enumerating one table.
+
+Checker = Callable[[PackageIndex], "list[Finding]"]
+ALL_CHECKERS: dict[str, Checker] = {}
+_RULE_DOCS: dict[str, str] = {}
+
+
+def checker(family: str, rules: dict[str, str]):
+    """Register a checker family and its rule-id -> docstring table."""
+
+    def wrap(fn: Checker) -> Checker:
+        ALL_CHECKERS[family] = fn
+        _RULE_DOCS.update(rules)
+        return fn
+
+    return wrap
+
+
+def rule_explanation(rule: str, first_line: bool = False) -> str:
+    doc = _RULE_DOCS.get(rule, "")
+    return doc.strip().splitlines()[0] if (first_line and doc) else doc
+
+
+def all_rules() -> dict[str, str]:
+    return dict(_RULE_DOCS)
+
+
+def run_analysis(
+    index: PackageIndex, families: Iterable[str] | None = None
+) -> list[Finding]:
+    """Run checkers (all by default) and drop line-level-suppressed hits."""
+    # late import so `import tensorlink_tpu.analysis.core` alone doesn't
+    # register half a table — the registry must be full before any run
+    from tensorlink_tpu.analysis import (  # noqa: F401
+        api_exists,
+        async_safety,
+        jit_hygiene,
+        rpc_schema,
+    )
+
+    names = list(families) if families is not None else sorted(ALL_CHECKERS)
+    findings: list[Finding] = []
+    for name in names:
+        findings.extend(ALL_CHECKERS[name](index))
+    kept = []
+    for f in findings:
+        mod = index.by_path.get(f.path)
+        if mod is not None and mod.suppressed(f.rule, f.line):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    return kept
+
+
+# --------------------------------------------------------------- baseline
+def load_baseline(path: str) -> set[str]:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "suppress" not in data:
+        raise ValueError(f"{path}: not a tlint baseline (missing 'suppress')")
+    return set(data["suppress"])
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    data = {
+        "comment": (
+            "Accepted tlint findings; python -m tensorlink_tpu.analysis "
+            "fails only on findings NOT fingerprinted here. Regenerate "
+            "with --write-baseline after triaging new findings."
+        ),
+        "suppress": sorted({f.fingerprint for f in findings}),
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2)
+        fh.write("\n")
+
+
+def find_default_baseline(start: str) -> str | None:
+    """Walk up from ``start`` looking for the committed baseline file."""
+    cur = os.path.abspath(start if os.path.isdir(start) else os.path.dirname(start) or ".")
+    while True:
+        cand = os.path.join(cur, BASELINE_NAME)
+        if os.path.exists(cand):
+            return cand
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return None
+        cur = nxt
+
+
+# ------------------------------------------------------------- ast helpers
+def dotted_name(node: ast.AST) -> str | None:
+    """'jax.lax.scan' for nested Attribute/Name chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolve_call(mod: ModuleInfo, node: ast.AST) -> str | None:
+    """Canonical dotted target of a call through this module's imports.
+
+    `from functools import partial as _p; _p(...)` -> "functools.partial";
+    `import jax.numpy as jnp; jnp.asarray` -> "jax.numpy.asarray".
+    """
+    name = dotted_name(node)
+    if name is None:
+        return None
+    head, _, rest = name.partition(".")
+    if head in mod.from_imports:
+        src, orig = mod.from_imports[head]
+        base = f"{src}.{orig}"
+    elif head in mod.imports:
+        base = mod.imports[head]
+    else:
+        base = head
+    return f"{base}.{rest}" if rest else base

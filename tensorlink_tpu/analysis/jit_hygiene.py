@@ -1,0 +1,345 @@
+"""JIT hygiene: host syncs, state mutation, and retrace hazards.
+
+A ``jax.jit``-compiled function is traced once per (shape, dtype, static
+args) signature; anything that touches the Python side inside the traced
+body either silently serializes the accelerator (host syncs), vanishes
+after the first trace (side effects), or defeats the compile cache
+(retraces). The trainer grew jit-cache-signature telemetry (PR 1) exactly
+because these bugs are invisible until the latency histogram degrades —
+this checker catches them at review time instead.
+
+Traced contexts recognized: functions/lambdas decorated with or passed to
+``jit``/``pjit``/``shard_map``, bodies handed to ``lax.scan`` /
+``lax.while_loop`` / ``lax.fori_loop`` / ``lax.cond`` / ``lax.switch`` /
+``checkpoint``/``remat``/``vmap``/``pmap``/``grad``/``value_and_grad``/
+``vjp``, and local functions wrapped by name (``f = jax.jit(g)``).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tensorlink_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    PackageIndex,
+    checker,
+    dotted_name,
+    resolve_call,
+)
+
+_RULES = {
+    "TL001": (
+        "Host synchronization inside a jit-traced function.\n\n"
+        "`.item()`, `float()/int()/bool()` on a traced value, `np.asarray`,\n"
+        "`jax.device_get`, `.block_until_ready()`, and `print` all force the\n"
+        "accelerator to flush and copy to host. Inside a traced body they\n"
+        "either fail at trace time (concretization error) or — when traced\n"
+        "through on constants — silently pin the value at trace time. Move\n"
+        "host reads outside the jitted function, or use `jax.debug.print`\n"
+        "for tracing-safe logging."
+    ),
+    "TL002": (
+        "Mutation of `self.*` or global state inside a jit-traced function.\n\n"
+        "Side effects run ONCE at trace time, not per call: `self.calls += 1`\n"
+        "inside a jitted method body records exactly one increment ever, and\n"
+        "re-running the compiled program never sees it. Return new values\n"
+        "instead, or keep the mutation outside the traced body."
+    ),
+    "TL003": (
+        "Retrace hazard: jit cache defeated at the call site.\n\n"
+        "Wrapping with `jax.jit` inside a loop body builds a FRESH cache\n"
+        "every iteration (each wrapper hashes differently), so every call\n"
+        "recompiles; hoist the jit out of the loop. Likewise an f-string\n"
+        "passed as a static argument produces a new cache key per distinct\n"
+        "string — derive static args from hashable, low-cardinality values."
+    ),
+}
+
+_JIT_WRAPPERS = {
+    "jax.jit",
+    "jax.pjit",
+    "jax.experimental.pjit.pjit",
+    "jax.experimental.shard_map.shard_map",
+    "jax.sharding.shard_map",
+    "jit",
+    "pjit",
+    "shard_map",
+}
+# first-arg-is-traced-body transforms (body runs under trace when the
+# enclosing call is itself traced or immediately executed by jax)
+_BODY_TAKERS = {
+    "jax.lax.scan",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.map",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.vmap",
+    "jax.pmap",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.vjp",
+    "jax.linearize",
+    "lax.scan",
+    "lax.while_loop",
+    "lax.fori_loop",
+    "lax.cond",
+    "lax.switch",
+}
+
+_HOST_SYNC_CALLS = {
+    "jax.device_get",
+    "numpy.asarray",
+    "numpy.array",
+    "numpy.copy",
+}
+_HOST_SYNC_METHODS = {"item", "block_until_ready", "tolist", "__array__"}
+_CONCRETIZERS = {"float", "int", "bool"}
+
+
+def _is_jit_ref(mod: ModuleInfo, node: ast.AST) -> bool:
+    """Does this expression reference a jit-like wrapper (possibly through
+    functools.partial or import aliases)?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Name, ast.Attribute)):
+            target = resolve_call(mod, sub)
+            if target in _JIT_WRAPPERS:
+                return True
+    return False
+
+
+def _collect_traced_functions(mod: ModuleInfo):
+    """-> list of (function-ish node, reason) whose bodies are traced.
+
+    Handles decorators (`@jax.jit`, `@partial(jax.jit, ...)`), direct wraps
+    (`jax.jit(lambda ...)`, `f = jax.jit(g)` resolving `g` in the same
+    scope), and bodies handed to lax control-flow / transform combinators.
+    """
+    traced: dict[ast.AST, str] = {}
+    # local name -> def node, per enclosing scope (module or function)
+    scopes: list[dict[str, ast.AST]] = []
+
+    def scan_scope(body: list[ast.stmt]):
+        local = {
+            n.name: n
+            for n in body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        scopes.append(local)
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        if _is_jit_ref(mod, dec):
+                            traced[node] = "decorated jit"
+                elif isinstance(node, ast.Call):
+                    target = resolve_call(mod, node.func)
+                    takes_body = target in _BODY_TAKERS
+                    is_wrap = target in _JIT_WRAPPERS or (
+                        target in ("functools.partial",)
+                        and node.args
+                        and _is_jit_ref(mod, node.args[0])
+                    )
+                    if not (takes_body or is_wrap):
+                        continue
+                    args = node.args
+                    if (
+                        target in ("functools.partial",)
+                        and args
+                        and _is_jit_ref(mod, args[0])
+                    ):
+                        args = args[1:]
+                    # jit wrappers trace their first argument only;
+                    # lax combinators (cond/switch/scan) may take the
+                    # traced body at any position — scan them all
+                    if not takes_body:
+                        args = args[:1]
+                    for a in args:
+                        if isinstance(a, ast.Lambda):
+                            traced[a] = f"passed to {target}"
+                        elif isinstance(a, ast.Name):
+                            for scope in reversed(scopes):
+                                hit = scope.get(a.id)
+                                if hit is not None:
+                                    traced[hit] = f"wrapped by {target}"
+                                    break
+            # descend into nested function bodies with their own scope
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan_scope(stmt.body)
+        scopes.pop()
+
+    scan_scope(mod.tree.body)
+    return traced
+
+
+def _walk_traced(fn: ast.AST):
+    """Yield nodes in a traced body, including nested defs (they trace too
+    when called from the traced body — the common jitted-closure idiom)."""
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        yield from ast.walk(stmt)
+
+
+def _check_host_sync(mod: ModuleInfo, fn: ast.AST, name: str, out: list):
+    for node in _walk_traced(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        target = resolve_call(mod, node.func)
+        if target in _HOST_SYNC_CALLS:
+            out.append(Finding(
+                "TL001", mod.path, node.lineno,
+                f"host sync `{dotted_name(node.func)}` inside jit-traced "
+                f"`{name}`",
+                symbol=f"{name}.{dotted_name(node.func)}",
+            ))
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _HOST_SYNC_METHODS
+            and not node.args
+        ):
+            out.append(Finding(
+                "TL001", mod.path, node.lineno,
+                f"host sync `.{node.func.attr}()` inside jit-traced "
+                f"`{name}`",
+                symbol=f"{name}.{node.func.attr}",
+            ))
+        elif target == "print":
+            out.append(Finding(
+                "TL001", mod.path, node.lineno,
+                f"`print` inside jit-traced `{name}` runs at trace time "
+                "only (use jax.debug.print)",
+                symbol=f"{name}.print",
+            ))
+        elif (
+            target in _CONCRETIZERS
+            and len(node.args) == 1
+            and not isinstance(node.args[0], ast.Constant)
+        ):
+            out.append(Finding(
+                "TL001", mod.path, node.lineno,
+                f"`{target}(...)` on a non-constant inside jit-traced "
+                f"`{name}` concretizes the tracer (host sync)",
+                symbol=f"{name}.{target}",
+            ))
+
+
+def _check_state_mutation(mod: ModuleInfo, fn: ast.AST, name: str, out: list):
+    globals_declared: set[str] = set()
+    for node in _walk_traced(fn):
+        if isinstance(node, (ast.Global, ast.Nonlocal)):
+            globals_declared.update(node.names)
+    for node in _walk_traced(fn):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                out.append(Finding(
+                    "TL002", mod.path, node.lineno,
+                    f"`self.{t.attr}` assigned inside jit-traced `{name}`: "
+                    "side effects run once at trace time",
+                    symbol=f"{name}.self.{t.attr}",
+                ))
+            elif isinstance(t, ast.Name) and t.id in globals_declared:
+                out.append(Finding(
+                    "TL002", mod.path, node.lineno,
+                    f"global/nonlocal `{t.id}` assigned inside jit-traced "
+                    f"`{name}`: side effects run once at trace time",
+                    symbol=f"{name}.{t.id}",
+                ))
+
+
+def _jit_wrapped_names(mod: ModuleInfo) -> set[str]:
+    """Names bound to jit-wrapped callables (`f = jax.jit(...)` and
+    `@jax.jit def f`), for the f-string static-arg check."""
+    names: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _is_jit_ref(mod, node.value.func):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+                    elif (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        names.add(t.attr)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_ref(mod, d) for d in node.decorator_list):
+                names.add(node.name)
+    return names
+
+
+def _check_retrace(mod: ModuleInfo, out: list):
+    jitted = _jit_wrapped_names(mod)
+
+    class LoopVisitor(ast.NodeVisitor):
+        def __init__(self):
+            self.loop_depth = 0
+
+        def visit_For(self, node):
+            self._loop(node)
+
+        def visit_While(self, node):
+            self._loop(node)
+
+        def _loop(self, node):
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        def visit_FunctionDef(self, node):
+            # a def inside a loop resets loop context for its body
+            saved, self.loop_depth = self.loop_depth, 0
+            self.generic_visit(node)
+            self.loop_depth = saved
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node):
+            target = resolve_call(mod, node.func)
+            if self.loop_depth and target in _JIT_WRAPPERS:
+                out.append(Finding(
+                    "TL003", mod.path, node.lineno,
+                    f"`{dotted_name(node.func)}(...)` inside a loop body "
+                    "builds a fresh compile cache per iteration — hoist it",
+                    symbol=f"loop.{dotted_name(node.func)}",
+                ))
+            # f-string flowing into a jit static arg
+            callee = dotted_name(node.func)
+            callee_tail = (callee or "").split(".")[-1]
+            if callee_tail in jitted or target in _JIT_WRAPPERS:
+                for a in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(a, ast.JoinedStr):
+                        out.append(Finding(
+                            "TL003", mod.path, a.lineno,
+                            f"f-string argument to jit-wrapped `{callee}` "
+                            "keys the compile cache per distinct string",
+                            symbol=f"fstring.{callee}",
+                        ))
+            self.generic_visit(node)
+
+    LoopVisitor().visit(mod.tree)
+
+
+@checker("jit_hygiene", _RULES)
+def check(index: PackageIndex) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in index.modules:
+        traced = _collect_traced_functions(mod)
+        for fn, _reason in traced.items():
+            name = getattr(fn, "name", "<lambda>")
+            _check_host_sync(mod, fn, name, out)
+            _check_state_mutation(mod, fn, name, out)
+        _check_retrace(mod, out)
+    return out

@@ -1,0 +1,193 @@
+"""RPC schema consistency: sent message types vs. registered handlers.
+
+The p2p envelope dispatches on the literal ``"type"`` field; the roles
+register handlers with ``self.on("TYPE", coro)``. Nothing ties the two
+together at runtime — the reference shipped handlers for messages nobody
+ever sent and senders whose type string no handler matched, and both fail
+silently (the receiver ghost-penalizes and drops). This checker extracts
+both literal tables from the AST and cross-checks them package-wide.
+
+What counts as a *send*: a dict literal carrying a literal ``"type"`` key,
+passed as a direct argument to a ``.send(...)``/``.request(...)`` call —
+on any receiver (``self``, ``node``, ``self.user`` ...) — or to a *send
+helper*: a method whose body forwards one of its parameters into a
+``.send/.request`` argument (``_relay_to_origin`` style). Dict literals in
+``return`` position are replies, correlated by message id, and need no
+handler; handshake frames go through ``encode_message`` directly and are
+likewise excluded by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tensorlink_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    PackageIndex,
+    checker,
+)
+
+_RULES = {
+    "TL201": (
+        "Message type sent with no registered handler.\n\n"
+        "The receiving role's dispatch table has no `self.on(TYPE, ...)`\n"
+        "for this literal: the message is counted as a ghost, the sender\n"
+        "is reputation-penalized, and a `request()` waits out its full\n"
+        "timeout. Register a handler or fix the type string."
+    ),
+    "TL202": (
+        "Dead handler: registered message type is never sent.\n\n"
+        "`self.on(TYPE, ...)` exists but no code path in the analyzed\n"
+        "tree sends that type — either vestigial (delete it) or the\n"
+        "sender's type string drifted (fix it). The reference's defect\n"
+        "catalog is full of exactly this class."
+    ),
+}
+
+_SEND_METHODS = {"send", "request"}
+
+
+@dataclass
+class _Table:
+    handlers: dict[str, tuple[str, int]] = field(default_factory=dict)
+    sends: dict[str, tuple[str, int]] = field(default_factory=dict)
+
+
+def _literal_types(d: ast.Dict) -> list[str]:
+    """Literal "type" values of a dict literal. A conditional literal
+    (`"RELAY_BACKWARD" if backward else "RELAY_FORWARD"`) contributes both
+    branches."""
+    for k, v in zip(d.keys, d.values):
+        if not (isinstance(k, ast.Constant) and k.value == "type"):
+            continue
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            return [v.value]
+        if isinstance(v, ast.IfExp):
+            return [
+                b.value
+                for b in (v.body, v.orelse)
+                if isinstance(b, ast.Constant) and isinstance(b.value, str)
+            ]
+    return []
+
+
+def _method_attr(call: ast.Call) -> str | None:
+    return call.func.attr if isinstance(call.func, ast.Attribute) else None
+
+
+def _send_helper_methods(mod: ModuleInfo) -> set[str]:
+    """Methods that forward a parameter into a .send/.request argument —
+    one level of indirection so `self._relay_to_origin(msg, {...})` counts
+    as a send of the literal dict."""
+    helpers: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        params = {a.arg for a in node.args.args} - {"self"}
+        if not params:
+            continue
+        for sub in ast.walk(node):
+            if not (isinstance(sub, ast.Call) and _method_attr(sub) in _SEND_METHODS):
+                continue
+            for arg in sub.args:
+                if isinstance(arg, ast.Name) and arg.id in params:
+                    helpers.add(node.name)
+                elif isinstance(arg, ast.Dict):
+                    for k, v in zip(arg.keys, arg.values):
+                        if k is None and isinstance(v, ast.Name) and v.id in params:
+                            helpers.add(node.name)  # {**param, ...} splat
+    return helpers
+
+
+def _collect(mod: ModuleInfo, helpers: set[str], table: _Table) -> None:
+    # local message dicts built first, sent by name later:
+    #   req = {"type": "REPLACE_WORKER", ...}; await self.request(v, req)
+    # scoped per enclosing function so unrelated same-named locals in other
+    # functions don't leak into the table
+    named_dicts: dict[tuple[int, str], ast.Dict] = {}
+    reply_marked: set[tuple[int, str]] = set()
+    func_of: dict[ast.AST, int] = {}
+    for i, fn in enumerate(
+        n
+        for n in ast.walk(mod.tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ):
+        for sub in ast.walk(fn):
+            func_of.setdefault(sub, i)
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Dict)
+            and _literal_types(node.value)
+        ):
+            scope = func_of.get(node, -1)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    named_dicts[(scope, t.id)] = node.value
+        elif isinstance(node, ast.Assign):
+            # `reply["re"] = msg["id"]` marks the dict as a CORRELATED
+            # REPLY: delivered to the requester's pending future, never
+            # dispatched — it needs no handler
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and isinstance(t.slice, ast.Constant)
+                    and t.slice.value == "re"
+                ):
+                    reply_marked.add((func_of.get(node, -1), t.value.id))
+
+    for key in reply_marked:
+        named_dicts.pop(key, None)
+
+    def record_send(d: ast.Dict) -> None:
+        for t in _literal_types(d):
+            table.sends.setdefault(t, (mod.path, d.lineno))
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        attr = _method_attr(node)
+        if attr == "on" and len(node.args) >= 2:
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                table.handlers.setdefault(a0.value, (mod.path, node.lineno))
+        elif attr in _SEND_METHODS or attr in helpers:
+            scope = func_of.get(node, -1)
+            for arg in node.args:
+                if isinstance(arg, ast.Dict):
+                    record_send(arg)
+                elif isinstance(arg, ast.Name):
+                    d = named_dicts.get((scope, arg.id))
+                    if d is not None:
+                        record_send(d)
+
+
+@checker("rpc_schema", _RULES)
+def check(index: PackageIndex) -> list[Finding]:
+    helpers: set[str] = set()
+    for mod in index.modules:
+        helpers |= _send_helper_methods(mod)
+    table = _Table()
+    for mod in index.modules:
+        _collect(mod, helpers, table)
+    out: list[Finding] = []
+    for t, (path, line) in sorted(table.sends.items()):
+        if t not in table.handlers:
+            out.append(Finding(
+                "TL201", path, line,
+                f'message type "{t}" is sent but no role registers a '
+                "handler for it (receiver ghosts it)",
+                symbol=f"send.{t}",
+            ))
+    for t, (path, line) in sorted(table.handlers.items()):
+        if t not in table.sends:
+            out.append(Finding(
+                "TL202", path, line,
+                f'handler registered for "{t}" but nothing in the analyzed '
+                "tree sends that type (dead handler or sender drift)",
+                symbol=f"handler.{t}",
+            ))
+    return out

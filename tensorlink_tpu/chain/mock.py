@@ -28,6 +28,11 @@ class MockRegistryContract:
         self._validators: dict[str, dict] = {}  # nodeId -> record, insertion-ordered
         self._jobs: list[dict] = []  # on-chain job records (1-based ids)
         self._clock = 1_700_000_000  # deterministic "block time"
+        # EVM-style event log emitted by the CURRENT execute() call; the
+        # server moves these into the transaction's receipt (requestJob
+        # emits JobRequested so submitters read their job id from the
+        # receipt instead of racing a jobCount() re-read)
+        self.pending_logs: list[dict] = []
 
     def execute(self, calldata: bytes) -> bytes:
         sel, args = calldata[:4], calldata[4:]
@@ -76,7 +81,19 @@ class MockRegistryContract:
                 "payment_milli": payment, "completed": False,
                 "requested_at": self._clock,
             })
-            return abi.encode(["uint256"], [len(self._jobs)])
+            job_id = len(self._jobs)
+            # event JobRequested(uint256 indexed jobId, string userId) —
+            # the authoritative job-id channel for submitters (a tx return
+            # value is unreadable over JSON-RPC; chain/registry.py)
+            self.pending_logs.append({
+                "address": CONTRACT_ADDRESS,
+                "topics": [
+                    "0x" + keccak256(b"JobRequested(uint256,string)").hex(),
+                    "0x" + job_id.to_bytes(32, "big").hex(),
+                ],
+                "data": "0x" + abi.encode(["string"], [user_id]).hex(),
+            })
+            return abi.encode(["uint256"], [job_id])
         if sel == selector("completeJob(uint256)"):
             [job_id] = abi.decode(["uint256"], args)
             if not 1 <= job_id <= len(self._jobs):
@@ -102,6 +119,9 @@ class MockChainServer:
     def __init__(self, contract: MockRegistryContract | None = None):
         self.contract = contract or MockRegistryContract()
         self.calls: list[str] = []  # method log, for assertions
+        self._receipts: dict[str, dict] = {}  # txHash -> receipt w/ logs
+        self._tx_nonce = 0
+        self._tx_lock = threading.Lock()  # handlers run on server threads
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -135,7 +155,8 @@ class MockChainServer:
             calldata = bytes.fromhex(params[0]["data"][2:])
             if params[0]["to"].lower() != CONTRACT_ADDRESS:
                 raise ValueError("unknown contract")
-            return "0x" + self.contract.execute(calldata).hex()
+            with self._tx_lock:
+                return "0x" + self.contract.execute(calldata).hex()
         if method == "eth_sendTransaction":
             tx = params[0]
             # same unknown-contract check as eth_call: a misconfigured
@@ -143,10 +164,36 @@ class MockChainServer:
             if tx["to"].lower() != CONTRACT_ADDRESS:
                 raise ValueError("unknown contract")
             calldata = bytes.fromhex(tx["data"][2:])
-            self.contract.execute(calldata)
-            return "0x" + keccak256(calldata).hex()
+            # ThreadingHTTPServer handles each request on its own thread:
+            # the reset -> execute -> receipt-snapshot sequence (and the
+            # nonce bump) must be atomic, or a concurrent submitter's
+            # reset clears this tx's logs and its receipt comes up empty —
+            # the exact job-id race the JobRequested event exists to kill
+            with self._tx_lock:
+                self.contract.pending_logs = []
+                self.contract.execute(calldata)
+                # salt with a per-server nonce: identical calldata
+                # submitted twice must not collide on tx hash (real
+                # chains mix in the sender nonce), or the second receipt
+                # would shadow the first
+                self._tx_nonce += 1
+                tx_hash = "0x" + keccak256(
+                    calldata + self._tx_nonce.to_bytes(8, "big")
+                ).hex()
+                # receipt carries the events this execution emitted,
+                # exactly like a real node — Web3Registry reads
+                # JobRequested from here
+                self._receipts[tx_hash] = {
+                    "status": "0x1",
+                    "transactionHash": tx_hash,
+                    "logs": list(self.contract.pending_logs),
+                }
+            return tx_hash
         if method == "eth_getTransactionReceipt":
-            return {"status": "0x1", "transactionHash": params[0]}
+            with self._tx_lock:
+                return self._receipts.get(
+                    params[0], {"status": "0x1", "transactionHash": params[0]}
+                )
         raise ValueError(f"unsupported method {method}")
 
     # ----------------------------------------------------------- lifecycle

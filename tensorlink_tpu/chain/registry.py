@@ -39,7 +39,7 @@ from __future__ import annotations
 import time
 
 from tensorlink_tpu.chain import abi
-from tensorlink_tpu.chain.keccak import selector
+from tensorlink_tpu.chain.keccak import keccak256, selector
 from tensorlink_tpu.chain.rpc import ChainError, ChainRpc
 from tensorlink_tpu.p2p.dht import PeerInfo
 from tensorlink_tpu.roles.registry import Registry, ValidatorEntry
@@ -185,18 +185,46 @@ class Web3Registry(Registry):
         )
 
     # -- on-chain job/payment records (module docstring) ----------------
+
+    # keccak256("JobRequested(uint256,string)") — topic[0] of the event the
+    # contract emits per requestJob; topic[1] is the indexed job id
+    JOB_REQUESTED_TOPIC = "0x" + keccak256(
+        b"JobRequested(uint256,string)"
+    ).hex()
+
     def request_job_onchain(
         self, user_id: str, capacity_bytes: int, payment_milli: int
     ) -> int:
         """Record a job request; -> its on-chain job id. A transaction
-        cannot return a value over JSON-RPC (real deployments read the
-        event log), so the id is read back as jobCount() after the
-        receipt — safe while one user submits at a time; concurrent
-        submitters on a real chain would parse the JobRequested event."""
-        self._transact(
+        cannot return a value over JSON-RPC, so the id comes from the
+        JobRequested event in the transaction's receipt logs — race-free
+        under concurrent submitters (each receipt names ITS job). Only
+        when the node returns no receipt/logs (old contract without the
+        event) does this fall back to re-reading jobCount(), which is
+        correct only while a single user submits at a time — the
+        constraint UserNode.request_job documents."""
+        tx_hash = self._transact(
             "requestJob", ["string", "uint256", "uint256"],
             [user_id, int(capacity_bytes), int(payment_milli)],
         )
+        try:
+            receipt = self.rpc.get_transaction_receipt(tx_hash)
+        except ChainError:
+            receipt = None
+        status = (receipt or {}).get("status")
+        if status is not None and int(status, 16) == 0:
+            # reverted: falling through to the jobCount() fallback here
+            # would return some OTHER job's id as if this request
+            # succeeded — and its escrow would later be completed
+            raise ChainError(
+                f"requestJob transaction {tx_hash} reverted (status 0x0)"
+            )
+        for log in (receipt or {}).get("logs", []):
+            topics = log.get("topics") or []
+            if len(topics) >= 2 and topics[0] == self.JOB_REQUESTED_TOPIC:
+                return int(topics[1], 16)
+        # legacy fallback: jobCount() after the receipt (single-submitter
+        # window only — see docstring)
         [count] = self._read("jobCount", ["uint256"], [], [])
         return int(count)
 

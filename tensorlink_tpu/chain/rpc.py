@@ -56,5 +56,13 @@ class ChainRpc:
             tx["from"] = sender
         return self.request("eth_sendTransaction", [tx])
 
+    def get_transaction_receipt(self, tx_hash: str) -> dict | None:
+        """Receipt (status + event logs) for a mined transaction; None
+        while pending/unknown. The logs are how a transaction's "return
+        value" actually reaches a JSON-RPC client (chain/registry.py
+        request_job_onchain parses JobRequested from here)."""
+        out = self.request("eth_getTransactionReceipt", [tx_hash])
+        return out if isinstance(out, dict) else None
+
     def chain_id(self) -> int:
         return int(self.request("eth_chainId", []), 16)

@@ -497,9 +497,18 @@ class MultiHeadAttention(Module):
             # below (review finding). The index>0 NaN-poison further
             # down still catches silent fresh-path misuse, since the
             # traced index can't gate a branch.
+            # A T==1 write carrying a width-1 mask into a WIDER cache is a
+            # single-token prompt prefill (the engine's [B,1,1,1] mask at
+            # T0==1) and is treated fresh too — ADVICE r5: classifying it
+            # non-fresh blessed a width-1 mask that broadcasts over the
+            # whole cache, attending unwritten zero-key slots. Decode
+            # steps are unaffected: they carry cache-width masks (the
+            # valid-slot mask), and a fresh-misclassified caller at
+            # index>0 hits the NaN poison below — loud, not silent.
             fresh = (
                 fresh_keys if fresh_keys is not None
-                else T > 1 and mask is not None and mask.shape[-1] == T
+                else mask is not None and mask.shape[-1] == T
+                and (T > 1 or ck.shape[1] > T)
             )
             if fresh and (mask is None or mask.shape[-1] != T):
                 raise ValueError(
@@ -509,8 +518,13 @@ class MultiHeadAttention(Module):
                 )
             if (
                 not fresh and mask is not None
-                and mask.shape[-1] not in (1, ck.shape[1])
+                and mask.shape[-1] != ck.shape[1]
             ):
+                # width-1 masks are NOT accepted here: broadcasting one
+                # over the cache would also "validate" every unwritten
+                # slot the valid-mask doesn't cover (window bands, pad
+                # masks) — a width-1 mask meeting a wider cache is the
+                # fresh prefill form, handled above
                 raise ValueError(
                     "cache attention needs a cache-width mask (last dim "
                     f"{ck.shape[1]}), got {mask.shape}; a prompt-width "

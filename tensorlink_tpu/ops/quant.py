@@ -90,9 +90,13 @@ def quantized_random_init(module, key, dtype=jnp.bfloat16):
     start, while the int8 form (~8.5 GB) fits. Dense 2-D weights become
     {"q": uniform int8, "s": per-channel scale such that the effective
     weight std matches LeCun 1/sqrt(fan_in)} (uniform[-127,127] has std
-    ~73.3); Dense biases are zeros; every other leaf (embeddings,
-    norms) is a normal(0, 0.02) draw in ``dtype``, created leaf-by-leaf
-    on device. Intended for serving benchmarks and capacity tests
+    ~73.3); Dense biases are zeros; norm gains (leaves named ``scale``)
+    are ONES, matching the real init — a normal(0, 0.02) draw there
+    multiplies every layer's activations by ~0.02 and collapses the
+    forward pass ~50x per layer (ADVICE r5); every other leaf
+    (embeddings, biases elsewhere) is a normal(0, 0.02) draw in
+    ``dtype``, created leaf-by-leaf on device. Intended for serving
+    benchmarks and capacity tests
     (random weights, real shapes/dtypes/layout); real checkpoints go
     through quantize_params_int8."""
     import numpy as np
@@ -130,9 +134,14 @@ def quantized_random_init(module, key, dtype=jnp.bfloat16):
                 k, k1 = jax.random.split(k)
                 if name in children:
                     out[name] = walk(children[name], sub, k1)
+                elif isinstance(sub, dict):
+                    out[name] = walk(mod, sub, k1)
+                elif name == "scale":
+                    # norm gain: ones, as in the real init — random gains
+                    # shrink activations ~50x per layer (module docstring)
+                    out[name] = jnp.ones(sub.shape, dtype)
                 else:
-                    out[name] = walk(mod, sub, k1) if isinstance(sub, dict) \
-                        else leaf_normal(k1, sub.shape)
+                    out[name] = leaf_normal(k1, sub.shape)
             return out
         return leaf_normal(k, shp.shape)
 

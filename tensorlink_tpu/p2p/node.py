@@ -123,7 +123,9 @@ class Node:
             try:
                 from tensorlink_tpu.p2p.nat import _local_ip_toward
 
-                self._lan_ip = await asyncio.to_thread(
+                # start() runs once per node, before any handler can
+                # touch _lan_ip — the check-then-act straddle is safe here
+                self._lan_ip = await asyncio.to_thread(  # tlint: disable=TL102
                     _local_ip_toward, "8.8.8.8"
                 )
             except OSError:

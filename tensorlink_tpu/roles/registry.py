@@ -109,7 +109,14 @@ class InMemoryRegistry(Registry):
         return len(jobs)
 
     def complete_job_onchain(self, job_id: int) -> None:
-        self._jobs[job_id - 1]["completed"] = True
+        # same error contract as the mock chain contract (chain/mock.py
+        # completeJob): unknown ids raise ValueError, not AttributeError/
+        # IndexError — the two ledger backends must not diverge on error
+        # behavior (ADVICE r5)
+        jobs = getattr(self, "_jobs", [])
+        if not 1 <= job_id <= len(jobs):
+            raise ValueError(f"unknown job {job_id}")
+        jobs[job_id - 1]["completed"] = True
 
     def job_onchain(self, job_id: int) -> dict | None:
         jobs = getattr(self, "_jobs", [])

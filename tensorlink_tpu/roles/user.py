@@ -435,6 +435,40 @@ class DistributedJob:
             self.chain_registry.complete_job_onchain, self.chain_job_id
         )
 
+    async def shutdown(self, timeout: float = 10.0) -> int:
+        """Tear the job down: UNLOAD every stage peer (frees loaded
+        stages + any reservation worker-side; owner-authorized) and close
+        the on-chain record. The reference had no job teardown at all —
+        finished jobs pinned worker memory until the process died, which
+        is exactly the capacity leak the worker's reservation TTL guards
+        against for NEVER-shipped jobs. Best-effort per peer: a dead
+        worker's state is reclaimed by its own restart, not by us.
+        Returns the number of stage slots workers confirmed freed."""
+        async def unload(peer: Peer) -> int:
+            try:
+                resp = await self.user.request(
+                    peer,
+                    {"type": "UNLOAD", "job_id": self.job.job_id},
+                    timeout=timeout,
+                )
+            except (ConnectionError, asyncio.TimeoutError, OSError):
+                return 0
+            return (
+                int(resp.get("stages", 0))
+                if resp.get("type") == "UNLOADED"
+                else 0
+            )
+
+        # concurrent: teardown latency is one timeout, not one per dead
+        # peer (a 4-worker job with 3 unreachable peers must not stall
+        # its caller 30 s)
+        freed = sum(await asyncio.gather(*(
+            unload(p)
+            for p in {st.peer.node_id: st.peer for st in self.stages}.values()
+        )))
+        await self.complete_onchain()
+        return freed
+
     async def train_step(
         self,
         batch_x: np.ndarray,
@@ -941,7 +975,7 @@ class UserNode(Node):
 
     # ------------------------------------------------- relay result intake
     def relay_waiter(self, key: tuple, expected: str, members: set) -> asyncio.Future:
-        fut = asyncio.get_event_loop().create_future()
+        fut = asyncio.get_running_loop().create_future()
         self._relay_waiters[key] = (expected, set(members), fut)
         return fut
 
@@ -1103,6 +1137,15 @@ class UserNode(Node):
     ) -> DistributedJob:
         """Partition -> JOB_REQ -> connect workers -> ship specs+weights ->
         LOADED acks -> DistributedJob (reference call stack §3.1).
+
+        With ``chain_registry=``, the job request is recorded on-chain
+        BEFORE placement and the ledger id lands in
+        ``DistributedJob.chain_job_id``. The id comes from the
+        JobRequested event in the transaction receipt; against a legacy
+        contract without that event the registry falls back to re-reading
+        ``jobCount()``, which is only correct while a single user submits
+        at a time — run concurrent submitters only against contracts that
+        emit JobRequested.
 
         ``obfuscate=True`` folds secret orthogonal rotations into each
         stage's BOUNDARY Dense layers (roles/privacy.py): the activations

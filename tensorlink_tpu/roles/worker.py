@@ -793,7 +793,7 @@ class WorkerNode(Node):
             return await self.connect_candidates(
                 info["host"], int(info["port"]), info.get("alt_hosts", ()),
                 expect_id=nid)
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
         deadline = loop.time() + wait_s
         while loop.time() < deadline:
             p = self.peers.get(nid)
@@ -1118,7 +1118,8 @@ class WorkerNode(Node):
         try:
             await asyncio.gather(*(push(i) for i in runner.replica_peers))
             expected = {i["node_id"] for i in runner.replica_peers}
-            deadline = asyncio.get_event_loop().time() + 30.0
+            loop = asyncio.get_running_loop()
+            deadline = loop.time() + 30.0
             while True:
                 have = {
                     s
@@ -1129,7 +1130,7 @@ class WorkerNode(Node):
                 }
                 if expected <= have:
                     break
-                remaining = deadline - asyncio.get_event_loop().time()
+                remaining = deadline - loop.time()
                 if remaining <= 0:
                     raise asyncio.TimeoutError("grad sync timeout")
                 event.clear()

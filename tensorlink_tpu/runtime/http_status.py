@@ -149,7 +149,10 @@ class StatusServer:
         )
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # claim the server BEFORE awaiting: concurrent stop() calls must
+        # not both close (the second would await a dead handle) — the
+        # check-and-clear is atomic, only the winner tears down
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
+            await server.wait_closed()

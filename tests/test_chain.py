@@ -340,3 +340,86 @@ async def test_request_job_records_onchain(chain):
     finally:
         for n in (user, validator, worker):
             await n.stop()
+
+
+# --------------------------------------------------------- job ledger
+def test_job_id_from_receipt_event(chain):
+    """request_job_onchain reads the JobRequested event from the tx
+    receipt — race-free under concurrent submitters (ADVICE r5: the old
+    jobCount() re-read returned whichever request landed LAST)."""
+    reg = Web3Registry(chain.url, CONTRACT_ADDRESS, cache_ttl=0.0)
+    a = reg.request_job_onchain("user-a", 1000, 5)
+    b = reg.request_job_onchain("user-b", 2000, 7)
+    assert (a, b) == (1, 2)
+    assert reg.job_onchain(1)["user_id"] == "user-a"
+    assert reg.job_onchain(2)["user_id"] == "user-b"
+    # the receipt really carried the event (not the jobCount fallback)
+    rpc = ChainRpc(chain.url)
+    tx = reg._transact(
+        "requestJob", ["string", "uint256", "uint256"], ["user-c", 1, 1]
+    )
+    receipt = rpc.get_transaction_receipt(tx)
+    [log] = receipt["logs"]
+    assert log["topics"][0] == Web3Registry.JOB_REQUESTED_TOPIC
+    assert int(log["topics"][1], 16) == 3
+
+
+def test_job_ledger_backend_parity(chain):
+    """Both ledger backends (memory, chain) agree on the whole
+    request -> complete lifecycle INCLUDING the error contract:
+    completing or reading an unknown job raises/returns the same way
+    (ADVICE r5: InMemoryRegistry used to raise bare AttributeError/
+    IndexError where the contract raises ValueError('unknown job'))."""
+    from tensorlink_tpu.chain.rpc import ChainError
+    from tensorlink_tpu.roles.registry import InMemoryRegistry
+
+    mem = InMemoryRegistry()
+    web3 = Web3Registry(chain.url, CONTRACT_ADDRESS, cache_ttl=0.0)
+
+    for reg, err in ((mem, ValueError), (web3, ChainError)):
+        # completing before ANY request, and out-of-range ids: same
+        # ValueError-shaped refusal (the chain surfaces it as ChainError
+        # wrapping the contract's ValueError)
+        with pytest.raises(err, match="unknown job"):
+            reg.complete_job_onchain(1)
+        jid = reg.request_job_onchain("parity-user", 4096, 9)
+        assert jid == 1
+        rec = reg.job_onchain(jid)
+        assert rec["user_id"] == "parity-user"
+        assert rec["capacity_bytes" if "capacity_bytes" in rec else "capacity"] == 4096
+        assert rec["completed"] is False
+        with pytest.raises(err, match="unknown job"):
+            reg.complete_job_onchain(jid + 1)
+        reg.complete_job_onchain(jid)
+        assert reg.job_onchain(jid)["completed"] is True
+    # unknown-id reads: memory returns None; the chain contract raises
+    assert mem.job_onchain(99) is None
+    with pytest.raises(ChainError):
+        web3.job_onchain(99)
+
+
+def test_job_ids_race_free_under_concurrent_submitters(chain):
+    """The whole point of the JobRequested receipt path: N threads
+    submitting concurrently each get THEIR OWN id (the mock serializes
+    reset->execute->receipt under a lock; jobCount() re-reads would
+    return whichever landed last)."""
+    import threading
+
+    ids, errs = [], []
+
+    def submit(i):
+        try:
+            reg = Web3Registry(chain.url, CONTRACT_ADDRESS, cache_ttl=0.0)
+            jid = reg.request_job_onchain(f"user-{i}", 100 + i, 1)
+            assert reg.job_onchain(jid)["user_id"] == f"user-{i}"
+            ids.append(jid)
+        except Exception as e:  # surfaces in the main thread's assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=submit, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    assert sorted(ids) == list(range(1, 9))

@@ -463,3 +463,18 @@ def test_kv_seq_shard_requires_seq_axis(tiny_llama):
         InferenceEngine(
             make_mesh(MeshConfig()), m, p, max_len=32, kv_seq_shard=True,
         )
+
+
+def test_single_token_prompt_matches_naive(tiny_llama):
+    """T0==1 prompts build a [B,1,1,1] prefill mask — now classified as
+    the fresh single-token prefill (ADVICE r5: as non-fresh it broadcast
+    over the whole cache, attending unwritten zero-key slots). Greedy
+    tokens must match the cacheless re-forward decode exactly."""
+    cfg, m, p = tiny_llama
+    ids = np.asarray(jax.random.randint(KEY, (2, 1), 0, cfg.vocab_size))
+    eng = InferenceEngine(
+        make_mesh(MeshConfig()), m, p, max_len=16,
+        cache_dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    got = eng.generate(ids, GenerationConfig(max_new_tokens=6))
+    np.testing.assert_array_equal(got, _naive_greedy(m, p, ids, 6))

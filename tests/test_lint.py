@@ -1,13 +1,17 @@
-"""Lint smoke test: the [tool.ruff] config in pyproject.toml holds.
+"""Lint gates: ruff (generic) + tlint (project-specific static analysis).
 
 Runs `ruff check` (pyflakes rules + the no-print-in-library-code ban)
 when ruff is on PATH; skips otherwise — the lint gate must not make the
-suite depend on a tool the runtime never needs.
+suite depend on a tool the runtime never needs. `tlint`
+(tensorlink_tpu.analysis) is part of the package itself, so that gate
+always runs: zero unsuppressed findings against the committed
+tlint.baseline.json, or this test names the regressions.
 """
 
 import os
 import shutil
 import subprocess
+import sys
 
 import pytest
 
@@ -25,3 +29,17 @@ def test_ruff_clean():
         timeout=120,
     )
     assert out.returncode == 0, f"ruff findings:\n{out.stdout}\n{out.stderr}"
+
+
+def test_tlint_clean():
+    """The project analyzer (jit hygiene, asyncio safety, RPC schema,
+    API existence — see README "Static analysis") reports nothing new
+    over the package."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tensorlink_tpu.analysis", "tensorlink_tpu"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=300,
+    )
+    assert out.returncode == 0, f"tlint findings:\n{out.stdout}\n{out.stderr}"

@@ -189,3 +189,41 @@ def test_fresh_prefill_guard_poisons_nonempty_cache():
     assert np.isfinite(np.asarray(ok)).all()  # index 0: legit prefill
     bad, _ = mha.apply(params, x, cache=cache1, mask=m)  # index 4
     assert np.isnan(np.asarray(bad)).all()
+
+
+def test_single_token_prefill_width1_mask_is_fresh():
+    """A T==1 write at cache index 0 with a [B,1,1,1] mask is a fresh
+    single-token prefill (ADVICE r5: classified non-fresh, the width-1
+    mask broadcast over the whole cache and blessed unwritten zero-key
+    slots). It must match the cacheless forward exactly; at index>0 the
+    same shape is a misuse and hits the fresh-keys NaN poison."""
+    from tensorlink_tpu.nn.attention import MultiHeadAttention
+
+    mha = MultiHeadAttention(32, 4, causal=True, attn_impl="reference")
+    params = mha.init(jax.random.key(0))
+    cache = mha.init_cache(2, 16, dtype=jnp.float32)
+    x1 = jax.random.normal(jax.random.key(1), (2, 1, 32))
+    m1 = jnp.ones((2, 1, 1, 1), bool)
+
+    out, cache1 = mha.apply(params, x1, cache=cache, mask=m1)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(mha.apply(params, x1)), atol=1e-5
+    )
+    # decode-shaped misuse: width-1 mask with a non-empty cache is loud
+    bad, _ = mha.apply(params, x1, cache=cache1, mask=m1)
+    assert np.isnan(np.asarray(bad)).all()
+
+
+def test_width1_mask_rejected_for_multi_token_cache_write():
+    """T>1 with a width-1 mask is neither the fresh form (mask is not
+    T-wide) nor a cache-width mask: it used to be blessed and broadcast
+    over every slot — now it raises instead (ADVICE r5)."""
+    from tensorlink_tpu.nn.attention import MultiHeadAttention
+
+    mha = MultiHeadAttention(32, 4, causal=True, attn_impl="reference")
+    params = mha.init(jax.random.key(0))
+    cache = mha.init_cache(2, 16, dtype=jnp.float32)
+    x4 = jax.random.normal(jax.random.key(2), (2, 4, 32))
+    with pytest.raises(ValueError, match="cache-width"):
+        mha.apply(params, x4, cache=cache, mask=jnp.ones((2, 1, 4, 1), bool))

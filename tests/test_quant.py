@@ -175,3 +175,35 @@ def test_quantized_random_init_serves():
     out = eng.generate(ids, GenerationConfig(max_new_tokens=6))
     assert out.shape == (2, 6)
     assert (out >= 0).all() and (out < cfg.vocab_size).all()
+
+
+def test_quantized_random_init_norm_gains_are_ones():
+    """Norm gain leaves (named ``scale``) must init to ONES like the real
+    init — a normal(0, 0.02) draw there multiplies every layer's
+    activations by ~0.02 and collapses the forward pass ~50x per layer,
+    making random serving-form logits degenerate (ADVICE r5)."""
+    from tensorlink_tpu.models.llama import Llama, LlamaConfig
+    from tensorlink_tpu.ops.quant import quantized_random_init
+
+    cfg = LlamaConfig(
+        vocab_size=64, dim=32, num_layers=2, num_heads=4, num_kv_heads=2,
+        hidden_dim=64, max_len=32,
+    )
+    qp = quantized_random_init(Llama(cfg), KEY, dtype=jnp.float32)
+
+    scales = []
+
+    def walk(t):
+        if isinstance(t, dict):
+            if set(t) == {"q", "s"}:
+                return  # quantized Dense weight: its "s" is NOT a norm gain
+            for k, v in t.items():
+                if k == "scale" and hasattr(v, "shape"):
+                    scales.append(np.asarray(v))
+                else:
+                    walk(v)
+
+    walk(qp)
+    assert scales, "model has no norm gains? key layout changed"
+    for s in scales:
+        np.testing.assert_array_equal(s, np.ones_like(s))

@@ -241,6 +241,36 @@ async def test_unload_releases_capacity():
 
 
 @pytest.mark.asyncio
+async def test_job_shutdown_unloads_stages_and_completes_ledger():
+    """DistributedJob.shutdown(): the master-side teardown the UNLOAD
+    handler existed for (tlint TL202 flagged it as a dead handler —
+    nothing in the package ever sent UNLOAD). Frees every worker's stage
+    state and closes the on-chain job record."""
+    reg, validator, workers, user, v_peer = await _setup_network(2)
+    try:
+        ledger = InMemoryRegistry()
+        m, p = _model()
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer,
+            max_stage_bytes=16 * 32 * 4 + 200,  # 2 stages, 2 workers
+            train={"optimizer": "sgd", "learning_rate": 0.0},
+            chain_registry=ledger, chain_payment_milli=3,
+        )
+        assert job.chain_job_id == 1
+        assert ledger.job_onchain(1)["completed"] is False
+        assert sum(len(w.stages) for w in workers) == 2
+        freed = await job.shutdown()
+        assert freed == 2
+        assert all(len(w.stages) == 0 for w in workers)
+        assert all(w.reserved_bytes == 0 for w in workers)
+        assert ledger.job_onchain(1)["completed"] is True
+        # idempotent: nothing left to free, ledger stays completed
+        assert await job.shutdown() == 0
+    finally:
+        await _teardown(user, validator, *workers)
+
+
+@pytest.mark.asyncio
 async def test_pol_challenge_detects_honest_worker():
     reg, validator, workers, user, v_peer = await _setup_network(1)
     try:

@@ -1,0 +1,470 @@
+"""tlint (tensorlink_tpu.analysis) checker tests.
+
+Every rule gets a fixture pair: a snippet it MUST flag (true positive)
+and a close negative it must leave alone. Plus the package-wide
+integration gate: the analyzer over `tensorlink_tpu/` with the committed
+baseline reports zero unsuppressed findings — the same invocation CI
+runs (tests/test_lint.py).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from tensorlink_tpu.analysis import PackageIndex, run_analysis
+from tensorlink_tpu.analysis.core import (
+    Finding,
+    load_baseline,
+    write_baseline,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(src: str, family: str, path: str = "pkg/mod.py") -> list:
+    index = PackageIndex.from_sources({path: src})
+    return run_analysis(index, families=[family])
+
+
+def rules_of(findings) -> set:
+    return {f.rule for f in findings}
+
+
+# ------------------------------------------------------------ jit hygiene
+def test_tl001_host_sync_in_jit_positive():
+    src = """
+import jax
+import numpy as np
+
+@jax.jit
+def step(x):
+    y = x * 2
+    print(y)
+    loss = float(y.sum())
+    host = np.asarray(y)
+    y.block_until_ready()
+    return y.item()
+"""
+    found = lint(src, "jit_hygiene")
+    assert rules_of(found) == {"TL001"}
+    msgs = " ".join(f.message for f in found)
+    assert "print" in msgs and "float" in msgs and "item" in msgs
+    assert len(found) == 5
+
+
+def test_tl001_negative_outside_jit_and_tracing_safe():
+    src = """
+import jax
+import numpy as np
+
+def host_step(x):
+    # same calls OUTSIDE a traced context: all fine
+    print(x)
+    return float(np.asarray(x).sum())
+
+@jax.jit
+def step(x):
+    jax.debug.print("x={}", x)  # tracing-safe logging
+    return x * 2.0 + int(3)     # int() on a constant is not a sync
+"""
+    assert lint(src, "jit_hygiene") == []
+
+
+def test_tl001_jit_variants_partial_and_wrapped_name():
+    src = """
+import functools as ft
+import jax
+
+@ft.partial(jax.jit, static_argnums=(1,))
+def a(x, n):
+    return x.item()
+
+def b(x):
+    return x.item()
+
+run_b = jax.jit(b)
+
+run_lambda = jax.jit(lambda x: x.item())
+"""
+    found = lint(src, "jit_hygiene")
+    assert len([f for f in found if f.rule == "TL001"]) == 3
+
+
+def test_tl001_scan_body_is_traced():
+    src = """
+import jax
+
+def outer(xs):
+    def body(carry, x):
+        v = float(x)  # concretizes the scan tracer
+        return carry + v, v
+    return jax.lax.scan(body, 0.0, xs)
+"""
+    found = lint(src, "jit_hygiene")
+    assert rules_of(found) == {"TL001"}
+
+
+def test_tl002_state_mutation_positive_and_negative():
+    src = """
+import jax
+
+class Runner:
+    def make(self):
+        @jax.jit
+        def step(x):
+            self.calls += 1      # traced once, never per call
+            self.last = x        # same
+            return x * 2
+        return step
+
+    def fine(self, x):
+        self.calls += 1          # outside any traced body
+        return x
+"""
+    found = lint(src, "jit_hygiene")
+    assert rules_of(found) == {"TL002"}
+    assert len(found) == 2
+
+
+def test_tl003_jit_in_loop_and_fstring_static():
+    src = """
+import jax
+
+def train(fs, xs, tag):
+    outs = []
+    for f in fs:
+        g = jax.jit(f)          # fresh cache every iteration
+        outs.append(g(xs))
+    return outs
+
+fast = jax.jit(lambda x, name: x, static_argnames=("name",))
+
+def call(x, i):
+    return fast(x, f"layer{i}")  # per-string cache key
+"""
+    found = lint(src, "jit_hygiene")
+    assert rules_of(found) == {"TL003"}
+    assert len(found) == 2
+
+
+def test_tl003_negative_hoisted_jit():
+    src = """
+import jax
+
+g = jax.jit(lambda x: x * 2)
+
+def train(xs):
+    return [g(x) for x in xs]
+"""
+    assert lint(src, "jit_hygiene") == []
+
+
+# ---------------------------------------------------------- async safety
+def test_tl101_blocking_calls_positive():
+    src = """
+import asyncio
+import time
+import subprocess
+
+async def handler(self, peer, msg):
+    time.sleep(1.0)
+    subprocess.run(["ls"])
+    with open("/tmp/x") as f:
+        return f.read()
+"""
+    found = lint(src, "async_safety")
+    assert len([f for f in found if f.rule == "TL101"]) == 3
+
+
+def test_tl101_negative_to_thread_and_sync_fn():
+    src = """
+import asyncio
+import time
+
+def sync_helper():
+    time.sleep(1.0)  # not on the event loop's watch
+
+async def handler():
+    await asyncio.sleep(1.0)
+    await asyncio.to_thread(time.sleep, 1.0)  # off-loop: fine
+    await asyncio.to_thread(sync_helper)
+"""
+    assert lint(src, "async_safety") == []
+
+
+def test_tl102_check_then_act_across_await():
+    src = """
+class Node:
+    async def ensure_session(self):
+        if self.session is None:
+            self.session = await self.connect()  # double-init race
+        return self.session
+"""
+    found = lint(src, "async_safety")
+    assert rules_of(found) == {"TL102"}
+
+
+def test_tl102_rmw_spanning_await():
+    src = """
+class Node:
+    async def bump(self):
+        self.total = self.total + await self.fetch_delta()
+"""
+    found = lint(src, "async_safety")
+    assert rules_of(found) == {"TL102"}
+
+
+def test_tl102_negative_lock_held_and_recheck():
+    src = """
+class Node:
+    async def ensure_session(self):
+        async with self._lock:
+            if self.session is None:
+                self.session = await self.connect()
+        return self.session
+
+    async def safe_bump(self):
+        delta = await self.fetch_delta()  # await BEFORE the RMW
+        self.total = self.total + delta
+"""
+    assert lint(src, "async_safety") == []
+
+
+def test_tl103_get_event_loop():
+    src = """
+import asyncio
+
+def make_future():
+    return asyncio.get_event_loop().create_future()
+
+def good():
+    return asyncio.get_running_loop().create_future()
+"""
+    found = lint(src, "async_safety")
+    assert [f.rule for f in found] == ["TL103"]
+
+
+# ------------------------------------------------------------ rpc schema
+_RPC_BASE = """
+class Node:
+    def on(self, t, h): ...
+    async def send(self, peer, msg): ...
+    async def request(self, peer, msg): ...
+"""
+
+
+def test_tl201_sent_without_handler():
+    src = _RPC_BASE + """
+class User(Node):
+    def register_handlers(self):
+        self.on("PONG", self._h_pong)
+
+    async def poke(self, peer):
+        await self.request(peer, {"type": "PINGG"})  # typo: no handler
+"""
+    found = lint(src, "rpc_schema")
+    assert {"TL201"} <= rules_of(found)
+    assert any("PINGG" in f.message for f in found)
+
+
+def test_tl202_dead_handler():
+    src = _RPC_BASE + """
+class User(Node):
+    def register_handlers(self):
+        self.on("NEVER_SENT", self._h_x)
+        self.on("PING", self._h_ping)
+
+    async def poke(self, peer):
+        await self.request(peer, {"type": "PING"})
+"""
+    found = lint(src, "rpc_schema")
+    assert [f.rule for f in found] == ["TL202"]
+    assert "NEVER_SENT" in found[0].message
+
+
+def test_tl2xx_negative_replies_and_helpers_and_named_dicts():
+    src = _RPC_BASE + """
+class Worker(Node):
+    def register_handlers(self):
+        self.on("WORK", self._h_work)
+        self.on("RESULT", self._h_result)
+        self.on("GO_A", self._h_a)
+        self.on("GO_B", self._h_b)
+
+    async def _h_work(self, node, peer, msg):
+        # correlated reply: needs no handler
+        return {"type": "WORK_DONE", "ok": True}
+
+    async def _to_origin(self, msg, payload):
+        await self.send(msg["origin"], {**payload, "job": msg["job"]})
+
+    async def finish(self, msg, blob, backward):
+        # helper send + conditional literal + named dict
+        await self._to_origin(msg, {"type": "RESULT", "data": blob})
+        req = {"type": "GO_B" if backward else "GO_A"}
+        await self.request(msg["origin"], req)
+
+    async def _dispatch(self, peer, msg):
+        reply = {"type": "ERROR", "error": "x"}  # correlated reply
+        reply["re"] = msg["id"]
+        await self.send(peer, reply)
+"""
+    src += """
+class Master(Node):
+    async def kick(self, peer):
+        await self.request(peer, {"type": "WORK"})
+"""
+    assert lint(src, "rpc_schema") == []
+
+
+# ---------------------------------------------------------- api existence
+def test_tl301_missing_self_method():
+    src = """
+class Placer:
+    def place(self, job):
+        return self.select_candidate_worker(job)  # exists nowhere
+
+    def other(self):
+        return 1
+"""
+    found = lint(src, "api_exists")
+    assert [f.rule for f in found] == ["TL301"]
+    assert "select_candidate_worker" in found[0].message
+
+
+def test_tl301_negative_inherited_fields_and_dynamic():
+    src = """
+from dataclasses import dataclass
+
+class Base:
+    def ping(self): ...
+
+class Node(Base):
+    def __init__(self):
+        self.handler = None
+
+    def run(self):
+        self.ping()          # on the base
+        self.handler()       # assigned attribute
+        self.late()          # defined below
+        return self.tag      # attribute READ is not checked
+
+    def late(self): ...
+
+@dataclass
+class Rec:
+    cb: object = None
+    def go(self):
+        return self.cb()     # dataclass field
+
+class Dyn:
+    def __getattr__(self, k): ...
+    def go(self):
+        return self.whatever()  # dynamic surface: skipped
+
+class External(SomeUnknownBase):
+    def go(self):
+        return self.from_base()  # unknowable: skipped
+"""
+    assert lint(src, "api_exists") == []
+
+
+def test_tl302_missing_module_attr():
+    helper = """
+def real():
+    return 1
+"""
+    src = """
+from pkg import helper
+
+def use():
+    helper.real()
+    return helper.totally_missing()
+"""
+    index = PackageIndex.from_sources(
+        {"pkg/helper.py": helper, "pkg/use.py": src}
+    )
+    found = run_analysis(index, families=["api_exists"])
+    assert [f.rule for f in found] == ["TL302"]
+    assert "totally_missing" in found[0].message
+
+
+# ------------------------------------------------- suppression machinery
+def test_inline_disable_comment():
+    src = """
+import asyncio
+
+def f():
+    return asyncio.get_event_loop()  # tlint: disable=TL103
+"""
+    assert lint(src, "async_safety") == []
+
+
+def test_baseline_roundtrip(tmp_path):
+    f = Finding("TL999", "x.py", 3, "msg", symbol="sym")
+    path = tmp_path / "base.json"
+    write_baseline(str(path), [f])
+    assert load_baseline(str(path)) == {f.fingerprint}
+    # fingerprints are line-independent: moving the finding keeps it known
+    moved = Finding("TL999", "x.py", 99, "msg", symbol="sym")
+    assert moved.fingerprint in load_baseline(str(path))
+
+
+# ------------------------------------------------------ integration gate
+def test_package_lints_clean_with_committed_baseline():
+    """The acceptance invocation: zero unsuppressed findings over the
+    package with the committed baseline."""
+    out = subprocess.run(
+        [sys.executable, "-m", "tensorlink_tpu.analysis", "tensorlink_tpu"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert out.returncode == 0, f"tlint findings:\n{out.stdout}\n{out.stderr}"
+
+
+def test_cli_json_format_and_families():
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "tensorlink_tpu.analysis",
+            "tensorlink_tpu/analysis", "--format", "json",
+            "--family", "rpc_schema", "--baseline", "none",
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    data = json.loads(out.stdout)
+    assert data["files"] >= 6
+    assert isinstance(data["findings"], list)
+
+
+def test_cli_exit_one_on_findings(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import asyncio\n\ndef f():\n    return asyncio.get_event_loop()\n"
+    )
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "tensorlink_tpu.analysis", str(bad),
+            "--baseline", "none",
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert out.returncode == 1
+    assert "TL103" in out.stdout
+    # and the baseline workflow accepts it
+    base = tmp_path / "tlint.baseline.json"
+    wb = subprocess.run(
+        [
+            sys.executable, "-m", "tensorlink_tpu.analysis", str(bad),
+            "--baseline", str(base), "--write-baseline",
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert wb.returncode == 0
+    again = subprocess.run(
+        [
+            sys.executable, "-m", "tensorlink_tpu.analysis", str(bad),
+            "--baseline", str(base),
+        ],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+    )
+    assert again.returncode == 0, again.stdout
