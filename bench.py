@@ -903,6 +903,28 @@ def main() -> None:
     if os.environ.get("BENCH_BUBBLE", "1") == "1" and _BERT == "base":
         out["pipeline_bubble"] = measured_bubble_subprocess()
 
+    # -- regression report vs the newest committed BENCH_r*.json: the
+    # per-key deltas tldiag bench-diff computes, embedded in the record
+    # (report only — a slow chip day must not fail the bench; CI policy
+    # reads `regressions` if it wants to gate)
+    try:
+        from tensorlink_tpu.diag import bench_diff, latest_bench_record
+
+        prev = latest_bench_record(os.path.dirname(os.path.abspath(__file__)))
+        if prev is not None:
+            name, rec = prev
+            diff = bench_diff(rec, out, threshold=0.05)
+            out["bench_diff"] = {
+                "against": name,
+                "regressions": {
+                    k: diff["keys"][k] for k in diff["regressions"]
+                },
+                "improvements": diff["improvements"],
+                "keys_compared": len(diff["keys"]),
+            }
+    except Exception as e:  # noqa: BLE001 — must not sink the headline
+        out["bench_diff_error"] = str(e)[:200]
+
     base = read_recorded_baseline()
     out["vs_baseline"] = round(samples_per_sec_per_chip / base, 3) if base else 1.0
     # the round-1 denominator was measured with per-call dispatch overhead

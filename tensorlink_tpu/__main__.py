@@ -65,6 +65,10 @@ def _add_node_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--dht-snapshot", default=None, metavar="PATH",
                    help="persist DHT state to PATH periodically (and "
                         "restore from it on start)")
+    p.add_argument("--postmortem-dir", default=None, metavar="DIR",
+                   help="write a post-mortem JSON bundle (flight events, "
+                        "spans, metrics, config) into DIR on unhandled "
+                        "crash or SIGTERM")
     # multi-HOST mesh formation (SURVEY §2.4/§5.8): all processes of one
     # slice join a single JAX runtime; jax.devices() then spans hosts and
     # ShardedTrainer programs compile over the global mesh
@@ -109,6 +113,15 @@ async def _run_role(role: str, args) -> None:
     # off_chain=False (set in _node_cfg from --chain-url/--chain-contract)
     node = cls(_node_cfg(args, role), **kw)
     await node.start()
+    if args.postmortem_dir:
+        # black box: unhandled crash / SIGTERM dumps events + spans +
+        # metrics + config + versions as one JSON bundle
+        from tensorlink_tpu.runtime.flight import install_crash_handler
+
+        install_crash_handler(
+            args.postmortem_dir, recorder=node.flight, tracer=node.tracer,
+            metrics=node.metrics, config=node.cfg,
+        )
     validator_peer = None
     if args.bootstrap:
         host, port = args.bootstrap.rsplit(":", 1)

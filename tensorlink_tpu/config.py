@@ -116,6 +116,12 @@ class TrainConfig:
     # storage dtype of adam/adamw m+v ("bfloat16" halves optimizer-state
     # bytes and HBM traffic; update math stays f32 — train/optim.py)
     opt_moment_dtype: str = "float32"
+    # non-finite sentinel (runtime/flight.py): Trainer._step always
+    # reports a ``nonfinite`` flag in its stats; with this set, a step
+    # whose loss/grads are non-finite leaves params, optimizer moments,
+    # and the step counter UNCHANGED (the anomaly is still counted in
+    # train_nonfinite_total and recorded as a flight event)
+    skip_nonfinite_updates: bool = False
 
     def __post_init__(self):
         # validated HERE so BOTH trainers (train/trainer.py Trainer and
@@ -197,6 +203,13 @@ class NodeConfig:
     # cadence of the validator's cached-registry refresh (serves the
     # non-blocking is_validator_local gate on the event loop)
     registry_refresh_s: float = 30.0
+    # health sentinel loop (runtime/flight.py): event-loop lag probe,
+    # watchdog trip-edge checks, memory watermark gauges
+    health_interval_s: float = 1.0
+    # a placed job whose train_step has not COMPLETED within this
+    # deadline flips the master's /healthz unhealthy (armed on the first
+    # step, disarmed by DistributedJob.shutdown); None disables
+    step_watchdog_s: float | None = 300.0
 
     def __post_init__(self):
         # wire serialization (msgpack/json) round-trips tuples as lists;

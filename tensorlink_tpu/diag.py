@@ -1,0 +1,439 @@
+"""tldiag — cluster-wide diagnostics over the node status endpoints.
+
+``python -m tensorlink_tpu.diag`` (console script: ``tldiag``) scrapes
+``/healthz``, ``/metrics`` (JSON + Prometheus), ``/spans``, ``/events``,
+and ``/node`` from a list of node status ports into ONE diagnostic
+bundle, prints a cluster health table (dead/unhealthy nodes, stale
+heartbeats, stragglers, anomaly counts), and diffs ``BENCH_r*.json``
+pairs for step-time/throughput regressions:
+
+    tldiag scrape 127.0.0.1:8080 worker-1:8080 -o bundle.json
+    tldiag table bundle.json
+    tldiag bench-diff BENCH_r04.json BENCH_r05.json --threshold 0.05
+
+Dependency-free in itself (stdlib + asyncio sockets — the same
+dependency posture as the StatusServer it scrapes) and never touches an
+accelerator, so it runs on an operator laptop against a remote cluster.
+The scraping API is async (``scrape_cluster``) so in-process tests can
+drive it against live asyncio nodes without deadlocking the shared
+event loop.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import re
+import sys
+import time
+from typing import Any
+
+# every node serves these (http_status.py); /jobs exists only on
+# validators and is fetched opportunistically
+ROUTES = ("/healthz", "/metrics", "/metrics?format=prom", "/spans",
+          "/events", "/node", "/jobs")
+
+
+# ------------------------------------------------------------- scraping
+async def http_get(
+    host: str, port: int, path: str, timeout: float = 5.0
+) -> tuple[int, bytes]:
+    """Minimal HTTP/1.1 GET -> (status, body). Raises OSError/timeout
+    for unreachable targets — callers turn that into a DEAD row."""
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout
+    )
+    try:
+        writer.write(
+            f"GET {path} HTTP/1.1\r\nHost: {host}\r\n"
+            "Connection: close\r\n\r\n".encode()
+        )
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(-1), timeout)
+    finally:
+        writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    parts = head.split()
+    if len(parts) < 2 or not parts[1].isdigit():
+        raise ConnectionError(f"malformed response from {host}:{port}")
+    return int(parts[1]), body
+
+
+def parse_target(target: str) -> tuple[str, int]:
+    """'host:port' or bare 'port' (localhost)."""
+    host, _, port = target.rpartition(":")
+    return (host or "127.0.0.1"), int(port)
+
+
+async def scrape_node(target: str, timeout: float = 5.0) -> dict[str, Any]:
+    """All routes of one node -> {"target", "routes": {...}, "error"?}.
+    A node that answers ANY route is alive; one that answers none is
+    recorded with the connection error (the bundle must name dead nodes,
+    not skip them)."""
+    host, port = parse_target(target)
+    out: dict[str, Any] = {"target": target, "routes": {}}
+    for path in ROUTES:
+        try:
+            status, body = await http_get(host, port, path, timeout)
+        except (OSError, asyncio.TimeoutError, ConnectionError) as e:
+            out["routes"][path] = {"error": f"{type(e).__name__}: {e}"}
+            if path == "/healthz":  # first route failing = probably dead
+                out["error"] = f"{type(e).__name__}: {e}"
+            continue
+        rec: dict[str, Any] = {"status": status}
+        if "format=prom" in path:
+            rec["text"] = body.decode(errors="replace")
+        else:
+            try:
+                rec["body"] = json.loads(body) if body else None
+            except ValueError:
+                rec["text"] = body.decode(errors="replace")[:2000]
+        out["routes"][path] = rec
+    if all("error" in r for r in out["routes"].values()):
+        out["error"] = out.get("error") or "unreachable"
+    return out
+
+
+async def scrape_cluster(
+    targets: list[str], timeout: float = 5.0
+) -> dict[str, Any]:
+    """One bundle over every target, scraped concurrently."""
+    nodes = await asyncio.gather(
+        *(scrape_node(t, timeout) for t in targets)
+    )
+    return {
+        "collected_at": time.time(),
+        "targets": list(targets),
+        "nodes": list(nodes),
+    }
+
+
+# ------------------------------------------------------- health table
+# anomaly counters surfaced per row (from each node's /metrics counters)
+ANOMALY_COUNTERS = (
+    "train_nonfinite_total",
+    "peer_dropped_total",
+    "dispatch_errors_total",
+)
+
+
+def _route_body(scrape: dict, path: str) -> Any:
+    return (scrape.get("routes", {}).get(path) or {}).get("body")
+
+
+def node_row(
+    scrape: dict,
+    stale_heartbeat_s: float = 30.0,
+    skew_threshold: float = 1.5,
+) -> dict[str, Any]:
+    """One cluster-table row from one node's scrape."""
+    row: dict[str, Any] = {
+        "target": scrape.get("target"),
+        "role": "?",
+        "node_id": "?",
+        "healthy": None,
+        "reasons": "",
+        "peers": None,
+        "max_heartbeat_age_s": None,
+        "skew": None,
+        "anomalies": {},
+        "error_events": 0,
+        "flags": [],
+    }
+    if scrape.get("error"):
+        row["flags"].append("DEAD")
+        row["reasons"] = scrape["error"]
+        return row
+    hz = scrape.get("routes", {}).get("/healthz") or {}
+    body = hz.get("body") or {}
+    row["healthy"] = hz.get("status") == 200 and bool(body.get("ok", True))
+    if not row["healthy"]:
+        row["flags"].append("UNHEALTHY")
+        row["reasons"] = "; ".join(
+            f"{k}: {v}" for k, v in (body.get("reasons") or {}).items()
+        )
+    node = _route_body(scrape, "/node") or {}
+    row["role"] = node.get("role", "?")
+    row["node_id"] = str(node.get("node_id", "?"))[:16]
+    peers = node.get("peers") or {}
+    row["peers"] = len(peers)
+    ages = [
+        p.get("last_seen_age_s")
+        for p in peers.values()
+        if isinstance(p, dict) and p.get("last_seen_age_s") is not None
+    ]
+    if ages:
+        row["max_heartbeat_age_s"] = round(max(ages), 1)
+        if max(ages) > stale_heartbeat_s:
+            row["flags"].append("STALE-HEARTBEAT")
+    stragglers = node.get("stragglers") or {}
+    skew = stragglers.get("skew")
+    if skew is not None:
+        row["skew"] = round(float(skew), 2)
+        if float(skew) > skew_threshold:
+            row["flags"].append(
+                f"STRAGGLER(stage {stragglers.get('slowest_stage')})"
+            )
+    metrics = _route_body(scrape, "/metrics") or {}
+    counters = metrics.get("counters") or {}
+    row["anomalies"] = {
+        k: counters[k] for k in ANOMALY_COUNTERS if counters.get(k)
+    }
+    if row["anomalies"]:
+        row["flags"].append("ANOMALIES")
+    events = (_route_body(scrape, "/events") or {}).get("events") or []
+    row["error_events"] = sum(1 for e in events if e.get("severity") == "error")
+    return row
+
+
+def cluster_table(
+    bundle: dict,
+    stale_heartbeat_s: float = 30.0,
+    skew_threshold: float = 1.5,
+) -> list[dict[str, Any]]:
+    return [
+        node_row(s, stale_heartbeat_s, skew_threshold)
+        for s in bundle.get("nodes", [])
+    ]
+
+
+def render_table(rows: list[dict[str, Any]]) -> str:
+    cols = ("target", "role", "node_id", "healthy", "peers",
+            "max_heartbeat_age_s", "skew", "error_events", "flags")
+    titles = ("TARGET", "ROLE", "NODE", "OK", "PEERS", "HB-AGE",
+              "SKEW", "ERR-EVTS", "FLAGS")
+
+    def cell(row: dict, col: str) -> str:
+        v = row.get(col)
+        if col == "flags":
+            extra = ",".join(
+                f"{k}={n}" for k, n in (row.get("anomalies") or {}).items()
+            )
+            return ",".join(v or []) + (f" [{extra}]" if extra else "") or "-"
+        if v is None:
+            return "-"
+        return str(v)
+
+    table = [titles] + [[cell(r, c) for c in cols] for r in rows]
+    widths = [max(len(line[i]) for line in table) for i in range(len(cols))]
+    lines = [
+        "  ".join(c.ljust(w) for c, w in zip(line, widths)).rstrip()
+        for line in table
+    ]
+    unhealthy = [
+        r for r in rows if r["flags"] or r["healthy"] is False
+    ]
+    for r in unhealthy:
+        if r.get("reasons"):
+            lines.append(f"  !! {r['target']}: {r['reasons']}")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------- bench diffing
+# key fragments that say which way "good" points; everything else is
+# reported as a delta without a regression verdict
+_HIGHER_BETTER = (
+    "samples_per_sec", "tokens_per_sec", "mfu", "speedup", "throughput",
+    "fraction_attained", "vs_baseline", "tick_over_dispatch",
+)
+_LOWER_BETTER_RE = re.compile(
+    r"(_s$|_s_per_call$|seconds|latency|bubble_fraction|drop_fraction)"
+)
+
+
+def _direction(key: str) -> str | None:
+    k = key.lower()
+    leaf = k.rsplit(".", 1)[-1]
+    if leaf == "value" or any(t in k for t in _HIGHER_BETTER):
+        return "higher"
+    if _LOWER_BETTER_RE.search(leaf):
+        return "lower"
+    return None
+
+
+def _flatten_numeric(d: Any, prefix: str = "") -> dict[str, float]:
+    out: dict[str, float] = {}
+    if isinstance(d, dict):
+        for k, v in d.items():
+            out.update(_flatten_numeric(v, f"{prefix}.{k}" if prefix else str(k)))
+    elif isinstance(d, bool):
+        pass  # bools are not measurements
+    elif isinstance(d, (int, float)) and prefix:
+        out[prefix] = float(d)
+    return out
+
+
+def _bench_payload(rec: dict) -> dict:
+    """Committed BENCH_r*.json wraps the bench's JSON line under
+    ``parsed`` (driver metadata around it); accept the wrapper, the raw
+    bench output, and — when ``parsed`` is null — the bench line
+    embedded in the captured ``tail`` text."""
+    inner = rec.get("parsed")
+    if isinstance(inner, dict):
+        return inner
+    tail = rec.get("tail")
+    if isinstance(tail, str):
+        for line in reversed(tail.strip().splitlines()):
+            i = line.find('{"metric"')
+            if i >= 0:
+                try:
+                    return json.loads(line[i:])
+                except ValueError:
+                    break  # front-truncated tail: unrecoverable
+    return rec
+
+
+def bench_diff(
+    old: dict, new: dict, threshold: float = 0.05
+) -> dict[str, Any]:
+    """Per-key relative deltas between two bench records (BENCH_r*.json
+    shape). A key regresses when it moved AGAINST its direction by more
+    than ``threshold`` (5% default); direction-less keys only report.
+    This is a report, never a failure — CI policy belongs to the
+    caller."""
+    a = _flatten_numeric(_bench_payload(old))
+    b = _flatten_numeric(_bench_payload(new))
+    keys: dict[str, Any] = {}
+    regressions: list[str] = []
+    improvements: list[str] = []
+    for k in sorted(set(a) & set(b)):
+        if a[k] == 0:
+            continue  # no meaningful relative delta
+        delta = (b[k] - a[k]) / abs(a[k])
+        direction = _direction(k)
+        rec = {
+            "old": a[k],
+            "new": b[k],
+            "delta_frac": round(delta, 4),
+            "direction": direction,
+        }
+        if direction is not None and abs(delta) > threshold:
+            worse = delta < 0 if direction == "higher" else delta > 0
+            rec["regression"] = worse
+            (regressions if worse else improvements).append(k)
+        keys[k] = rec
+    return {
+        "threshold": threshold,
+        "keys": keys,
+        "regressions": regressions,
+        "improvements": improvements,
+        "only_old": sorted(set(a) - set(b)),
+        "only_new": sorted(set(b) - set(a)),
+    }
+
+
+def render_bench_diff(diff: dict) -> str:
+    lines = [
+        f"bench diff (threshold {diff['threshold']:.0%}): "
+        f"{len(diff['regressions'])} regression(s), "
+        f"{len(diff['improvements'])} improvement(s)"
+    ]
+    for k in diff["regressions"]:
+        r = diff["keys"][k]
+        lines.append(
+            f"  REGRESSION {k}: {r['old']:g} -> {r['new']:g} "
+            f"({r['delta_frac']:+.1%})"
+        )
+    for k in diff["improvements"]:
+        r = diff["keys"][k]
+        lines.append(
+            f"  improved   {k}: {r['old']:g} -> {r['new']:g} "
+            f"({r['delta_frac']:+.1%})"
+        )
+    return "\n".join(lines)
+
+
+def latest_bench_record(root: str) -> tuple[str, dict] | None:
+    """Newest USABLE committed BENCH_r*.json under ``root`` (descending
+    round order; a round whose payload has no headline value or recorded
+    an error — failed run, truncated capture — is skipped so bench.py
+    never diffs a real run against noise). Returns (filename, record)
+    or None."""
+    import os
+
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return None
+    rounds = sorted(
+        (
+            (int(m.group(1)), name)
+            for name in names
+            if (m := re.fullmatch(r"BENCH_r(\d+)\.json", name))
+        ),
+        reverse=True,
+    )
+    for _, name in rounds:
+        try:
+            with open(os.path.join(root, name)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        payload = _bench_payload(rec)
+        if payload.get("value") and "error" not in payload:
+            return name, rec
+    return None
+
+
+# ------------------------------------------------------------------ CLI
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tldiag",
+        description="cluster diagnostics over node status endpoints",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sc = sub.add_parser("scrape", help="collect a diagnostic bundle")
+    sc.add_argument("targets", nargs="+", metavar="HOST:PORT")
+    sc.add_argument("-o", "--out", default=None,
+                    help="write the full bundle JSON here")
+    sc.add_argument("--timeout", type=float, default=5.0)
+    sc.add_argument("--stale-heartbeat-s", type=float, default=30.0)
+    sc.add_argument("--skew-threshold", type=float, default=1.5)
+    tb = sub.add_parser("table", help="health table from a saved bundle")
+    tb.add_argument("bundle", help="bundle JSON from `tldiag scrape -o`")
+    tb.add_argument("--stale-heartbeat-s", type=float, default=30.0)
+    tb.add_argument("--skew-threshold", type=float, default=1.5)
+    bd = sub.add_parser(
+        "bench-diff", help="flag regressions between two BENCH_r*.json"
+    )
+    bd.add_argument("old")
+    bd.add_argument("new")
+    bd.add_argument("--threshold", type=float, default=0.05,
+                    help="relative delta beyond which a directional key "
+                         "counts as moved (default 5%%)")
+    bd.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the full diff as JSON")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "scrape":
+        bundle = asyncio.run(scrape_cluster(args.targets, args.timeout))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(bundle, f)
+            print(f"bundle written: {args.out}", file=sys.stderr)
+        rows = cluster_table(
+            bundle, args.stale_heartbeat_s, args.skew_threshold
+        )
+        print(render_table(rows))
+        return 0
+    if args.cmd == "table":
+        with open(args.bundle) as f:
+            bundle = json.load(f)
+        print(render_table(cluster_table(
+            bundle, args.stale_heartbeat_s, args.skew_threshold
+        )))
+        return 0
+    if args.cmd == "bench-diff":
+        with open(args.old) as f:
+            old = json.load(f)
+        with open(args.new) as f:
+            new = json.load(f)
+        diff = bench_diff(old, new, args.threshold)
+        print(json.dumps(diff) if args.as_json else render_bench_diff(diff))
+        return 0
+    return 2  # pragma: no cover — argparse enforces the subcommands
+
+
+if __name__ == "__main__":
+    sys.exit(main())

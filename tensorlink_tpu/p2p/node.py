@@ -98,6 +98,14 @@ class Node:
         # spans propagate to peers through the _trace envelope field
         self.tracer = Tracer(service=f"{cfg.role}:{self.node_id[:8]}")
         self._trace_ctx = current_trace_context  # hot-path binding (send)
+        from tensorlink_tpu.runtime.flight import FlightRecorder, HealthState
+
+        # black box (runtime/flight.py): ring of lifecycle/failure events
+        # published via GET /events; health computed from watchdogs +
+        # readiness conditions, served as a truthful GET /healthz
+        self.flight = FlightRecorder(service=f"{cfg.role}:{self.node_id[:8]}")
+        self.health = HealthState(self.flight)
+        self._traffic_dog = None  # armed by start_heartbeat
         self.register_handlers()
 
     # ------------------------------------------------------------ lifecycle
@@ -149,8 +157,30 @@ class Node:
         if self.cfg.dht_snapshot_path:
             self._restore_dht_snapshot()
             self._spawn(self._dht_snapshot_loop())
+        self._spawn(self._health_loop())
         self.started.set()
+        self.flight.record(
+            "node_started", host=self.cfg.host, port=self.port,
+            role=self.role,
+        )
         self.log.info("listening on %s:%s", self.cfg.host, self.port)
+
+    async def _health_loop(self) -> None:
+        """Sentinel tick: event-loop lag probe (the overshoot of a timed
+        sleep IS the lag every other coroutine experienced), watchdog
+        trip-edge checks (events fire between scrapes, not only when
+        /healthz is polled), and memory watermark gauges."""
+        from tensorlink_tpu.runtime.flight import sample_memory_watermarks
+
+        interval = self.cfg.health_interval_s
+        loop = asyncio.get_running_loop()
+        while not self._stopping:
+            t0 = loop.time()
+            await asyncio.sleep(interval)
+            self.health.note_loop_lag(max(0.0, loop.time() - t0 - interval))
+            self.metrics.observe("event_loop_lag_s", self.health.loop_lag_s)
+            self.health.check_watchdogs()
+            sample_memory_watermarks(self.metrics)
 
     # ------------------------------------------------------ NAT traversal
     # (reference: miniupnpc IGD mapping at node start, smart_node.py:787-816)
@@ -542,6 +572,10 @@ class Node:
         self.peers[info.node_id] = peer
         self.dht.table.add(info)
         self._spawn(self._recv_loop(peer))
+        self.flight.record(
+            "peer_joined", peer=info.node_id[:16], role=info.role,
+            replaced=old is not None,
+        )
         self.log.info("peer %s (%s) connected", info.node_id[:8], info.role)
         return peer
 
@@ -714,6 +748,8 @@ class Node:
                     continue
                 peer.msgs_in += 1
                 peer.last_seen = time.time()
+                if self._traffic_dog is not None:
+                    self._traffic_dog.kick()  # any inbound frame = traffic
                 self.metrics.incr("msgs_in")
                 # only known types get their own counter: a peer spraying
                 # random type strings must not grow the registry (and the
@@ -759,6 +795,11 @@ class Node:
                 reply = await handler(self, peer, msg)
         except Exception as e:  # noqa: BLE001
             self.log.warning("handler %s failed: %s", msg["type"], e)
+            self.metrics.incr("dispatch_errors_total")
+            self.flight.record(
+                "dispatch_error", "error", type=str(msg.get("type")),
+                peer=peer.node_id[:16], error=str(e)[:200],
+            )
             reply = {"type": "ERROR", "error": str(e)}
         if reply is not None and "id" in msg:
             reply.setdefault("type", "RESPONSE")
@@ -780,6 +821,10 @@ class Node:
                 del self._streams[sid]
         if self.peers.get(peer.node_id) is peer:
             del self.peers[peer.node_id]
+            self.flight.record(
+                "peer_lost", "warn", peer=peer.node_id[:16], role=peer.role,
+                last_seen_age_s=round(time.time() - peer.last_seen, 3),
+            )
             # fail in-flight requests to the dead peer immediately instead
             # of letting them ride out the full request timeout
             for mid, target in list(self._pending_peer.items()):
@@ -849,6 +894,14 @@ class Node:
         misses `max_misses` consecutive beats is dropped via on_peer_lost.
         The reference's only liveness signal was a manual ping and socket
         errors (survey §5.3); this catches silent hangs too."""
+        # peer-traffic watchdog: trips when NO peer produced a frame for
+        # a whole eviction window — the node is isolated (or its network
+        # is), which a per-peer drop alone cannot say. Kicked by every
+        # inbound frame and by beat rounds with nothing to monitor.
+        self._traffic_dog = self.health.watchdog(
+            "peer_traffic", interval_s * (max_misses + 1)
+        )
+        self._traffic_dog.arm()
         self._spawn(self._heartbeat_loop(interval_s, timeout_s, max_misses))
 
     async def _heartbeat_loop(
@@ -868,12 +921,24 @@ class Node:
                         "peer %s missed %d heartbeats, dropping",
                         peer.node_id[:8], n,
                     )
+                    # the eviction used to be silent (log line only):
+                    # count it and record the black-box event BEFORE the
+                    # drop, so the peer_dropped -> peer_lost order in
+                    # /events reads as cause -> effect
+                    self.metrics.incr("peer_dropped_total")
+                    self.flight.record(
+                        "peer_dropped", "warn", peer=peer.node_id[:16],
+                        role=peer.role, missed_beats=n,
+                    )
                     peer.stream.close()
                     self._drop_peer(peer)
                     misses.pop(peer.node_id, None)
 
         while not self._stopping:
             await asyncio.sleep(interval_s)
+            if not self.peers and self._traffic_dog is not None:
+                # nothing to monitor: an idle node is not unhealthy
+                self._traffic_dog.kick()
             # concurrent: one hung peer must not delay liveness checks for
             # the rest (a round is bounded by one timeout, not k of them)
             await asyncio.gather(*(beat(p) for p in list(self.peers.values())))
@@ -999,3 +1064,14 @@ class Node:
         from tensorlink_tpu.runtime.tracing import straggler_report
 
         return straggler_report(self.metrics, self.peers)
+
+    def postmortem(self, path: str, reason: str = "manual") -> str:
+        """Dump this node's black box (events + spans + metrics +
+        config + versions) to ``path`` — the same bundle the crash
+        handler writes, callable on a live node."""
+        from tensorlink_tpu.runtime.flight import write_postmortem
+
+        return write_postmortem(
+            path, reason, recorder=self.flight, tracer=self.tracer,
+            metrics=self.metrics, config=self.cfg,
+        )

@@ -135,6 +135,7 @@ class ShardedTrainer:
         loss_reduction: str = "uniform_mean",
         tracer=None,
         metrics=None,
+        flight=None,
     ):
         """``loss_reduction`` declares how loss_fn reduces over the batch:
 
@@ -167,6 +168,14 @@ class ShardedTrainer:
                 # num_stages is derived further down — read the mesh here
                 {"stages": mesh.shape["pipe"], "micros": cfg.micro_batches},
             )
+        # same contract as train/trainer.py: telemetry-enabled trainers
+        # account non-finite steps (counter + flight event); the in-jit
+        # flag is in stats either way
+        if flight is None and (tracer is not None or metrics is not None):
+            from tensorlink_tpu.runtime.flight import default_recorder
+
+            flight = default_recorder()
+        self.flight = flight
         if loss_reduction not in ("uniform_mean", "batch_normalized"):
             raise ValueError(
                 f"unknown loss_reduction {loss_reduction!r}; declare "
@@ -497,6 +506,16 @@ class ShardedTrainer:
             from tensorlink_tpu.nn.lora import mask_to_lora
 
             grads = mask_to_lora(grads)
+        # non-finite sentinel, BEFORE clipping (an inf leaf turns the
+        # clip norm nan and poisons every grad — the flag must name the
+        # raw anomaly); mirrors train/trainer.py so skip_nonfinite_updates
+        # is honored by BOTH trainers, not silently ignored here
+        grads_finite = jax.tree_util.tree_reduce(
+            lambda a, g: a & jnp.isfinite(g).all(),
+            grads,
+            jnp.array(True),
+        )
+        nonfinite = ~(jnp.isfinite(loss) & grads_finite)
         if self.cfg.grad_clip_norm:
             grads, gnorm = clip_by_global_norm(grads, self.cfg.grad_clip_norm)
         else:
@@ -509,9 +528,20 @@ class ShardedTrainer:
 
             updates = mask_to_lora(updates)
         params = apply_updates(state.params, updates)
+        new_state = TrainState(
+            params=params, opt_state=opt_state, step=state.step + 1
+        )
+        if self.cfg.skip_nonfinite_updates:
+            # select the OLD state wholesale (params, moments, step): a
+            # poisoned batch must leave no trace in the model
+            new_state = jax.tree.map(
+                lambda new, old: jnp.where(nonfinite, old, new),
+                new_state,
+                state,
+            )
         return (
-            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
-            {"loss": loss, "grad_norm": gnorm},
+            new_state,
+            {"loss": loss, "grad_norm": gnorm, "nonfinite": nonfinite},
         )
 
     def train_step(self, state: TrainState, batch, rng=None):
@@ -532,7 +562,21 @@ class ShardedTrainer:
         # all_to_all dispatch, nn/moe.py) can engage; everything else is
         # unaffected (all axes here are Auto outside the pipe shard_map).
         with cm, jax.set_mesh(self.mesh):
-            return self._step_fn(state, batch, rng)
+            state, stats = self._step_fn(state, batch, rng)
+        # host-side anomaly accounting rides ONLY the telemetry path —
+        # bool() forces a device sync (same tradeoff as train/trainer.py)
+        if self._telemetry is not None and bool(stats.get("nonfinite", False)):
+            if self.metrics is not None:
+                self.metrics.incr("train_nonfinite_total")
+            if self.flight is not None:
+                self.flight.record(
+                    "train_nonfinite",
+                    "error",
+                    step=int(state.step),
+                    loss=float(stats["loss"]),
+                    skipped=self.cfg.skip_nonfinite_updates,
+                )
+        return state, stats
 
     def eval_fn(self, state: TrainState, batch):
         if self._eval_fn is None:

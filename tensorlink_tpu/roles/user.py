@@ -239,6 +239,24 @@ class DistributedJob:
         # a train seed (MODULE_SPEC train.seed), so eval-only jobs and
         # old records keep today's deterministic behavior.
         self.train_mode = True
+        # health sentinels (runtime/flight.py): the master's /healthz
+        # reflects THIS job — a dead stage peer sets a readiness
+        # condition (cleared on recovery), and a step watchdog trips
+        # when train_step stops completing (armed on the first step,
+        # disarmed by shutdown)
+        self._step_dog = None
+        if user.cfg.step_watchdog_s:
+            self._step_dog = user.health.watchdog(
+                f"job_step:{job.job_id[:16]}",
+                user.cfg.step_watchdog_s,
+                armed=False,
+            )
+        user._register_job(self)
+        user.flight.record(
+            "job_placed", job_id=job.job_id[:16], stages=job.n_stages,
+            dp=job.dp_factor, relay=self.relay,
+            workers=[st.peer.node_id[:16] for st in stages],
+        )
 
     def train(self, mode: bool = True) -> None:
         """Fan train/eval mode out to subsequent forward passes."""
@@ -467,6 +485,30 @@ class DistributedJob:
             for p in {st.peer.node_id: st.peer for st in self.stages}.values()
         )))
         await self.complete_onchain()
+        if self.validator is not None:
+            # tell the validator the job is over (best-effort) so it can
+            # clear any placement-degraded readiness condition — a job
+            # whose dead worker was never replaced because the user
+            # finished instead must not pin the validator at 503
+            try:
+                await self.user.request(
+                    self.validator,
+                    {"type": "JOB_UPDATE", "job_id": self.job.job_id,
+                     "done": True},
+                    timeout=timeout,
+                )
+            except (ConnectionError, asyncio.TimeoutError, OSError):
+                pass
+        if self._step_dog is not None:
+            # remove, not disarm: a long-lived master places many jobs
+            # and must not accumulate one dead dog per job (review)
+            self.user.health.remove_watchdog(self._step_dog.name)
+            self._step_dog = None
+        self.user.health.clear_conditions(f"job:{self.job.job_id[:16]}")
+        self.user._unregister_job(self)
+        self.user.flight.record(
+            "job_shutdown", job_id=self.job.job_id[:16], stages_freed=freed,
+        )
         return freed
 
     async def train_step(
@@ -483,12 +525,18 @@ class DistributedJob:
         last-known params re-shipped), and retries — the recovery the
         reference stubs out with empty timeout bodies (survey §5.3).
         """
+        if self._step_dog is not None and not self._step_dog.armed:
+            self._step_dog.arm()  # first step starts the deadline clock
         for attempt in range(self.max_step_retries + 1):
             try:
-                return await self._try_train_step(batch_x, loss_grad_fn)
+                loss = await self._try_train_step(batch_x, loss_grad_fn)
             except (ConnectionError, asyncio.TimeoutError, RuntimeError) as e:
                 if attempt == self.max_step_retries or self.validator is None:
                     raise
+                self.user.flight.record(
+                    "step_retry", "warn", job_id=self.job.job_id[:16],
+                    step=self.step, attempt=attempt, error=str(e)[:200],
+                )
                 acked = await self._abort_step()
                 await self.recover_dead_stages(
                     aborted=acked,
@@ -496,6 +544,10 @@ class DistributedJob:
                     # only consistent restart point is the shared snapshot
                     rollback_all=isinstance(e, StepEndFailure),
                 )
+                continue
+            if self._step_dog is not None:
+                self._step_dog.kick()
+            return loss
         raise AssertionError("unreachable")
 
     async def forward(self, batch_x: np.ndarray) -> np.ndarray:
@@ -774,6 +826,12 @@ class DistributedJob:
                 self.validator.node_id[:8] if self.validator else "?",
                 peer.node_id[:8],
             )
+            self.user.flight.record(
+                "validator_failover", "warn",
+                job_id=self.job.job_id[:16],
+                dead=(self.validator.node_id[:16] if self.validator else "?"),
+                new=peer.node_id[:16],
+            )
             self.validator = peer
             return
         raise RuntimeError(f"no replica validator reachable ({last})")
@@ -825,6 +883,14 @@ class DistributedJob:
             for s in self.stages
         ]
         self.stages.sort(key=lambda s: (s.replica, s.index))
+        self.user.flight.record(
+            "stage_recovered", job_id=self.job.job_id[:16], stage=index,
+            replica=replica, dead=dead_id[:16], new=placement["node_id"][:16],
+        )
+        # the slot points at a live worker again: readiness restored
+        self.user.health.clear_condition(
+            f"job:{self.job.job_id[:16]}:stage{index}.{replica}"
+        )
         if ship:
             await self._ship_stage(st)
         return st
@@ -972,6 +1038,34 @@ class UserNode(Node):
         self._relay_waiters: dict[tuple, tuple[str, set, asyncio.Future]] = {}
         self.on("RELAY_RESULT", self._h_relay_result)
         self.on("RELAY_ERROR", self._h_relay_result)
+        # live DistributedJob handles by job_id: on_peer_lost consults
+        # them so a dead stage worker degrades /healthz immediately
+        # (readiness condition + flight event), not only when the next
+        # train_step happens to fail
+        self._jobs: dict[str, DistributedJob] = {}
+
+    def _register_job(self, job: "DistributedJob") -> None:
+        self._jobs[job.job.job_id] = job
+
+    def _unregister_job(self, job: "DistributedJob") -> None:
+        self._jobs.pop(job.job.job_id, None)
+
+    def on_peer_lost(self, peer: Peer) -> None:
+        for dj in list(self._jobs.values()):
+            jid = dj.job.job_id[:16]
+            for st in dj.stages:
+                if st.peer.node_id != peer.node_id:
+                    continue
+                self.flight.record(
+                    "stage_peer_lost", "error", job_id=jid,
+                    stage=st.index, replica=st.replica,
+                    worker=peer.node_id[:16],
+                )
+                self.health.set_condition(
+                    f"job:{jid}:stage{st.index}.{st.replica}",
+                    f"stage {st.index} replica {st.replica} worker "
+                    f"{peer.node_id[:8]} lost",
+                )
 
     # ------------------------------------------------- relay result intake
     def relay_waiter(self, key: tuple, expected: str, members: set) -> asyncio.Future:

@@ -46,6 +46,32 @@ class ValidatorNode(Node):
         self.jobs: dict[str, JobRecord] = {}
         self.job_state: dict[str, dict] = {}  # job_id -> {loss, accuracy,...}
 
+    def on_peer_lost(self, peer: Peer) -> None:
+        """A dead worker that holds live placements degrades every job
+        it serves: flight event + readiness condition per job, cleared
+        when REPLACE_WORKER lands a substitute. /healthz on this
+        validator then answers 'can the jobs I placed actually run'."""
+        hit = []
+        for jid, job in self.jobs.items():
+            slots = [
+                {"stage": int(w.get("stage", -1)),
+                 "replica": int(w.get("replica", 0))}
+                for w in (job.workers or [])
+                if w and w.get("node_id") == peer.node_id
+            ]
+            if slots:
+                hit.append((jid, slots))
+        for jid, slots in hit:
+            self.flight.record(
+                "placed_worker_lost", "error", job_id=jid[:16],
+                worker=peer.node_id[:16], slots=slots,
+            )
+            self.health.set_condition(
+                f"job:{jid[:16]}",
+                f"placed worker {peer.node_id[:8]} lost "
+                f"(slots {[(s['stage'], s['replica']) for s in slots]})",
+            )
+
     async def start(self) -> None:
         await super().start()
         if self.registry is not None:
@@ -276,6 +302,13 @@ class ValidatorNode(Node):
         st.update(dict(msg.get("state") or {}))
         st["replicated_from"] = peer.node_id
         st["replicated_at"] = time.time()
+        # a replication push means the seed just (re)placed this job —
+        # any degradation we flagged for its old placement is answered
+        # by the fresh record (a still-dead slot would have blocked the
+        # replacement, and the seed would not have pushed). Without this
+        # a REPLICA validator stayed 503 forever: the REPLACE_WORKER
+        # that clears the seed's condition never reaches it (review).
+        self.health.clear_condition(f"job:{job.job_id[:16]}")
         return {"type": "JOB_REPLICATED", "job_id": job.job_id}
 
     async def _h_job_req(self, node, peer, msg) -> dict:
@@ -311,10 +344,15 @@ class ValidatorNode(Node):
                         await self._recruit_stage(job, i, stats, taken, replica=r)
                     )
         if any(p is None for p in placements):
+            unplaced = [i for i, p in enumerate(placements) if p is None]
+            self.flight.record(
+                "job_declined", "warn", job_id=job.job_id[:16],
+                author=job.author[:16], reason="unplaceable",
+                slots=unplaced,
+            )
             return {
                 "type": "DECLINE_JOB",
-                "reason": f"could not place stage slots "
-                f"{[i for i, p in enumerate(placements) if p is None]}",
+                "reason": f"could not place stage slots {unplaced}",
             }
         job.workers = placements
         self.job_state[job.job_id] = {"created": time.time(), "updates": 0}
@@ -329,6 +367,11 @@ class ValidatorNode(Node):
         self.jobs[job.job_id] = job
         await self.dht_store(f"job:{job.job_id}", job.to_wire())
         self._spawn(self._replicate_job(job))
+        self.flight.record(
+            "job_accepted", job_id=job.job_id[:16], author=job.author[:16],
+            stages=job.n_stages, dp=job.dp_factor,
+            workers=[(p or {}).get("node_id", "")[:16] for p in placements],
+        )
         return {
             "type": "ACCEPT_JOB",
             "job_id": job.job_id,
@@ -338,7 +381,11 @@ class ValidatorNode(Node):
 
     async def _h_job_update(self, node, peer, msg) -> dict:
         """Loss/accuracy aggregation (reference stubs this:
-        validator.py:329-331)."""
+        validator.py:329-331). ``done: true`` marks the job finished
+        (sent by DistributedJob.shutdown): a torn-down job's placement
+        can no longer be degraded, so its readiness condition clears —
+        without this a worker that died and was never replaced (because
+        the user finished instead) kept this validator 503 forever."""
         jid = str(msg["job_id"])
         st = self.job_state.setdefault(jid, {"updates": 0})
         for k in ("loss", "accuracy", "step"):
@@ -346,6 +393,11 @@ class ValidatorNode(Node):
                 st[k] = msg[k]
         st["updates"] += 1
         st["last_update"] = time.time()
+        if msg.get("done") and self.jobs.get(jid, None) is not None:
+            if peer.node_id == self.jobs[jid].author:  # author-only
+                st["done"] = True
+                self.health.clear_condition(f"job:{jid[:16]}")
+                self.flight.record("job_done", job_id=jid[:16])
         return {"type": "JOB_UPDATED"}
 
     async def _h_job_info(self, node, peer, msg) -> dict:
@@ -411,6 +463,10 @@ class ValidatorNode(Node):
             job, stage_index, stats, taken, replica=replica
         )
         if placement is None:
+            self.flight.record(
+                "worker_replace_failed", "error", job_id=jid[:16],
+                stage=stage_index, replica=replica,
+            )
             return {"type": "ERROR", "error": "no replacement available"}
         job.workers[slot] = placement
         await self.dht_store(f"job:{jid}", job.to_wire())
@@ -419,6 +475,18 @@ class ValidatorNode(Node):
             {"stage": stage_index, "replica": replica,
              "new": placement["node_id"], "at": time.time()}
         )
+        self.flight.record(
+            "worker_replaced", job_id=jid[:16], stage=stage_index,
+            replica=replica, new=placement["node_id"][:16],
+        )
+        if all(
+            w and w.get("node_id") in self.peers for w in job.workers
+        ):
+            # every slot points at a connected worker again: the
+            # degradation on_peer_lost flagged is over — /healthz goes
+            # back to ready for this job (another still-dead slot keeps
+            # the condition until ITS replacement lands)
+            self.health.clear_condition(f"job:{jid[:16]}")
         # placement changed: refresh the sibling replicas so a later
         # seed-validator loss hands the user a CURRENT record. The reply
         # names this validator's replica set so a user that failed over
@@ -597,6 +665,13 @@ class ValidatorNode(Node):
     ) -> dict:
         st = self.job_state.setdefault(job_id, {})
         st.setdefault("audits", []).append(record)
+        self.flight.record(
+            "audit",
+            "error" if record.get("passed") is False else "info",
+            job_id=job_id[:16], worker=wid[:16],
+            stage=record.get("stage"), passed=record.get("passed"),
+            reason=record.get("reason"),
+        )
         if record.get("passed") is False:
             self.dht.put_local(f"rep:{wid}", 0.0)
             if self.registry is not None:

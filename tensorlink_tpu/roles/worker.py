@@ -494,6 +494,22 @@ class WorkerNode(Node):
         self._reservations: dict[tuple[str, int], tuple[int, float, str]] = {}
         self.training = False
 
+    def on_peer_lost(self, peer: Peer) -> None:
+        """A lost job OWNER strands this worker's loaded stages: until
+        the master reattaches (same identity) or the reservation-style
+        teardown frees them, capacity is pinned — worth a black-box
+        event when diagnosing 'why did the worker refuse offers'."""
+        orphaned = [
+            {"job_id": jid[:16], "stage": idx}
+            for (jid, idx), r in self.stages.items()
+            if r.owner == peer.node_id
+        ]
+        if orphaned:
+            self.flight.record(
+                "stage_owner_lost", "warn", owner=peer.node_id[:16],
+                stages=orphaned,
+            )
+
     @property
     def reserved_bytes(self) -> int:
         now = time.time()
@@ -669,6 +685,11 @@ class WorkerNode(Node):
             # pre-dial chain neighbors so the first relay hop finds a live
             # connection (same initiator election as replicas)
             self._spawn(self._preconnect(neighbors))
+        self.flight.record(
+            "stage_loaded", job_id=runner.job_id[:16],
+            stage=runner.stage_index, replica=runner.replica,
+            owner=runner.owner[:16], param_bytes=tree_bytes(params),
+        )
         return {
             "type": "LOADED",
             "job_id": runner.job_id,
@@ -1229,6 +1250,10 @@ class WorkerNode(Node):
             return runner
         runner.fence = max(runner.fence, int(msg.get("fence", runner.fence + 1)))
         runner.reset_step()
+        self.flight.record(
+            "step_aborted", "warn", job_id=runner.job_id[:16],
+            stage=runner.stage_index, fence=runner.fence, step=runner.step,
+        )
         return {"type": "STEP_ABORTED", "step": runner.step, "fence": runner.fence}
 
     async def _h_params_request(self, node, peer, msg) -> dict:
@@ -1312,6 +1337,11 @@ class WorkerNode(Node):
         for k in res_removed:
             del self._reservations[k]
         self.training = bool(self.stages)
+        if removed or res_removed:
+            self.flight.record(
+                "stage_unloaded", job_id=jid[:16], stages=len(removed),
+                reservations=len(res_removed),
+            )
         return {"type": "UNLOADED", "job_id": jid, "stages": len(removed)}
 
     async def _h_pol_challenge(self, node, peer, msg) -> dict:

@@ -1,3 +1,11 @@
+from tensorlink_tpu.runtime.flight import (  # noqa: F401
+    FlightRecorder,
+    HealthState,
+    Watchdog,
+    default_recorder,
+    install_crash_handler,
+    write_postmortem,
+)
 from tensorlink_tpu.runtime.mesh import MeshRuntime, make_mesh  # noqa: F401
 from tensorlink_tpu.runtime.metrics import (  # noqa: F401
     Histogram,

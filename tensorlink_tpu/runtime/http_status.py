@@ -11,7 +11,13 @@ node can serve status: a dependency-free asyncio HTTP/1.1 responder with
     GET /jobs     -> validator job table         (when the node has one)
     GET /spans    -> tracer span buffer as Chrome-trace JSON
                      (open in Perfetto / chrome://tracing)
-    GET /healthz  -> {"ok": true}
+    GET /events   -> flight-recorder ring buffer (runtime/flight.py)
+                     ?kind= &min_severity= &since=<seq> &limit=
+    GET /healthz  -> node.health.report(): 200 {"ok": true, ...} when
+                     healthy, 503 + {"ok": false, "reasons": {...}}
+                     when a watchdog tripped / a readiness condition is
+                     set / the event loop lags (truthful liveness +
+                     readiness, not a hardcoded constant)
 
 Read only, bound to the node's host; HEAD is answered with headers only.
 Every response carries ``Cache-Control: no-store`` — a proxy caching
@@ -24,6 +30,16 @@ import asyncio
 import json
 from typing import Any, Callable
 from urllib.parse import parse_qsl
+
+
+class Response:
+    """Handler return type for a non-200 status (the /healthz 503)."""
+
+    __slots__ = ("status", "body")
+
+    def __init__(self, status: str, body: Any):
+        self.status = status
+        self.body = body
 
 
 class StatusServer:
@@ -47,10 +63,35 @@ class StatusServer:
         JSON-serializable object, or ``(content_type, text)`` for
         non-JSON payloads (the Prometheus exposition)."""
         node = self.node
+
+        def healthz(q: dict):
+            health = getattr(node, "health", None)
+            if health is None:
+                return {"ok": True}  # health-less nodes stay r1-shaped
+            rep = health.report()
+            if rep["ok"]:
+                return rep  # 200, "ok": true preserved (additive keys)
+            return Response("503 Service Unavailable", rep)
+
         routes: dict[str, Callable[[dict], Any]] = {
-            "/healthz": lambda q: {"ok": True},
+            "/healthz": healthz,
             "/node": lambda q: node.status(),
         }
+        flight = getattr(node, "flight", None)
+        if flight is not None:
+
+            def events_route(q: dict):
+                return {
+                    "service": flight.service,
+                    "events": flight.events(
+                        kind=q.get("kind"),
+                        min_severity=q.get("min_severity"),
+                        since=int(q["since"]) if "since" in q else None,
+                        limit=int(q["limit"]) if "limit" in q else None,
+                    ),
+                }
+
+            routes["/events"] = events_route
         metrics = getattr(node, "metrics", None)
         if metrics is not None:
 
@@ -113,6 +154,8 @@ class StatusServer:
                     status, body = "500 Internal Server Error", {
                         "error": type(e).__name__
                     }
+            if isinstance(body, Response):  # handler-chosen status
+                status, body = body.status, body.body
             if isinstance(body, tuple):  # (content_type, text) non-JSON
                 ctype, payload = body[0], body[1].encode()
             else:

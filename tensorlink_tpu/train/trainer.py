@@ -72,6 +72,7 @@ class Trainer:
         donate: bool = True,
         tracer=None,
         metrics=None,
+        flight=None,
     ):
         self.module = module
         self.loss_fn = loss_fn
@@ -83,6 +84,15 @@ class Trainer:
         # same timeline
         self.tracer = tracer
         self.metrics = metrics
+        # flight recorder (runtime/flight.py): non-finite loss/grad
+        # anomalies become black-box events. Telemetry-enabled trainers
+        # default to the process recorder — the host-side stats read the
+        # anomaly check needs is only paid when telemetry is on anyway.
+        if flight is None and (tracer is not None or metrics is not None):
+            from tensorlink_tpu.runtime.flight import default_recorder
+
+            flight = default_recorder()
+        self.flight = flight
         self._telemetry = None
         if tracer is not None or metrics is not None:
             from tensorlink_tpu.runtime.tracing import StepTelemetry
@@ -167,6 +177,16 @@ class Trainer:
             from tensorlink_tpu.nn.lora import mask_to_lora
 
             grads = mask_to_lora(grads)
+        # non-finite sentinel, in-jit and BEFORE clipping (clipping a
+        # tree with an inf leaf turns the norm nan and poisons every
+        # grad — the flag must name the raw anomaly): one all-reduce
+        # over grad leaves + the loss scalar, no host sync here
+        grads_finite = jax.tree_util.tree_reduce(
+            lambda a, g: a & jnp.isfinite(g).all(),
+            grads,
+            jnp.array(True),
+        )
+        nonfinite = ~(jnp.isfinite(loss) & grads_finite)
         if self.cfg.grad_clip_norm:
             grads, gnorm = clip_by_global_norm(grads, self.cfg.grad_clip_norm)
         else:
@@ -180,7 +200,20 @@ class Trainer:
             updates = mask_to_lora(updates)
         params = apply_updates(state.params, updates)
         new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
-        return new_state, {"loss": loss, "grad_norm": gnorm}
+        if self.cfg.skip_nonfinite_updates:
+            # select the OLD state wholesale (params, moments, step): a
+            # poisoned batch must leave no trace in the model — not even
+            # an optimizer-moment update or a schedule tick
+            new_state = jax.tree.map(
+                lambda new, old: jnp.where(nonfinite, old, new),
+                new_state,
+                state,
+            )
+        return new_state, {
+            "loss": loss,
+            "grad_norm": gnorm,
+            "nonfinite": nonfinite,
+        }
 
     def _eval(self, params, batch, rng):
         return self._loss_for_grad(params, batch, rng)
@@ -198,7 +231,23 @@ class Trainer:
         if self._telemetry is None:
             return self._train_step(state, batch, rng)
         with self._telemetry.step(batch, rng):
-            return self._train_step(state, batch, rng)
+            state, stats = self._train_step(state, batch, rng)
+        # host-side anomaly accounting. bool() forces a device sync, so
+        # it rides ONLY the telemetry path — an uninstrumented trainer
+        # keeps the fully-async dispatch (the in-jit flag is still in
+        # stats for callers that want it)
+        if bool(stats.get("nonfinite", False)):
+            if self.metrics is not None:
+                self.metrics.incr("train_nonfinite_total")
+            if self.flight is not None:
+                self.flight.record(
+                    "train_nonfinite",
+                    "error",
+                    step=int(state.step),
+                    loss=float(stats["loss"]),
+                    skipped=self.cfg.skip_nonfinite_updates,
+                )
+        return state, stats
 
     def eval_loss(self, state: TrainState, batch, rng=None):
         return self._eval_step(state.params, batch, rng)

@@ -1,0 +1,310 @@
+"""tldiag (tensorlink_tpu/diag.py): bench diffing, cluster health table,
+and the end-to-end acceptance scenario — kill a worker mid-job and watch
+the black box light up on every surviving node."""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.config import NodeConfig
+from tensorlink_tpu.diag import (
+    bench_diff,
+    cluster_table,
+    latest_bench_record,
+    main,
+    node_row,
+    render_bench_diff,
+    render_table,
+    scrape_cluster,
+    scrape_node,
+)
+
+# ------------------------------------------------------------ bench diff
+
+
+def test_bench_diff_directions_and_threshold():
+    old = {
+        "value": 1000.0, "mfu": 0.50, "decode_tokens_per_sec": 10000.0,
+        "step_seconds": 0.10, "flops_per_step_xla": 1e12,
+        "roofline": {"t_compute_floor_s": 0.02},
+    }
+    new = {
+        "value": 900.0,              # -10% throughput -> regression
+        "mfu": 0.51,                 # +2% -> inside threshold, no verdict
+        "decode_tokens_per_sec": 12000.0,  # +20% -> improvement
+        "step_seconds": 0.13,        # +30% time -> regression
+        "flops_per_step_xla": 2e12,  # direction-less -> report only
+        "roofline": {"t_compute_floor_s": 0.02},
+    }
+    d = bench_diff(old, new, threshold=0.05)
+    assert set(d["regressions"]) == {"value", "step_seconds"}
+    assert d["improvements"] == ["decode_tokens_per_sec"]
+    assert d["keys"]["value"]["delta_frac"] == pytest.approx(-0.1)
+    assert d["keys"]["flops_per_step_xla"]["direction"] is None
+    assert "regression" not in d["keys"]["mfu"]
+    text = render_bench_diff(d)
+    assert "REGRESSION value" in text and "improved" in text
+
+
+def test_bench_diff_unwraps_committed_wrapper():
+    """BENCH_r*.json wraps the bench line under `parsed` (or, when the
+    driver failed to parse, leaves it in the captured `tail`)."""
+    payload = {"metric": "m", "value": 100.0}
+    wrapped = {"n": 4, "rc": 0, "parsed": payload}
+    tailed = {
+        "n": 5, "rc": 0, "parsed": None,
+        "tail": "noise line\n" + json.dumps({"metric": "m", "value": 80.0}),
+    }
+    d = bench_diff(wrapped, tailed, threshold=0.05)
+    assert d["keys"]["value"]["old"] == 100.0
+    assert d["keys"]["value"]["new"] == 80.0
+    assert d["regressions"] == ["value"]
+
+
+def test_latest_bench_record_skips_unusable(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"parsed": {"metric": "m", "value": 50.0}})
+    )
+    # newer but unusable: errored run, then a zero-value run
+    (tmp_path / "BENCH_r02.json").write_text(
+        json.dumps({"parsed": {"value": 0.0, "error": "backend down"}})
+    )
+    (tmp_path / "BENCH_r03.json").write_text("not json")
+    got = latest_bench_record(str(tmp_path))
+    assert got is not None and got[0] == "BENCH_r01.json"
+    assert latest_bench_record(str(tmp_path / "missing")) is None
+
+
+def test_cli_bench_diff_and_table(tmp_path, capsys):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps({"metric": "m", "value": 100.0}))
+    b.write_text(json.dumps({"metric": "m", "value": 80.0}))
+    assert main(["bench-diff", str(a), str(b), "--threshold", "0.1"]) == 0
+    out = capsys.readouterr().out
+    assert "REGRESSION value" in out
+    assert main(["bench-diff", str(a), str(b), "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["regressions"] == ["value"]
+
+    bundle = tmp_path / "bundle.json"
+    bundle.write_text(json.dumps({
+        "nodes": [{"target": "10.0.0.1:8080", "error": "ConnectionRefused"}]
+    }))
+    assert main(["table", str(bundle)]) == 0
+    out = capsys.readouterr().out
+    assert "DEAD" in out and "10.0.0.1:8080" in out
+
+
+def test_node_row_flags_synthetic():
+    dead = node_row({"target": "x:1", "error": "refused"})
+    assert dead["flags"] == ["DEAD"] and dead["healthy"] is None
+    sick = node_row({
+        "target": "x:2",
+        "routes": {
+            "/healthz": {"status": 503, "body": {
+                "ok": False, "reasons": {"watchdog:job_step": "stalled"},
+            }},
+            "/node": {"status": 200, "body": {
+                "role": "user", "node_id": "u" * 64,
+                "peers": {"w" * 16: {"last_seen_age_s": 99.0}},
+                "stragglers": {"skew": 3.0, "slowest_stage": 1},
+            }},
+            "/metrics": {"status": 200, "body": {
+                "counters": {"train_nonfinite_total": 2},
+            }},
+            "/events": {"status": 200, "body": {"events": [
+                {"kind": "watchdog_trip", "severity": "error"},
+            ]}},
+        },
+    }, stale_heartbeat_s=30.0)
+    assert "UNHEALTHY" in sick["flags"]
+    assert "STALE-HEARTBEAT" in sick["flags"]
+    assert any(f.startswith("STRAGGLER") for f in sick["flags"])
+    assert "ANOMALIES" in sick["flags"]
+    assert sick["anomalies"] == {"train_nonfinite_total": 2}
+    assert sick["error_events"] == 1
+    text = render_table([dead, sick])
+    assert "watchdog:job_step" in text  # reasons surfaced under the table
+
+
+# ----------------------------------------------------------- live scrape
+
+
+@pytest.mark.asyncio
+async def test_scrape_live_node_routes():
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    node = WorkerNode(NodeConfig(role="worker", host="127.0.0.1", port=0,
+                                 http_status_port=0))
+    await node.start()
+    try:
+        node.metrics.incr("steps")  # empty registries export no prom lines
+        scrape = await scrape_node(f"127.0.0.1:{node._http.bound_port}")
+        assert "error" not in scrape
+        assert scrape["routes"]["/healthz"]["status"] == 200
+        assert scrape["routes"]["/node"]["body"]["node_id"] == node.node_id
+        assert "traceEvents" in scrape["routes"]["/spans"]["body"]
+        assert scrape["routes"]["/events"]["body"]["events"]
+        assert "tensorlink" in scrape["routes"]["/metrics?format=prom"]["text"]
+        row = node_row(scrape)
+        assert row["healthy"] is True and row["flags"] == []
+    finally:
+        await node.stop()
+
+
+# ------------------------------------------------------------ acceptance
+
+
+@pytest.mark.asyncio
+async def test_worker_death_flips_health_events_and_tldiag_table():
+    """ISSUE 4 acceptance: kill a worker mid-job. The user AND validator
+    /healthz flip unhealthy with reasons, /events carries the peer-drop
+    and watchdog events, and a tldiag bundle's cluster table flags the
+    dead node."""
+    from tensorlink_tpu.models.mlp import MLP, MLPConfig
+    from tensorlink_tpu.roles.registry import InMemoryRegistry
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    def cfg(role, **kw):
+        return NodeConfig(role=role, host="127.0.0.1", port=0,
+                          http_status_port=0, health_interval_s=0.1, **kw)
+
+    reg = InMemoryRegistry()
+    validator = ValidatorNode(cfg("validator"), registry=reg)
+    await validator.start()
+    workers = []
+    for _ in range(2):
+        w = WorkerNode(cfg("worker"))
+        await w.start()
+        await w.connect("127.0.0.1", validator.port)
+        workers.append(w)
+    user = UserNode(cfg("user", step_watchdog_s=0.6))
+    await user.start()
+    v_peer = await user.connect("127.0.0.1", validator.port)
+
+    m = MLP(MLPConfig(in_dim=16, hidden_dim=32, out_dim=4, num_layers=2))
+    p = m.init(jax.random.key(0))
+    victim = None
+    try:
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer,
+            max_stage_bytes=16 * 32 * 4 + 200,  # -> 2 stages, no spare
+            micro_batches=2,
+            train={"optimizer": "sgd", "learning_rate": 0.05},
+        )
+        assert user.flight.events(kind="job_placed")
+        assert validator.flight.events(kind="job_accepted")
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 16)).astype(np.float32)
+        w_true = rng.normal(size=(16, 4))
+        y = np.argmax(x @ w_true, -1)
+
+        def loss_grad(logits, micro):
+            lj = jnp.asarray(logits)
+            yj = jnp.asarray(np.array_split(y, 2)[micro])
+
+            def f(logit):
+                logz = jax.nn.logsumexp(logit, axis=-1)
+                ll = jnp.take_along_axis(logit, yj[:, None], axis=-1)[..., 0]
+                return jnp.mean(logz - ll)
+
+            val, g = jax.value_and_grad(f)(lj)
+            return float(val), np.asarray(g)
+
+        await job.train_step(x, loss_grad)  # arms + kicks the step dog
+        st, _ = await _healthz(user)
+        assert st == 200
+
+        # ---- kill the stage-1 worker mid-job (no spare to recruit)
+        victim_id = job.stages[1].peer.node_id
+        victim = next(w for w in workers if w.node_id == victim_id)
+        victim_http = victim._http.bound_port
+        await victim.stop()
+        await asyncio.sleep(0.3)  # EOF -> on_peer_lost on user+validator
+
+        # the next step cannot recover (no replacement worker): it fails,
+        # and from then on no step completes -> the step watchdog trips
+        with pytest.raises((RuntimeError, ConnectionError)):
+            await job.train_step(x, loss_grad)
+        await asyncio.sleep(1.0)
+
+        # ---- user /healthz: 503 with the stage condition + watchdog
+        st, body = await _healthz(user)
+        assert st == 503 and body["ok"] is False
+        jid = job.job.job_id[:16]
+        assert any(
+            k.startswith(f"condition:job:{jid}:stage1") for k in body["reasons"]
+        ), body["reasons"]
+        assert f"watchdog:job_step:{jid}" in body["watchdogs"] or any(
+            k.startswith("watchdog:job_step") for k in body["reasons"]
+        )
+
+        # ---- validator /healthz: 503, its placed worker is gone
+        st, body = await _healthz(validator)
+        assert st == 503 and any(
+            k.startswith("condition:job:") for k in body["reasons"]
+        )
+
+        # ---- /events on the user: peer-drop + watchdog + lifecycle
+        kinds = {e["kind"] for e in user.flight.events()}
+        assert {"peer_lost", "stage_peer_lost", "watchdog_trip",
+                "job_placed", "step_retry"} <= kinds, kinds
+        assert {"placed_worker_lost", "job_accepted"} <= {
+            e["kind"] for e in validator.flight.events()
+        }
+
+        # ---- tldiag: scrape the cluster (dead node's port included)
+        survivor = next(w for w in workers if w.node_id != victim_id)
+        targets = [
+            f"127.0.0.1:{user._http.bound_port}",
+            f"127.0.0.1:{validator._http.bound_port}",
+            f"127.0.0.1:{survivor._http.bound_port}",
+            f"127.0.0.1:{victim_http}",
+        ]
+        bundle = await scrape_cluster(targets, timeout=3.0)
+        assert bundle["targets"] == targets
+        rows = cluster_table(bundle)
+        by_target = {r["target"]: r for r in rows}
+        assert "DEAD" in by_target[f"127.0.0.1:{victim_http}"]["flags"]
+        assert "UNHEALTHY" in by_target[f"127.0.0.1:{user._http.bound_port}"]["flags"]
+        assert "UNHEALTHY" in by_target[
+            f"127.0.0.1:{validator._http.bound_port}"
+        ]["flags"]
+        assert by_target[f"127.0.0.1:{survivor._http.bound_port}"][
+            "healthy"
+        ] is True
+        text = render_table(rows)
+        assert "DEAD" in text and "UNHEALTHY" in text
+        # the bundle carries the black box itself, not just verdicts
+        user_scrape = bundle["nodes"][0]
+        ev_kinds = {
+            e["kind"]
+            for e in user_scrape["routes"]["/events"]["body"]["events"]
+        }
+        assert "stage_peer_lost" in ev_kinds and "watchdog_trip" in ev_kinds
+    finally:
+        live = [user, validator] + [
+            w for w in workers if w is not victim
+        ]
+        for n in live:
+            await n.stop()
+
+
+async def _healthz(node) -> tuple[int, dict]:
+    reader, writer = await asyncio.open_connection(
+        "127.0.0.1", node._http.bound_port
+    )
+    writer.write(b"GET /healthz HTTP/1.1\r\n\r\n")
+    await writer.drain()
+    raw = await reader.read(1 << 20)
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split()[1]), json.loads(body) if body else {}

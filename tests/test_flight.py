@@ -1,0 +1,512 @@
+"""Flight recorder, health sentinels, post-mortem bundles (runtime/
+flight.py) and their wiring: truthful /healthz, /events, heartbeat-drop
+accounting, and the trainer's in-jit non-finite sentinel."""
+
+import asyncio
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.config import NodeConfig, TrainConfig
+from tensorlink_tpu.runtime.flight import (
+    FlightRecorder,
+    HealthState,
+    Watchdog,
+    sample_memory_watermarks,
+    write_postmortem,
+)
+from tensorlink_tpu.runtime.metrics import Metrics
+
+
+async def _http_get(host: str, port: int, path: str) -> tuple[int, dict]:
+    reader, writer = await asyncio.open_connection(host, port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    raw = await reader.read(1 << 20)
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split()[1]), json.loads(body) if body else {}
+
+
+# ------------------------------------------------------------- recorder
+
+
+def test_flight_recorder_ring_and_filters():
+    r = FlightRecorder("svc", max_events=3)
+    for i in range(5):
+        r.record("tick", x=i)
+    r.record("boom", "error", why="bad")
+    # bounded: oldest evicted, order preserved; totals keep counting
+    assert len(r) == 3
+    assert [e["attrs"].get("x") for e in r.events()] == [3, 4, None]
+    assert r.counts["tick"] == 5 and r.counts["boom"] == 1
+    # filters: kind, min_severity, since (seq-exclusive), limit
+    assert [e["kind"] for e in r.events(kind="boom")] == ["boom"]
+    assert [e["kind"] for e in r.events(min_severity="error")] == ["boom"]
+    last_seq = r.events()[-1]["seq"]
+    assert r.events(since=last_seq) == []
+    assert len(r.events(limit=2)) == 2
+    # non-JSON attrs are stringified at record time, never at serve time
+    r.record("obj", thing=object(), nested={"k": {1, 2}})
+    ev = r.events(kind="obj")[0]
+    json.dumps(ev)  # must not raise
+    assert isinstance(ev["attrs"]["thing"], str)
+    with pytest.raises(ValueError, match="severity"):
+        r.record("x", "fatal")
+
+
+def test_flight_recorder_thread_safety_smoke():
+    import threading
+
+    r = FlightRecorder("svc", max_events=64)
+
+    def spam(i):
+        for _ in range(200):
+            r.record("t", i=i)
+
+    ts = [threading.Thread(target=spam, args=(i,)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(r) == 64 and r.counts["t"] == 800
+
+
+# ------------------------------------------------------------ watchdogs
+
+
+def test_watchdog_trip_edge_and_rearm():
+    r = FlightRecorder("svc")
+    dog = Watchdog("step", deadline_s=0.05, recorder=r)
+    assert dog.check()
+    time.sleep(0.08)
+    assert not dog.check()
+    assert not dog.check()  # still tripped, but only ONE trip event
+    assert len(r.events(kind="watchdog_trip")) == 1
+    dog.kick()  # recovery event + healthy again
+    assert dog.check()
+    assert len(r.events(kind="watchdog_recovered")) == 1
+    # disarmed dogs never trip; arm() restarts the clock cleanly
+    dog.disarm()
+    time.sleep(0.08)
+    assert dog.check()
+    dog.arm()
+    assert dog.check() and not dog.tripped
+
+
+def test_health_state_report_and_conditions():
+    r = FlightRecorder("svc")
+    h = HealthState(r)
+    assert h.report()["ok"]
+    h.set_condition("job:x:stage1", "worker dead")
+    rep = h.report()
+    assert not rep["ok"] and not rep["ready"] and rep["live"]
+    assert "condition:job:x:stage1" in rep["reasons"]
+    assert r.events(kind="health_degraded")
+    # duplicate set: reason updates, no second degraded event
+    h.set_condition("job:x:stage1", "still dead")
+    assert len(r.events(kind="health_degraded")) == 1
+    h.clear_conditions("job:x")
+    assert h.report()["ok"] and r.events(kind="health_restored")
+    # watchdog integration + loop lag
+    dog = h.watchdog("hb", 0.01)
+    time.sleep(0.03)
+    rep = h.report()
+    assert "watchdog:hb" in rep["reasons"]
+    dog.kick()
+    h.note_loop_lag(5.0)
+    rep = h.report()
+    assert "event_loop_lag" in rep["reasons"]
+    h.note_loop_lag(0.0)
+    assert h.report()["ok"]
+    # retired dogs vanish from the report entirely (no per-job buildup)
+    h.remove_watchdog("hb")
+    assert "hb" not in h.report()["watchdogs"]
+
+
+def test_memory_watermarks_sampled_into_metrics():
+    m = Metrics()
+    out = sample_memory_watermarks(m)
+    # host gauges exist on any Linux/psutil host; jax is loaded in this
+    # suite so HBM gauges appear whenever the backend reports limits
+    assert "host_mem_used_frac" in out
+    snap = m.snapshot()
+    assert 0.0 <= snap["host_mem_used_frac"]["last"] <= 1.0
+
+
+# ----------------------------------------------------------- post-mortem
+
+
+def test_write_postmortem_bundle(tmp_path):
+    from tensorlink_tpu.runtime.tracing import Tracer
+
+    r = FlightRecorder("svc")
+    r.record("peer_dropped", "warn", peer="abcd")
+    t = Tracer("svc")
+    with t.span("work"):
+        pass
+    m = Metrics()
+    m.observe("loss", 1.0)
+    cfg = NodeConfig(role="worker")
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError as e:
+        path = write_postmortem(
+            str(tmp_path / "pm.json"), "unhandled RuntimeError",
+            recorder=r, tracer=t, metrics=m, config=cfg, exc=e,
+        )
+    with open(path) as f:
+        bundle = json.load(f)
+    assert bundle["reason"] == "unhandled RuntimeError"
+    assert bundle["versions"]["python"] and bundle["versions"]["jax"]
+    assert bundle["events"][0]["kind"] == "peer_dropped"
+    assert bundle["spans"][0]["name"] == "work"
+    assert bundle["metrics"]["loss"]["last"] == 1.0
+    assert bundle["config"]["role"] == "worker"
+    assert "RuntimeError: boom" in bundle["exception"]
+
+
+def test_install_crash_handler_excepthook(tmp_path):
+    from tensorlink_tpu.runtime.flight import install_crash_handler
+
+    r = FlightRecorder("svc")
+    r.record("last_words", note="it was the DNS")
+    seen = []
+    prev = sys.excepthook
+    sys.excepthook = lambda *a: seen.append(a)
+    uninstall = install_crash_handler(
+        str(tmp_path), recorder=r, signals=()
+    )
+    try:
+        exc = ValueError("crash")
+        sys.excepthook(ValueError, exc, None)
+        bundles = list(tmp_path.glob("postmortem-*.json"))
+        assert len(bundles) == 1
+        with open(bundles[0]) as f:
+            bundle = json.load(f)
+        assert bundle["reason"] == "unhandled ValueError"
+        assert bundle["events"][0]["kind"] == "last_words"
+        assert seen, "previous excepthook must still run"
+    finally:
+        uninstall()
+        assert sys.excepthook is not prev  # our lambda restored...
+        sys.excepthook = prev
+
+
+# -------------------------------------------------- node + http wiring
+
+
+@pytest.mark.asyncio
+async def test_healthz_truthful_and_events_route():
+    """Satellite: /healthz consults node.health (503 + reasons when
+    unhealthy, 200 with ok=true preserved when healthy) and /events
+    serves the flight ring with filters."""
+    from tensorlink_tpu.p2p.node import Node
+
+    node = Node(NodeConfig(role="user", host="127.0.0.1", port=0,
+                           http_status_port=0, health_interval_s=0.1))
+    await node.start()
+    try:
+        port = node._http.bound_port
+        st, body = await _http_get("127.0.0.1", port, "/healthz")
+        assert st == 200 and body["ok"] is True and body["ready"] is True
+        node.health.set_condition("stage0", "worker dead")
+        st, body = await _http_get("127.0.0.1", port, "/healthz")
+        assert st == 503 and body["ok"] is False
+        assert "condition:stage0" in body["reasons"]
+        node.health.clear_condition("stage0")
+        st, body = await _http_get("127.0.0.1", port, "/healthz")
+        assert st == 200 and body["ok"] is True
+
+        st, body = await _http_get("127.0.0.1", port, "/events")
+        kinds = [e["kind"] for e in body["events"]]
+        assert "node_started" in kinds and "health_degraded" in kinds
+        st, body = await _http_get(
+            "127.0.0.1", port, "/events?kind=health_degraded&limit=1"
+        )
+        assert [e["kind"] for e in body["events"]] == ["health_degraded"]
+        seq = body["events"][-1]["seq"]
+        st, body = await _http_get(
+            "127.0.0.1", port, f"/events?since={seq}&kind=health_degraded"
+        )
+        assert body["events"] == []
+        # the health loop ticked: loop-lag gauge + memory watermarks live
+        await asyncio.sleep(0.35)
+        snap = node.metrics.snapshot()
+        assert "event_loop_lag_s" in snap
+        assert "host_mem_used_frac" in snap
+    finally:
+        await node.stop()
+
+
+@pytest.mark.asyncio
+async def test_heartbeat_eviction_counts_and_records():
+    """Satellite: the heartbeat eviction increments peer_dropped_total
+    and records a flight event with peer id + missed-beat count (it used
+    to be a log line only), and the isolated node's peer-traffic
+    watchdog trips."""
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    a = UserNode(NodeConfig(role="user", host="127.0.0.1", port=0,
+                            health_interval_s=0.1))
+    b = WorkerNode(NodeConfig(role="worker", host="127.0.0.1", port=0))
+    await a.start()
+    await b.start()
+    try:
+        peer = await a.connect("127.0.0.1", b.port)
+
+        async def hang(node, p, msg):
+            await asyncio.sleep(10)
+
+        b._handlers["PING"] = hang  # silent hang, socket stays open
+        a.start_heartbeat(interval_s=0.1, timeout_s=0.2, max_misses=2)
+        await asyncio.sleep(1.2)
+        assert peer.node_id not in a.peers
+        assert a.metrics.counters["peer_dropped_total"] == 1
+        evs = a.flight.events(kind="peer_dropped")
+        assert len(evs) == 1
+        assert evs[0]["attrs"]["peer"] == peer.node_id[:16]
+        assert evs[0]["attrs"]["missed_beats"] == 2
+        # the generic connection-loss event rides along
+        assert a.flight.events(kind="peer_lost")
+        # while the hung peer was the ONLY peer, no frame arrived for a
+        # whole eviction window: the peer-traffic watchdog tripped (the
+        # black box keeps the evidence) — and once the dead peer is
+        # evicted the node is idle, not unhealthy, so it re-armed
+        trips = a.flight.events(kind="watchdog_trip")
+        assert trips and trips[0]["attrs"]["watchdog"] == "peer_traffic"
+        await asyncio.sleep(0.3)
+        assert a.health.report()["ok"]
+        assert a.flight.events(kind="watchdog_recovered")
+    finally:
+        await a.stop()
+        await b.stop()
+
+
+# --------------------------------------------------- trainer sentinels
+
+
+def _trainer(metrics=None, flight=None, **cfg_kw):
+    from tensorlink_tpu.models.mlp import MLP, MLPConfig
+    from tensorlink_tpu.train.trainer import Trainer, softmax_cross_entropy
+
+    m = MLP(MLPConfig(in_dim=8, hidden_dim=16, out_dim=4, num_layers=2))
+
+    def loss_fn(module, params, batch, rng):
+        return softmax_cross_entropy(
+            module.apply(params, batch["x"]), batch["y"]
+        )
+
+    cfg = TrainConfig(
+        batch_size=8, micro_batches=1, optimizer="sgd", learning_rate=0.1,
+        dtype="float32", **cfg_kw,
+    )
+    # donate=False: tests re-feed the same state object across branches
+    return Trainer(m, loss_fn, cfg, metrics=metrics, flight=flight,
+                   donate=False)
+
+
+def _batches(rng):
+    good = {
+        "x": jnp.asarray(rng.normal(size=(8, 8)).astype(np.float32)),
+        "y": jnp.asarray(rng.integers(0, 4, 8)),
+    }
+    bad = {"x": good["x"].at[0, 0].set(jnp.nan), "y": good["y"]}
+    return good, bad
+
+
+def test_trainer_nonfinite_skip_keeps_params(rng):
+    """Acceptance: a NaN batch increments train_nonfinite_total, records
+    the flight event, and (with skip enabled) leaves params, optimizer
+    state, and the step counter untouched for that step."""
+    metrics, flight = Metrics(), FlightRecorder("trainer")
+    tr = _trainer(metrics, flight, skip_nonfinite_updates=True)
+    state = tr.init_state(jax.random.key(0))
+    good, bad = _batches(rng)
+
+    state, stats = tr.train_step(state, good, None)
+    assert not bool(stats["nonfinite"])
+    assert "train_nonfinite_total" not in metrics.counters
+    before = jax.tree.map(np.asarray, (state.params, state.opt_state))
+    step_before = int(state.step)
+
+    state2, stats2 = tr.train_step(state, bad, None)
+    assert bool(stats2["nonfinite"])
+    assert metrics.counters["train_nonfinite_total"] == 1
+    evs = flight.events(kind="train_nonfinite")
+    assert evs and evs[0]["severity"] == "error"
+    assert evs[0]["attrs"]["skipped"] is True
+    after = jax.tree.map(np.asarray, (state2.params, state2.opt_state))
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(a, b)
+    assert int(state2.step) == step_before  # schedule clock untouched
+
+    # the good batch still trains from the preserved state
+    state3, stats3 = tr.train_step(state2, good, None)
+    assert not bool(stats3["nonfinite"]) and int(state3.step) == step_before + 1
+
+
+def test_trainer_nonfinite_flag_without_skip(rng):
+    """skip disabled (default): the anomaly is still flagged/counted but
+    the poisoned update goes through — the r1 behavior, now observable."""
+    metrics = Metrics()
+    tr = _trainer(metrics)
+    state = tr.init_state(jax.random.key(0))
+    _, bad = _batches(rng)
+    state2, stats = tr.train_step(state, bad, None)
+    assert bool(stats["nonfinite"])
+    assert metrics.counters["train_nonfinite_total"] == 1
+    assert not all(
+        np.isfinite(np.asarray(leaf)).all()
+        for leaf in jax.tree.leaves(state2.params)
+    )
+
+
+def test_trainer_nonfinite_detects_inf_grads_with_finite_loss():
+    """The sentinel checks GRADS, not just the loss: a loss that is
+    finite while a gradient overflows must still flag."""
+    from tensorlink_tpu.models.mlp import MLP, MLPConfig
+    from tensorlink_tpu.train.trainer import Trainer
+
+    m = MLP(MLPConfig(in_dim=4, hidden_dim=8, out_dim=2, num_layers=1))
+
+    def loss_fn(module, params, batch, rng):
+        # finite loss (sqrt(0) == 0), non-finite grad: d/dx sqrt(x) at
+        # x=0 is inf, and the chain through *0.0 turns it into nan
+        w = jax.tree.leaves(params)[0]
+        return jnp.sqrt(jnp.sum(w) * 0.0)
+
+    tr = Trainer(
+        m, loss_fn,
+        TrainConfig(batch_size=4, micro_batches=1, optimizer="sgd",
+                    dtype="float32"),
+        donate=False,
+    )
+    state = tr.init_state(jax.random.key(0))
+    batch = {"x": jnp.ones((4, 4)), "y": jnp.zeros((4,), jnp.int32)}
+    _, stats = tr._train_step(state, batch, None)
+    assert bool(stats["nonfinite"])
+
+
+# ------------------------------------------- condition lifecycle (roles)
+
+
+@pytest.mark.asyncio
+async def test_recovery_and_shutdown_restore_health():
+    """The degradations are not one-way: a successful re-recruitment
+    clears the user AND validator conditions (healthz back to 200), and
+    job shutdown retires the step watchdog + tells the validator the job
+    is done (a dead-but-never-replaced worker must not pin 503)."""
+    from tensorlink_tpu.models.mlp import MLP, MLPConfig
+    from tensorlink_tpu.roles.registry import InMemoryRegistry
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    def cfg(role, **kw):
+        return NodeConfig(role=role, host="127.0.0.1", port=0,
+                          health_interval_s=0.1, **kw)
+
+    reg = InMemoryRegistry()
+    validator = ValidatorNode(cfg("validator"), registry=reg)
+    await validator.start()
+    workers = []
+    for _ in range(3):  # one spare for the re-recruitment
+        w = WorkerNode(cfg("worker"))
+        await w.start()
+        await w.connect("127.0.0.1", validator.port)
+        workers.append(w)
+    user = UserNode(cfg("user", step_watchdog_s=30.0))
+    await user.start()
+    v_peer = await user.connect("127.0.0.1", validator.port)
+
+    m = MLP(MLPConfig(in_dim=16, hidden_dim=32, out_dim=4, num_layers=2))
+    p = m.init(jax.random.key(0))
+    victim = None
+    try:
+        job = await user.request_job(
+            m.seq, p["seq"], v_peer, max_stage_bytes=16 * 32 * 4 + 200,
+            micro_batches=2,
+            train={"optimizer": "sgd", "learning_rate": 0.05},
+        )
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 16)).astype(np.float32)
+        y = np.argmax(x @ rng.normal(size=(16, 4)), -1)
+
+        def loss_grad(logits, micro):
+            lj = jnp.asarray(logits)
+            yj = jnp.asarray(np.array_split(y, 2)[micro])
+
+            def f(logit):
+                logz = jax.nn.logsumexp(logit, axis=-1)
+                ll = jnp.take_along_axis(
+                    logit, yj[:, None], axis=-1
+                )[..., 0]
+                return jnp.mean(logz - ll)
+
+            val, g = jax.value_and_grad(f)(lj)
+            return float(val), np.asarray(g)
+
+        await job.train_step(x, loss_grad)
+        victim_id = job.stages[1].peer.node_id
+        victim = next(w for w in workers if w.node_id == victim_id)
+        await victim.stop()
+        await asyncio.sleep(0.3)
+        assert not user.health.report()["ok"]  # stage condition set
+        assert not validator.health.report()["ok"]
+
+        await job.train_step(x, loss_grad)  # recovers onto the spare
+        assert user.health.report()["ok"], user.health.report()
+        assert validator.health.report()["ok"], validator.health.report()
+        assert user.flight.events(kind="stage_recovered")
+        assert validator.flight.events(kind="worker_replaced")
+
+        await job.shutdown()
+        # the step watchdog is REMOVED, not just disarmed: no per-job
+        # dead-dog buildup in /healthz or the health loop (review)
+        assert not any(
+            n.startswith("job_step:")
+            for n in user.health.report()["watchdogs"]
+        )
+        assert validator.flight.events(kind="job_done")
+        assert validator.job_state[job.job.job_id].get("done") is True
+    finally:
+        for n in [user, validator] + [
+            w for w in workers if w is not victim
+        ]:
+            await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_job_replicate_clears_replica_condition():
+    """A REPLICA validator flags a dead placed worker too, but the
+    user's REPLACE_WORKER never reaches it — the seed's replication push
+    of the fresh record is what says 'placement fixed' there (review:
+    replicas used to stay 503 forever)."""
+    from tensorlink_tpu.roles.jobs import JobRecord, StageSpec
+    from tensorlink_tpu.roles.validator import ValidatorNode
+
+    v = ValidatorNode(NodeConfig(role="validator", host="127.0.0.1"))
+    job = JobRecord(
+        author="a" * 64,
+        stages=[StageSpec(index=0, module_config={"__type__": "Dense"},
+                          param_bytes=128)],
+    )
+
+    class SeedPeer:
+        role = "validator"  # off-chain dev mode: self-declared role
+        node_id = "b" * 64
+
+    v.health.set_condition(f"job:{job.job_id[:16]}", "placed worker lost")
+    assert not v.health.report()["ok"]
+    resp = await v._h_job_replicate(
+        v, SeedPeer(), {"job": job.to_wire(), "state": {}}
+    )
+    assert resp["type"] == "JOB_REPLICATED"
+    assert v.health.report()["ok"]

@@ -40,7 +40,9 @@ async def test_status_endpoint_routes():
     try:
         port = node._http.bound_port
         st, body = await _http_get("127.0.0.1", port, "/healthz")
-        assert st == 200 and body == {"ok": True}
+        # backward-compatible 200 shape: "ok": true preserved, health
+        # detail keys additive (truthful health is tested in test_flight)
+        assert st == 200 and body["ok"] is True
         st, body = await _http_get("127.0.0.1", port, "/node")
         assert st == 200
         assert body["node_id"] == node.node_id and body["role"] == "worker"
@@ -188,6 +190,68 @@ def test_tracer_async_decorator_and_remote_parent():
 
     with t.span("child", remote={"trace_id": "abc", "span_id": "def"}) as s:
         assert s.trace_id == "abc" and s.parent_id == "def"
+
+
+def test_chrome_trace_span_buffer_overflow_eviction_order():
+    """max_spans overflow: the buffer keeps the NEWEST spans in record
+    order, and to_chrome_trace exports exactly those — an overflowing
+    tracer must never export evicted spans or scramble ordering."""
+    from tensorlink_tpu.runtime.tracing import Tracer
+
+    t = Tracer("svc", max_spans=4)
+    for i in range(10):
+        with t.span(f"s{i}", {"i": i}):
+            pass
+    assert len(t) == 4
+    assert [s.name for s in t.spans()] == ["s6", "s7", "s8", "s9"]
+    xs = [e for e in t.to_chrome_trace()["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in xs] == ["s6", "s7", "s8", "s9"]
+    assert [e["args"]["i"] for e in xs] == [6, 7, 8, 9]
+    # timestamps of the kept window are monotone (record order == time)
+    ts = [e["ts"] for e in xs]
+    assert ts == sorted(ts)
+    # a nested survivor whose PARENT was evicted still exports cleanly
+    t2 = Tracer("svc", max_spans=1)
+    with t2.span("outer"):
+        with t2.span("inner"):
+            pass
+    # inner recorded first (exit order), then outer evicted it... no:
+    # outer exits LAST, so it evicts inner — the newest span wins
+    assert [s.name for s in t2.spans()] == ["outer"]
+    assert len(t2.to_chrome_trace()["traceEvents"]) == 3  # 2 meta + 1 X
+
+
+def test_histogram_quantile_bounds_empty_and_single():
+    """Satellite: q=0 / q=1 at the degenerate ends — empty histograms
+    answer nan (never a fake 0.0), a single observation answers within
+    its bucket for EVERY q, and overflow observations clamp."""
+    import math
+
+    from tensorlink_tpu.runtime.metrics import Histogram
+
+    h = Histogram(buckets=(0.1, 1.0, 10.0))
+    assert math.isnan(h.quantile(0.0))
+    assert math.isnan(h.quantile(1.0))
+    snap = h.snapshot()
+    assert snap["n"] == 0 and math.isnan(snap["p50"])
+
+    h.observe(0.5)  # single observation, bucket (0.1, 1.0]
+    assert h.quantile(0.0) == pytest.approx(0.1)  # bucket lower bound
+    assert h.quantile(1.0) == pytest.approx(1.0)  # bucket upper bound
+    assert 0.1 <= h.quantile(0.5) <= 1.0
+    assert h.snapshot()["sum"] == pytest.approx(0.5)
+
+    # single observation BELOW the first bound interpolates from 0
+    h2 = Histogram(buckets=(0.1, 1.0))
+    h2.observe(0.05)
+    assert h2.quantile(0.0) == pytest.approx(0.0)
+    assert h2.quantile(1.0) == pytest.approx(0.1)
+
+    # single observation ABOVE the last bound clamps to it (q=0 and q=1)
+    h3 = Histogram(buckets=(0.1, 1.0))
+    h3.observe(50.0)
+    assert h3.quantile(0.0) == pytest.approx(1.0)
+    assert h3.quantile(1.0) == pytest.approx(1.0)
 
 
 def test_chrome_trace_export_shape():
