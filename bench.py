@@ -562,9 +562,17 @@ def main() -> None:
             if b512 == 8:
                 out["seq512_samples_per_sec_per_chip"] = round(b512 * sps2, 2)
                 out["seq512_mfu"] = mfu_of(fl2, sps2)
-                out["seq512_flops_xla_crosscheck"] = xla_step_cost(
-                    one2, st2, ba2
-                )[0]
+                # the MFU plateau, first-class under BOTH accountings
+                # (VERDICT #7): analytic is the honest number for the
+                # flash program (cost_analysis can't see inside
+                # pallas_call), xla is exact for what XLA itself emitted
+                # — reporting only one buried the gap in a footnote
+                xla512 = xla_step_cost(one2, st2, ba2)[0]
+                out["seq512_mfu_analytic"] = out["seq512_mfu"]
+                out["seq512_mfu_xla"] = (
+                    mfu_of(xla512, sps2) if xla512 else None
+                )
+                out["seq512_flops_xla_crosscheck"] = xla512
         out["seq512_batch_sweep"] = sweep512
 
     # -- secondary: KV-cache decode throughput (BASELINE.json names
@@ -655,6 +663,144 @@ def main() -> None:
                 }
         except Exception as e:  # noqa: BLE001
             out["decode_error"] = str(e)[:200]
+
+    # -- continuous batching vs static batching (ISSUE 5 tentpole):
+    # N staggered prompts through the fixed-slot scheduler vs the same
+    # prompts in one static generate() batch. The acceptance bar is
+    # continuous >= 0.9x static aggregate tok/s WITH per-request
+    # TTFT/TPOT measured (the static batch has no per-request story at
+    # all: every request waits for the whole batch).
+    if os.environ.get("BENCH_SERVING_CB", "1") == "1" and _BERT == "base":
+        try:
+            from tensorlink_tpu.config import MeshConfig
+            from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config
+            from tensorlink_tpu.parallel.inference import (
+                GenerationConfig,
+                InferenceEngine,
+            )
+            from tensorlink_tpu.parallel.serving import (
+                ContinuousBatchingEngine,
+            )
+            from tensorlink_tpu.runtime.mesh import make_mesh
+            from tensorlink_tpu.runtime.metrics import Metrics
+
+            # slot width == static batch width: the ratio then isolates
+            # the scheduler's own overheads (chunked dispatch, batch-1
+            # prefills) from batch-size efficiency on a memory-bound
+            # decode, which slots < batch would conflate
+            Pcb, Ncb, NREQ, SLOTS = 32, 64, 16, 16
+            cbcfg = GPT2Config(qkv_fused=True)
+            cbmodel = GPT2(cbcfg)
+            cbeng = InferenceEngine(
+                make_mesh(MeshConfig()), cbmodel,
+                cbmodel.init(jax.random.key(0)), max_len=256,
+            )
+            rcb = np.random.default_rng(0)
+            cbprompts = rcb.integers(0, cbcfg.vocab_size, (NREQ, Pcb))
+            cbgen = GenerationConfig(max_new_tokens=Ncb)
+
+            # static figure: ALL prompts as one batch (static batching's
+            # best case), warm + 3 reps
+            sids = jnp.asarray(cbprompts)
+            t = cbeng.generate(sids, cbgen)
+            int(np.asarray(t)[0, -1])
+            t0 = time.perf_counter()
+            for _ in range(3):
+                t = cbeng.generate_async(sids, cbgen)
+            int(np.asarray(t)[0, -1])
+            static_tps = NREQ * Ncb / ((time.perf_counter() - t0) / 3)
+
+            sch = ContinuousBatchingEngine(
+                cbeng, slots=SLOTS, gen=cbgen, decode_chunk=16,
+                prefill_block=32,
+            )
+            # warm round compiles prefill bucket + decode chunk; the
+            # metrics registry is attached AFTER it so the published
+            # TTFT/TPOT quantiles measure serving, not XLA compiles
+            for p_ in cbprompts[:SLOTS]:
+                sch.submit(p_)
+            sch.run_until_idle()
+            sch.metrics = cbm = Metrics()
+            t0 = time.perf_counter()
+            rids = [sch.submit(p_) for p_ in cbprompts]
+            sch.run_until_idle()
+            dt = time.perf_counter() - t0
+            ntok = sum(len(sch.result(rid)) for rid in rids)
+            cont_tps = ntok / dt
+            out["serving_continuous_tokens_per_sec"] = round(cont_tps, 1)
+            out["serving_static_tokens_per_sec"] = round(static_tps, 1)
+            out["serving_continuous_vs_static"] = round(
+                cont_tps / static_tps, 3
+            )
+            th = cbm.histograms.get("serving_ttft_s")
+            tp = cbm.histograms.get("serving_tpot_s")
+            if th is not None:
+                out["serving_ttft_p50_s"] = round(th.quantile(0.5), 5)
+                out["serving_ttft_p99_s"] = round(th.quantile(0.99), 5)
+            if tp is not None:
+                out["serving_tpot_p50_s"] = round(tp.quantile(0.5), 6)
+                out["serving_tpot_p99_s"] = round(tp.quantile(0.99), 6)
+            out["serving_cb_config"] = (
+                f"GPT-2 small bf16 qkv_fused, {NREQ} staggered prompts "
+                f"(P{Pcb} N{Ncb}) over {SLOTS} slots, decode_chunk 16, "
+                "vs the same prompts in one static batch"
+            )
+        except Exception as e:  # noqa: BLE001 — must not sink the headline
+            out["serving_cb_error"] = str(e)[:200]
+
+    # -- int8 end-to-end quality (VERDICT #8): logit KL between bf16 and
+    # int8 weight-only GPT-2 small on a fixed eval batch. The number the
+    # "int8 costs ~nothing" claim rides on; tests/test_quant.py pins the
+    # same quantity under a bound on a CI-sized model.
+    if os.environ.get("BENCH_INT8Q", "1") == "1" and _BERT == "base":
+        try:
+            from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config
+            from tensorlink_tpu.ops.quant import quantize_params_int8
+
+            qcfg = GPT2Config()
+            qmodel = GPT2(qcfg)
+            qp0 = qmodel.init(jax.random.key(0))
+
+            def to_serving(t):
+                # the engine's serving dtype policy: >=2-D float leaves
+                # to bf16, 1-D (biases/norms/scales) stay f32
+                return jax.tree.map(
+                    lambda x: x.astype(jnp.bfloat16)
+                    if jnp.issubdtype(x.dtype, jnp.floating) and x.ndim >= 2
+                    else x,
+                    t,
+                )
+
+            pref = to_serving(qp0)
+            pq = to_serving(quantize_params_int8(qmodel, qp0))
+            qids = jnp.asarray(
+                np.random.default_rng(7).integers(
+                    0, qcfg.vocab_size, (8, 128)
+                )
+            )
+
+            @jax.jit
+            def logit_kl(pa, pb, ids):
+                la = qmodel.apply(pa, ids).astype(jnp.float32)
+                lb = qmodel.apply(pb, ids).astype(jnp.float32)
+                pa_ = jax.nn.log_softmax(la)
+                pb_ = jax.nn.log_softmax(lb)
+                kl = jnp.sum(jnp.exp(pa_) * (pa_ - pb_), axis=-1)
+                return jnp.mean(kl), jnp.max(kl)
+
+            kl_mean, kl_max = logit_kl(pref, pq, qids)
+            out["int8_quality"] = {
+                "logit_kl_mean": round(float(kl_mean), 6),
+                "logit_kl_max": round(float(kl_max), 6),
+                "bound": 0.02,
+                "config": (
+                    "GPT-2 small bf16 vs int8 weight-only, fixed batch "
+                    "8x128 (KL in nats, bf16||int8)"
+                ),
+            }
+            del pref, pq, qp0
+        except Exception as e:  # noqa: BLE001
+            out["int8_quality_error"] = str(e)[:200]
 
     # -- secondary: long-prefix serving (fresh-keys prefill + sliding
     # window + rolling ring cache, the r4 serving work). End-to-end

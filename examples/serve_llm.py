@@ -47,6 +47,12 @@ def main() -> None:
     ap.add_argument("--max-new", type=int, default=32)
     ap.add_argument("--temperature", type=float, default=0.8)
     ap.add_argument("--top-p", type=float, default=0.95)
+    ap.add_argument("--continuous", action="store_true",
+                    help="serve staggered requests through the "
+                         "continuous-batching scheduler (submit/result) "
+                         "instead of one static generate() batch")
+    ap.add_argument("--slots", type=int, default=4,
+                    help="decode slots for --continuous")
     args = ap.parse_args()
 
     # tiny config so the example runs on a dev box; swap for
@@ -64,21 +70,40 @@ def main() -> None:
         mesh, model, params, max_len=256,
         quantize="int8" if args.int8 else None,
         # windowed models serve from a ring KV cache: O(prompt+window)
-        # memory no matter how long the generation runs
-        rolling_cache=args.window is not None,
-    )
-    prompts = jnp.asarray(
-        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 8))
+        # memory no matter how long the generation runs — the static
+        # path only; the continuous scheduler uses the monotone cache
+        rolling_cache=args.window is not None and not args.continuous,
     )
     gen = GenerationConfig(
         max_new_tokens=args.max_new,
         temperature=args.temperature,
         top_p=args.top_p,
     )
-    tokens = eng.generate(prompts, gen, rng=jax.random.key(0))
+    rng = np.random.default_rng(0)
     print(f"mesh={dict(mesh.shape)} window={cfg.attn_window} "
-          f"int8={args.int8}")
-    print("generated:", np.asarray(tokens))
+          f"int8={args.int8} continuous={args.continuous}")
+    if args.continuous:
+        # staggered traffic: variable-length prompts submitted one by
+        # one, interleaved prefill+decode over a fixed slot batch;
+        # per-request seeds keep each stream deterministic under any
+        # co-tenant traffic
+        from tensorlink_tpu.parallel.serving import ContinuousBatchingEngine
+
+        sch = ContinuousBatchingEngine(
+            eng, slots=args.slots, gen=gen, decode_chunk=8,
+            prefill_block=8,
+        )
+        rids = [
+            sch.submit(rng.integers(0, cfg.vocab_size, (n,)), seed=i)
+            for i, n in enumerate((5, 8, 3, 11, 6, 8))
+        ]
+        for rid in rids:
+            print(f"request {rid}:", sch.result(rid))
+        print("scheduler:", sch.stats())
+    else:
+        prompts = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 8)))
+        tokens = eng.generate(prompts, gen, rng=jax.random.key(0))
+        print("generated:", np.asarray(tokens))
 
 
 if __name__ == "__main__":

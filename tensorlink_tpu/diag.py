@@ -236,9 +236,15 @@ def render_table(rows: list[dict[str, Any]]) -> str:
 _HIGHER_BETTER = (
     "samples_per_sec", "tokens_per_sec", "mfu", "speedup", "throughput",
     "fraction_attained", "vs_baseline", "tick_over_dispatch",
+    # continuous-vs-static serving ratio: 1.0 = parity, higher = the
+    # scheduler beats the static batch
+    "vs_static",
 )
 _LOWER_BETTER_RE = re.compile(
-    r"(_s$|_s_per_call$|seconds|latency|bubble_fraction|drop_fraction)"
+    r"(_s$|_s_per_call$|seconds|latency|bubble_fraction|drop_fraction"
+    # serving latency percentiles (TTFT/TPOT histograms) and the int8
+    # quality KL: smaller is better even where the unit suffix differs
+    r"|ttft|tpot|(^|_)kl(_|$))"
 )
 
 

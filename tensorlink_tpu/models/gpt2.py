@@ -85,7 +85,13 @@ class GPT2(Module):
         B, T = input_ids.shape
         if positions is None:
             if caches is not None:
-                positions = caches[0]["attn"]["index"] + jnp.arange(T)[None, :]
+                idx = caches[0]["attn"]["index"]
+                if getattr(idx, "ndim", 0) == 1:
+                    # per-row serving index ([B]): each row sits at its
+                    # own position (bare [B] + [1,T] would broadcast to
+                    # a bogus [B,T]-transposed table lookup)
+                    idx = idx[:, None]
+                positions = idx + jnp.arange(T)[None, :]
             else:
                 positions = jnp.arange(T)[None, :]
         x = self.children["wte"].apply(params["wte"], input_ids)

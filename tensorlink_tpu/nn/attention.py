@@ -405,7 +405,22 @@ class MultiHeadAttention(Module):
         if cache is not None:
             q_offset = cache["index"]
             if positions is None:  # caller-supplied positions win (padded decode)
-                positions = cache["index"] + jnp.arange(T)[None, :]
+                if getattr(cache["index"], "ndim", 0) == 1:
+                    # per-row index (serving slot form): rows sit at
+                    # different (and possibly pad-offset) logical
+                    # positions the index alone cannot reconstruct.
+                    # Only RoPE consumes positions here — the per-row
+                    # attention path itself is mask-authoritative — so
+                    # rope-less models (GPT-2: learned positions at the
+                    # embedding) may omit them.
+                    if self.rope:
+                        raise ValueError(
+                            "per-row cache indices with rope require "
+                            "explicit positions (rows sit at different "
+                            "logical positions)"
+                        )
+                else:
+                    positions = cache["index"] + jnp.arange(T)[None, :]
         elif positions is None:
             positions = jnp.arange(T)[None, :]
             if getattr(self, "attn_impl", None) in ("ring", "ulysses"):
@@ -432,6 +447,27 @@ class MultiHeadAttention(Module):
             )
         if cache is not None:
             rolling = "rolling" in cache
+            # per-row cache indices ([B]-shaped ``index``): the
+            # continuous-batching serving form — each batch row is an
+            # independent request slot with its own write position
+            # (parallel/serving.py). Single-token decode only; the
+            # caller owns positions and the validity mask (slot order is
+            # logical order per row up to its constant left-pad offset,
+            # so causality is implied by validity and the positional
+            # predicate is never consulted).
+            vec_index = getattr(cache["index"], "ndim", 0) == 1
+            if vec_index and rolling:
+                raise NotImplementedError(
+                    "per-row cache indices with a rolling cache would "
+                    "need per-row wrap bookkeeping; serve windowed "
+                    "models from the monotone cache"
+                )
+            if vec_index and T != 1:
+                raise ValueError(
+                    f"per-row cache indices require single-token decode "
+                    f"(T == 1), got T={T}; prefill a slot through a "
+                    "batch-1 scalar-index cache instead"
+                )
             # rolling (ring-buffer) cache for sliding-window serving:
             # write position wraps modulo capacity, so the cache stays
             # O(window) while generation runs arbitrarily long. The
@@ -439,6 +475,77 @@ class MultiHeadAttention(Module):
             # no longer logical order past the first wrap) — see
             # parallel/inference.py rolling_cache.
             cap = cache["k"].shape[1]
+            if vec_index:
+                # one scatter per k/v: row r writes its own slot
+                # index[r]. mode="drop" — a row whose region filled to
+                # capacity (index == cap) must write nothing (a clamp
+                # would corrupt its last real slot). Retired-but-not-
+                # readmitted serving rows park BELOW capacity and do
+                # keep writing; that garbage is harmless because the
+                # scheduler never validates their slots and prefill
+                # grafts the whole region on re-admission.
+                rows = jnp.arange(B)
+                ck = cache["k"].at[rows, cache["index"]].set(
+                    k[:, 0].astype(cache["k"].dtype), mode="drop"
+                )
+                cv = cache["v"].at[rows, cache["index"]].set(
+                    v[:, 0].astype(cache["v"].dtype), mode="drop"
+                )
+                new_cache = {"k": ck, "v": cv, "index": cache["index"] + T}
+                fresh = False
+                if mask is not None and mask.shape[-1] != cap:
+                    raise ValueError(
+                        "per-row cache indices need a cache-width mask "
+                        f"(last dim {cap}), got {mask.shape}"
+                    )
+                Tk = cap
+                k, v = ck, cv
+                live = cache["index"] + T  # [B]
+                valid = (
+                    jnp.arange(Tk)[None, None, None, :]
+                    < live[:, None, None, None]
+                )
+                mask = valid if mask is None else jnp.logical_and(mask, valid)
+                win = getattr(self, "window", None)
+                blocks_min = (
+                    DECODE_BLOCK if win is not None
+                    else DECODE_BLOCKWISE_MIN_WINDOWLESS
+                )
+                use_blockwise = (
+                    Tk > blocks_min and Tk % DECODE_BLOCK == 0
+                    and bias is None and getattr(self, "scale", None) is None
+                )
+                if win is not None:
+                    # slot-space band == logical band: slot s holds
+                    # logical position s - pads with pads constant per
+                    # row, so s > live-1-window iff pos > q_pos-window
+                    win_start = jnp.maximum(live - win, 0)  # [B]
+                    kpos = jnp.arange(Tk)[None, None, None, :]
+                    mask = jnp.logical_and(
+                        mask, kpos >= win_start[:, None, None, None]
+                    )
+                if use_blockwise:
+                    out = decode_attention_blockwise(
+                        q, k.astype(q.dtype), v.astype(q.dtype),
+                        jnp.max(live),  # bound: mask owns per-row truth
+                        mask=jnp.broadcast_to(
+                            mask,
+                            jnp.broadcast_shapes(mask.shape, (B, 1, 1, Tk)),
+                        ),
+                        start=jnp.min(win_start) if win is not None else 0,
+                    )
+                else:
+                    # mask is the sole authority (causality is implied:
+                    # every valid slot is at or before the lone query)
+                    out = self._attn(
+                        q, k.astype(q.dtype), v.astype(q.dtype),
+                        causal=False, mask=mask, q_offset=0,
+                        bias=bias, scale=getattr(self, "scale", None),
+                        window=None,
+                    )
+                out = out.reshape(B, T, self.num_heads * self.head_dim)
+                out = self.children["o"].apply(params["o"], out)
+                return out, new_cache
             wslot = cache["index"] % cap if rolling else cache["index"]
             if rolling and T > cap:
                 # duplicate wrapped slots: scatter order for duplicate

@@ -22,6 +22,13 @@ ACTIVATIONS = {
 }
 
 
+def _decode_glue():
+    # lazy: pallas machinery only loads when a decode path actually runs
+    from tensorlink_tpu.ops.pallas import decode_glue
+
+    return decode_glue
+
+
 class FeedForward(Module):
     """MLP block; ``gated=True`` gives the SwiGLU variant (Llama)."""
 
@@ -186,8 +193,22 @@ class TransformerBlock(Module):
             a = attn.apply(params["attn"], h, mask=mask, cache=cache, positions=positions)
             if cache is not None:
                 a, new_cache = a
-            x = x + drop.apply(params["drop"], a, rng=r1, train=train)
-            h = n2.apply(params["norm2"], x)
+            if (
+                cache is not None and not train and x.shape[1] == 1
+                and _decode_glue().should_fuse(a, self.norm)
+            ):
+                # decode fast path: residual add + norm2 in ONE kernel
+                # launch (T=1 steps are launch-bound; the add/mean/var/
+                # rsqrt/scale chain is otherwise 2 tiny fusions per
+                # block per token — see ops/pallas/decode_glue.py)
+                x, h = _decode_glue().fused_residual_norm(
+                    a, x, params["norm2"]["scale"],
+                    params["norm2"].get("bias"),
+                    eps=self.norm_eps, kind=self.norm,
+                )
+            else:
+                x = x + drop.apply(params["drop"], a, rng=r1, train=train)
+                h = n2.apply(params["norm2"], x)
             m, aux = self._mlp(params["mlp"], h, r2, train)
             x = x + drop.apply(params["drop"], m, rng=r3, train=train)
         else:  # post-LN (BERT)

@@ -5,3 +5,9 @@ from tensorlink_tpu.parallel.pp import (  # noqa: F401
     stack_stage_params,
     unstack_stage_params,
 )
+from tensorlink_tpu.parallel.serving import (  # noqa: F401
+    ContinuousBatchingEngine,
+    PromptTooLongError,
+    QueueFullError,
+    ServingError,
+)

@@ -40,6 +40,35 @@ class GenerationConfig:
     eos_token_id: int | None = None
 
 
+def sample_logits(logits, key, temperature, top_k, top_p=1.0):
+    """One home for the sampling math ([..., V] logits -> token ids):
+    the engine's in-scan decode and the continuous-batching scheduler
+    (parallel/serving.py) must draw from EXACTLY the same distribution
+    or greedy token parity between the two serving paths breaks."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k:
+        # lax.top_k is O(V log k) and TPU-optimized; this runs inside
+        # the per-token decode scan, so a full vocab sort would be on
+        # the hot path (review finding)
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        # nucleus: keep the smallest prefix of descending-prob tokens
+        # whose EXCLUSIVE cumulative mass is < top_p (the first token
+        # always survives). Costs one vocab sort per token — opt-in.
+        srt = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
+        thr = jnp.min(
+            jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits >= thr, logits, -jnp.inf)
+    return jax.random.categorical(key, logits, axis=-1)
+
+
 class InferenceEngine:
     """Greedy/temperature sampling over a TP(+DP)-sharded model.
 
@@ -161,28 +190,7 @@ class InferenceEngine:
 
     # ------------------------------------------------------------ internals
     def _sample(self, logits, key, temperature, top_k, top_p=1.0):
-        logits = logits.astype(jnp.float32)
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1)
-        logits = logits / temperature
-        if top_k:
-            # lax.top_k is O(V log k) and TPU-optimized; this runs inside
-            # the per-token decode scan, so a full vocab sort would be on
-            # the hot path (review finding)
-            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        if top_p < 1.0:
-            # nucleus: keep the smallest prefix of descending-prob tokens
-            # whose EXCLUSIVE cumulative mass is < top_p (the first token
-            # always survives). Costs one vocab sort per token — opt-in.
-            srt = jnp.sort(logits, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(srt, axis=-1)
-            keep = (jnp.cumsum(probs, axis=-1) - probs) < top_p
-            thr = jnp.min(
-                jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True
-            )
-            logits = jnp.where(logits >= thr, logits, -jnp.inf)
-        return jax.random.categorical(key, logits, axis=-1)
+        return sample_logits(logits, key, temperature, top_k, top_p)
 
     def _build(self, B: int, T0: int, gen: GenerationConfig):
         """One jitted program: prefill + lax.scan decode. Retraced per

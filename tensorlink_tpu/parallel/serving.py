@@ -1,0 +1,572 @@
+"""Continuous-batching serving engine over ``InferenceEngine``.
+
+The engine's ``generate()`` is one synchronous XLA program per BATCH:
+every prompt in the batch prefills together, decodes together, and the
+whole batch finishes together. Real traffic arrives staggered — the
+job-lifecycle premise of the source paper's validator/job queue — so a
+static batch either waits to fill (latency) or runs part-empty
+(throughput). This module serves a FIXED-SLOT decode batch instead:
+
+- the KV cache is allocated once as ``[slots, L, Hkv, D]`` per layer;
+  each slot row is an independent request with its own write index
+  (``nn/attention.py`` per-row cache indices), validity mask, logical
+  position, and RNG stream;
+- an admission queue interleaves PREFILL of arriving prompts (a batch-1
+  program that scatters the prompt's k/v into a free slot's cache
+  region) with DECODE of in-flight ones;
+- decode runs in jitted chunks of ``decode_chunk`` tokens with the
+  whole device state DONATED (the multi-GB cache is updated in place,
+  never copied per step) and the host keeps ``pipeline_depth`` chunks
+  in flight before syncing the oldest — dispatch overlaps device work,
+  no per-token host sync;
+- a slot is freed on EOS / max-tokens and immediately re-admissible.
+
+Determinism: the sampling key for the token at logical position ``n``
+of a request is ``fold_in(key(request_seed), n)`` — a function of the
+request alone, so a request's tokens do not depend on which slot it
+landed in or what other traffic shared the batch.
+
+API: ``submit() -> rid`` (non-blocking, queue-backpressured),
+``result(rid)`` (drives the loop until that request finishes),
+``aresult(rid)`` (asyncio wrapper for node event loops). Per-request
+TTFT/TPOT land in a ``Metrics`` registry as histograms.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import threading
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tensorlink_tpu.parallel.inference import (
+    GenerationConfig,
+    InferenceEngine,
+    sample_logits,
+)
+
+
+def _is_index_leaf(leaf) -> bool:
+    """A per-slot cache write-index vector ([S] int) — the only 1-D
+    integer leaf in a serving-form KV cache (k/v are 4-D)."""
+    return (
+        getattr(leaf, "ndim", None) == 1
+        and jnp.issubdtype(leaf.dtype, jnp.integer)
+    )
+
+
+def _cache_index(caches):
+    for leaf in jax.tree.leaves(caches):
+        if _is_index_leaf(leaf):
+            return leaf
+    raise ValueError("serving caches carry no per-slot index vector")
+
+
+def _with_cache_index(caches, new_index):
+    return jax.tree.map(
+        lambda c: new_index if _is_index_leaf(c) else c, caches
+    )
+
+
+class ServingError(RuntimeError):
+    """Base class for scheduler rejections."""
+
+
+class PromptTooLongError(ServingError):
+    """Prompt (plus its token budget) cannot fit a slot's cache region."""
+
+
+class QueueFullError(ServingError):
+    """Admission queue at max_queue — back-pressure the caller."""
+
+
+@dataclass
+class _Request:
+    rid: int
+    ids: np.ndarray | None  # [T0] prompt tokens (dropped once finished)
+    max_new: int
+    seed: int
+    submitted_at: float
+    slot: int | None = None
+    first_token: jax.Array | None = None  # device scalar from prefill
+    first_token_at: float | None = None
+    tokens: list[int] = field(default_factory=list)
+    done: bool = False
+    finished_at: float | None = None
+
+
+class ContinuousBatchingEngine:
+    """Fixed-slot continuous batching over a built ``InferenceEngine``.
+
+    ``slots``: decode batch width (compiled once; a slot row is one
+    request). ``decode_chunk``: tokens decoded per dispatched program —
+    larger amortizes dispatch, smaller reduces wasted steps after EOS.
+    ``pipeline_depth``: decode chunks kept in flight before the host
+    syncs the oldest (the host-off-critical-path knob).
+    ``prefill_block``: prompt lengths round up to a multiple of this, so
+    prefill retraces are bounded by max_len / prefill_block buckets.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        *,
+        slots: int = 8,
+        gen: GenerationConfig | None = None,
+        decode_chunk: int = 8,
+        pipeline_depth: int = 2,
+        prefill_block: int = 32,
+        max_queue: int | None = None,
+        keep_results: int = 1024,
+        metrics=None,
+        recorder=None,
+    ):
+        if engine.rolling:
+            raise NotImplementedError(
+                "continuous batching over a rolling (ring) cache would "
+                "need per-row wrap bookkeeping; use the monotone cache"
+            )
+        if engine.kv_seq_shard:
+            raise NotImplementedError(
+                "continuous batching with kv_seq_shard is not wired yet "
+                "(the per-slot scatter writes need owner-aware sharding)"
+            )
+        self.engine = engine
+        self.gen = gen or GenerationConfig()
+        if not 0.0 < self.gen.top_p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1] (1.0 = off), got {self.gen.top_p}"
+            )
+        self.slots = int(slots)
+        self.decode_chunk = int(decode_chunk)
+        self.pipeline_depth = max(int(pipeline_depth), 0)
+        self.prefill_block = int(prefill_block)
+        self.max_queue = max_queue
+        # finished requests kept readable through result(); older ones
+        # are evicted so steady traffic cannot grow host memory forever
+        self.keep_results = max(int(keep_results), 1)
+        self.metrics = metrics
+        self.recorder = recorder
+        self.L = engine.cache_len
+        self._lock = threading.Lock()
+
+        self._queue: collections.deque[_Request] = collections.deque()
+        self._requests: dict[int, _Request] = {}
+        self._done_order: collections.deque[int] = collections.deque()
+        self._slot_req: list[_Request | None] = [None] * self.slots
+        self._free: list[int] = list(range(self.slots))[::-1]
+        # (device tokens [K, S], dispatch-time slot->request snapshot)
+        self._inflight: collections.deque = collections.deque()
+        self._next_rid = 0
+        self._prefill_jit: dict[int, object] = {}
+
+        self._state = self._init_state()
+        self._decode = self._build_decode()
+
+    # --------------------------------------------------------- device state
+    def _init_state(self):
+        eng, S, L = self.engine, self.slots, self.L
+        caches = eng.model.init_caches(S, L, dtype=eng.cache_dtype)
+        # scalar per-layer write index -> per-slot vector (the serving
+        # cache form nn/attention.py scatters by)
+        caches = jax.tree.map(
+            lambda c: jnp.zeros((S,), jnp.int32)
+            if getattr(c, "ndim", None) == 0
+            and jnp.issubdtype(c.dtype, jnp.integer) else c,
+            caches,
+        )
+        state = {
+            "caches": caches,
+            "valid": jnp.zeros((S, L), bool),  # attendable cache slots
+            "n_valid": jnp.zeros((S,), jnp.int32),  # logical token count
+            "tok": jnp.zeros((S,), jnp.int32),  # last sampled, unfed token
+            "seed": jnp.zeros((S,), jnp.uint32),
+            "remaining": jnp.zeros((S,), jnp.int32),
+            "live": jnp.zeros((S,), bool),
+        }
+        mesh = eng.mesh
+        if mesh.shape.get(eng.data_axis, 1) > 1 and S % mesh.shape[eng.data_axis] == 0:
+            # slots ride the data axis exactly like engine batch rows
+            def shard(x):
+                spec = P(eng.data_axis, *([None] * (x.ndim - 1)))
+                return jax.device_put(x, NamedSharding(mesh, spec))
+
+            state = jax.tree.map(shard, state)
+        return state
+
+    def _fill_token(self) -> int:
+        return self.gen.eos_token_id if self.gen.eos_token_id is not None else 0
+
+    # ------------------------------------------------------------- programs
+    def _build_decode(self):
+        eng = self.engine
+        model, S, L, K = eng.model, self.slots, self.L, self.decode_chunk
+        gen = self.gen
+        temperature, top_k, top_p = (
+            float(gen.temperature), int(gen.top_k), float(gen.top_p)
+        )
+        eos = gen.eos_token_id
+        fill = self._fill_token()
+
+        def sample_row(seed, n, logits_row):
+            # key depends on (request seed, logical position) ONLY —
+            # slot assignment and co-tenants cannot change the draw
+            key = jax.random.fold_in(jax.random.key(seed), n)
+            return sample_logits(logits_row, key, temperature, top_k, top_p)
+
+        def chunk(params, state):
+            def step(state, _):
+                caches, valid = state["caches"], state["valid"]
+                live, tok = state["live"], state["tok"]
+                n_valid, remaining = state["n_valid"], state["remaining"]
+                rows = jnp.arange(S)
+                index = _cache_index(caches)
+                # the fed token's cache slot becomes attendable for live
+                # rows; a retired row's index parks at its final value
+                # (its write is never validated, or dropped at capacity)
+                valid = valid.at[rows, index].max(live, mode="drop")
+                logits, caches = model.apply(
+                    params,
+                    tok[:, None],
+                    caches=caches,
+                    positions=n_valid[:, None],
+                    mask=valid[:, None, None, :],
+                )
+                # the module advanced EVERY row's index by 1; only live
+                # rows actually consumed a slot
+                new_index = index + live.astype(jnp.int32)
+                caches = _with_cache_index(caches, new_index)
+                new_n_valid = n_valid + live.astype(jnp.int32)
+                nxt = jax.vmap(sample_row)(
+                    state["seed"], new_n_valid, logits[:, -1]
+                ).astype(jnp.int32)
+                emit = jnp.where(live, nxt, fill)
+                remaining = remaining - live.astype(jnp.int32)
+                ended = remaining <= 0
+                if eos is not None:
+                    ended = ended | (nxt == eos)
+                new_state = {
+                    "caches": caches,
+                    "valid": valid,
+                    "n_valid": new_n_valid,
+                    "tok": jnp.where(live, nxt, tok),
+                    "seed": state["seed"],
+                    "remaining": remaining,
+                    "live": live & ~ended,
+                }
+                return new_state, emit
+
+            state, toks = jax.lax.scan(step, state, None, length=K)
+            return state, toks  # toks: [K, S]
+
+        # donate the whole serving state: the KV cache updates in place
+        # across chunk calls instead of being copied per dispatch
+        return jax.jit(chunk, donate_argnums=(1,))
+
+    def _bucket(self, t0: int) -> int:
+        b = -(-t0 // self.prefill_block) * self.prefill_block
+        return min(b, self.L)
+
+    def _build_prefill(self, Tp: int):
+        eng = self.engine
+        model, S, L = eng.model, self.slots, self.L
+        gen = self.gen
+        temperature, top_k, top_p = (
+            float(gen.temperature), int(gen.top_k), float(gen.top_p)
+        )
+        eos = gen.eos_token_id
+
+        def prefill(params, state, ids, pad_mask, slot, seed, max_new):
+            pos = jnp.maximum(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
+            nv = pad_mask.sum(-1)[0].astype(jnp.int32)
+            small = model.init_caches(1, Tp, dtype=eng.cache_dtype)
+            # fresh-keys prefill over the just-projected k/v (engine
+            # contract): key must be a real prompt token at or before
+            # the query; left padding => slot order == logical order
+            qslot = jnp.arange(Tp)[None, None, :, None]
+            kslot = jnp.arange(Tp)[None, None, None, :]
+            causal = (kslot <= qslot) & pad_mask.astype(bool)[:, None, None, :]
+            logits, small = model.apply(
+                params, ids, caches=small, positions=pos, mask=causal
+            )
+            key0 = jax.random.fold_in(jax.random.key(seed), nv)
+            tok0 = sample_logits(
+                logits[0, -1], key0, temperature, top_k, top_p
+            ).astype(jnp.int32)
+            done0 = max_new <= 1
+            if eos is not None:
+                done0 = done0 | (tok0 == eos)
+
+            def graft(big, small_leaf):
+                if getattr(big, "ndim", None) == 4:
+                    return jax.lax.dynamic_update_slice(
+                        big, small_leaf.astype(big.dtype), (slot, 0, 0, 0)
+                    )
+                if _is_index_leaf(big):  # per-slot write index
+                    return big.at[slot].set(small_leaf.astype(big.dtype))
+                return big
+
+            caches = jax.tree.map(graft, state["caches"], small)
+            valid_row = jnp.zeros((L,), bool).at[:Tp].set(
+                pad_mask[0].astype(bool)
+            )
+            return {
+                "caches": caches,
+                "valid": state["valid"].at[slot].set(valid_row),
+                "n_valid": state["n_valid"].at[slot].set(nv),
+                "tok": state["tok"].at[slot].set(tok0),
+                "seed": state["seed"].at[slot].set(seed),
+                "remaining": state["remaining"].at[slot].set(
+                    (max_new - 1).astype(jnp.int32)
+                ),
+                "live": state["live"].at[slot].set(~done0),
+            }, tok0
+
+        return jax.jit(prefill, donate_argnums=(1,))
+
+    # --------------------------------------------------------------- events
+    def _event(self, kind: str, **data) -> None:
+        if self.recorder is not None:
+            try:
+                self.recorder.record(kind, **data)
+            except Exception:  # noqa: BLE001 — telemetry must not serve 500s
+                pass
+
+    # ----------------------------------------------------------------- API
+    def submit(
+        self, ids, *, max_new: int | None = None, seed: int = 0
+    ) -> int:
+        """Enqueue one prompt (1-D token array). Returns a request id;
+        never blocks. Raises ``PromptTooLongError`` when the prompt plus
+        its token budget cannot fit a slot's cache region, and
+        ``QueueFullError`` past ``max_queue`` pending admissions."""
+        ids = np.asarray(ids).reshape(-1)
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        max_new = int(max_new if max_new is not None else self.gen.max_new_tokens)
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        t0 = int(ids.size)
+        if t0 + max_new > self.engine.max_len:
+            raise PromptTooLongError(
+                f"prompt {t0} + new {max_new} exceeds engine max_len "
+                f"{self.engine.max_len}"
+            )
+        if self._bucket(t0) < t0 or self._bucket(t0) + max_new > self.L:
+            raise PromptTooLongError(
+                f"prompt {t0} (padded {self._bucket(t0)}) + new {max_new} "
+                f"exceeds the slot cache region ({self.L} slots)"
+            )
+        with self._lock:
+            # fill free slots first so max_queue bounds genuinely
+            # WAITING work, not work a free slot could take right now
+            self._admit_waiting()
+            if (
+                self.max_queue is not None
+                and not self._free
+                and len(self._queue) >= self.max_queue
+            ):
+                raise QueueFullError(
+                    f"{len(self._queue)} requests pending (max_queue="
+                    f"{self.max_queue})"
+                )
+            rid = self._next_rid
+            self._next_rid += 1
+            req = _Request(
+                rid=rid, ids=ids, max_new=max_new, seed=int(seed),
+                submitted_at=time.perf_counter(),
+            )
+            self._requests[rid] = req
+            if self._free:
+                self._admit(req)  # prefill dispatches immediately
+            else:
+                self._queue.append(req)
+        if self.metrics is not None:
+            self.metrics.incr("serving_requests_total")
+        self._event("serving.submit", rid=rid, prompt_len=t0)
+        return rid
+
+    def _admit_waiting(self) -> None:
+        while self._free and self._queue:
+            self._admit(self._queue.popleft())
+
+    def _admit(self, req: _Request) -> None:
+        slot = self._free.pop()
+        req.slot = slot
+        self._slot_req[slot] = req
+        t0 = int(req.ids.size)
+        Tp = self._bucket(t0)
+        ids = np.zeros((1, Tp), np.int32)
+        pm = np.zeros((1, Tp), np.int32)
+        ids[0, Tp - t0:] = req.ids
+        pm[0, Tp - t0:] = 1
+        fn = self._prefill_jit.get(Tp)
+        if fn is None:
+            fn = self._prefill_jit[Tp] = self._build_prefill(Tp)
+        self._state, tok0 = fn(
+            self.engine.params, self._state, jnp.asarray(ids),
+            jnp.asarray(pm), jnp.int32(slot), jnp.uint32(req.seed),
+            jnp.int32(req.max_new),
+        )
+        req.first_token = tok0
+        self._event("serving.admit", rid=req.rid, slot=slot, padded=Tp)
+
+    def _maybe_record_ttft(self, req: _Request) -> None:
+        if req.first_token_at is not None or req.first_token is None:
+            return
+        ready = getattr(req.first_token, "is_ready", None)
+        if ready is None or ready():
+            req.first_token_at = time.perf_counter()
+            if self.metrics is not None:
+                self.metrics.observe_hist(
+                    "serving_ttft_s", req.first_token_at - req.submitted_at
+                )
+
+    def _finish(self, req: _Request) -> None:
+        req.done = True
+        req.finished_at = time.perf_counter()
+        req.ids = None  # prompt no longer needed; keep retention light
+        slot = req.slot
+        if slot is not None and self._slot_req[slot] is req:
+            self._slot_req[slot] = None
+            self._free.append(slot)
+        # bounded result retention: results stay readable (result() is
+        # idempotent) until keep_results newer requests finished — a
+        # steady-traffic scheduler must not grow host memory forever
+        self._done_order.append(req.rid)
+        while len(self._done_order) > self.keep_results:
+            self._requests.pop(self._done_order.popleft(), None)
+        if self.metrics is not None:
+            self.metrics.incr("serving_tokens_total", len(req.tokens))
+            if req.first_token_at is not None and len(req.tokens) > 1:
+                self.metrics.observe_hist(
+                    "serving_tpot_s",
+                    (req.finished_at - req.first_token_at)
+                    / (len(req.tokens) - 1),
+                )
+        self._event(
+            "serving.finish", rid=req.rid, tokens=len(req.tokens),
+        )
+
+    def _append_token(self, req: _Request, tok: int) -> None:
+        if req.done:
+            return
+        req.tokens.append(int(tok))
+        eos = self.gen.eos_token_id
+        if len(req.tokens) >= req.max_new or (
+            eos is not None and int(tok) == eos
+        ):
+            self._finish(req)
+
+    def _drain_one(self) -> None:
+        toks, snapshot = self._inflight.popleft()
+        arr = np.asarray(toks)  # [K, S] — THE host sync point
+        for req in snapshot:
+            if req is not None:
+                self._take_first(req)
+        for k in range(arr.shape[0]):
+            for s, req in enumerate(snapshot):
+                if req is not None and not req.done:
+                    self._append_token(req, arr[k, s])
+
+    def _take_first(self, req: _Request) -> None:
+        """Fold the prefill's first token into the stream (syncs a
+        long-since-computed scalar). TTFT is recorded here at the
+        latest — _maybe_record_ttft covers every earlier opportunity,
+        including jax builds without Array.is_ready."""
+        if req.first_token is not None and not req.tokens:
+            t0 = int(np.asarray(req.first_token))
+            self._maybe_record_ttft(req)
+            req.first_token = None
+            self._append_token(req, t0)
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit waiting prompts into free
+        slots, dispatch one decode chunk, sync the oldest chunk once
+        ``pipeline_depth`` are in flight. Returns False when fully idle
+        (nothing queued, running, or in flight)."""
+        with self._lock:
+            self._admit_waiting()
+            busy = any(r is not None for r in self._slot_req)
+            if busy:
+                self._state, toks = self._decode(
+                    self.engine.params, self._state
+                )
+                self._inflight.append((toks, tuple(self._slot_req)))
+            for r in self._slot_req:
+                if r is not None:
+                    self._maybe_record_ttft(r)
+            while len(self._inflight) > (self.pipeline_depth if busy else 0):
+                self._drain_one()
+            return bool(
+                busy or self._queue or self._inflight
+            )
+
+    def result(self, rid: int, *, timeout_s: float | None = None) -> np.ndarray:
+        """Drive the serving loop until request ``rid`` finishes; return
+        its generated tokens (length <= its max_new; ends at EOS)."""
+        req = self._requests.get(rid)
+        if req is None:
+            raise KeyError(
+                f"unknown request id {rid} (never submitted, or its "
+                f"result was evicted after {self.keep_results} newer "
+                "completions — raise keep_results to retain more)"
+            )
+        deadline = (
+            time.perf_counter() + timeout_s if timeout_s is not None else None
+        )
+        while not req.done:
+            progressed = self.step()
+            if not progressed and not req.done:
+                raise ServingError(
+                    f"request {rid} cannot complete: scheduler idle "
+                    "(internal accounting bug)"
+                )
+            if deadline is not None and time.perf_counter() > deadline:
+                raise TimeoutError(f"request {rid} not done in {timeout_s}s")
+        return np.asarray(req.tokens, np.int32)
+
+    async def asubmit(
+        self, ids, *, max_new: int | None = None, seed: int = 0
+    ) -> int:
+        """Asyncio wrapper for ``submit``: admission dispatches a
+        prefill (and, for a new prompt-length bucket, compiles one) and
+        may contend with a pump thread holding the scheduler lock
+        across a chunk sync — none of which belongs on a node's event
+        loop."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: self.submit(ids, max_new=max_new, seed=seed)
+        )
+
+    async def aresult(self, rid: int, *, timeout_s: float | None = None):
+        """Asyncio wrapper: pump in a worker thread so a node event loop
+        can serve generation without blocking its RPC handlers."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, lambda: self.result(rid, timeout_s=timeout_s)
+        )
+
+    def run_until_idle(self) -> None:
+        """Process everything queued/in-flight to completion."""
+        while self.step():
+            pass
+
+    def stats(self) -> dict:
+        """Host-side scheduler snapshot (queue depth, slot occupancy)."""
+        with self._lock:
+            return {
+                "slots": self.slots,
+                "busy_slots": sum(
+                    1 for r in self._slot_req if r is not None
+                ),
+                "queued": len(self._queue),
+                "inflight_chunks": len(self._inflight),
+                "requests": len(self._requests),
+            }

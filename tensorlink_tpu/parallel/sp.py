@@ -223,14 +223,14 @@ def _rf_block_bwd(qt, k_blk, v_blk, out_t, lse, do_t, kvm, k_idx, idx,
 
 
 def _rf_fwd(q, k, v, kv_mask, causal, axis, interpret):
-    from tensorlink_tpu.ops.flash import _pick_block
+    from tensorlink_tpu.ops.flash import flash_block_for
     from tensorlink_tpu.ops.pallas.flash_attention import LSE_MASKED
 
     S = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
-    bq, bk = _pick_block(Tq), _pick_block(Tk)
+    bq, bk = flash_block_for(Tq, B), flash_block_for(Tk, B)
     qt = q.swapaxes(1, 2)  # [B, H, Tq, D]
     perm = [(i, (i - 1) % S) for i in range(S)]
 
@@ -282,13 +282,15 @@ def _rf_fwd(q, k, v, kv_mask, causal, axis, interpret):
 
 
 def _rf_bwd(causal, axis, interpret, res, g):
-    from tensorlink_tpu.ops.flash import _pick_block
+    from tensorlink_tpu.ops.flash import flash_block_for
 
     q, k, v, kv_mask, out_t, lse = res
     S = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
     Tq, Tk = q.shape[1], k.shape[1]
-    bq, bk = _pick_block(Tq), _pick_block(Tk)
+    bq, bk = (
+        flash_block_for(Tq, q.shape[0]), flash_block_for(Tk, q.shape[0])
+    )
     qt = q.swapaxes(1, 2)
     do_t = g.swapaxes(1, 2)
     perm = [(i, (i - 1) % S) for i in range(S)]

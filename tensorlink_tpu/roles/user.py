@@ -1050,6 +1050,23 @@ class UserNode(Node):
     def _unregister_job(self, job: "DistributedJob") -> None:
         self._jobs.pop(job.job.job_id, None)
 
+    def serving_engine(self, engine, **kw):
+        """The user role's LOCAL inference path: a continuous-batching
+        scheduler (parallel/serving.py) wired into this node's
+        observability — per-request TTFT/TPOT land in ``self.metrics``
+        (served at ``GET /metrics``, Prometheus included) and
+        submit/admit/finish events in the flight recorder (``GET
+        /events``). Drive it from async handlers via ``await
+        asubmit()`` + ``await aresult(rid)`` — both hop to a worker
+        thread, so neither prefill compiles nor chunk syncs land on the
+        node's event loop; the distributed pipelined path stays
+        ``DistributedJob.forward``."""
+        from tensorlink_tpu.parallel.serving import ContinuousBatchingEngine
+
+        kw.setdefault("metrics", self.metrics)
+        kw.setdefault("recorder", self.flight)
+        return ContinuousBatchingEngine(engine, **kw)
+
     def on_peer_lost(self, peer: Peer) -> None:
         for dj in list(self._jobs.values()):
             jid = dj.job.job_id[:16]

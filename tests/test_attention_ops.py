@@ -1009,3 +1009,184 @@ def test_window_supports_window_escape_hatch():
 
     with pytest.raises(ValueError, match="supports_window"):
         MultiHeadAttention(16, 2, causal=True, attn_impl=silent, window=4)
+
+
+# ------------------------- per-row cache indices (continuous batching)
+def test_vector_cache_index_matches_scalar_decode():
+    """[B]-shaped cache index (parallel/serving.py slot form): a decode
+    step where every row happens to share the same index must match the
+    scalar-index path bitwise, and a row parked AT capacity must write
+    nothing (mode="drop")."""
+    from tensorlink_tpu.nn.attention import MultiHeadAttention
+
+    m = MultiHeadAttention(
+        32, 4, num_kv_heads=2, causal=True, rope=True,
+        attn_impl="reference",
+    )
+    p = m.init(KEY)
+    B, L = 3, 16
+    cache = m.init_cache(B, L, jnp.float32)
+    r = np.random.default_rng(0)
+    x0 = jnp.asarray(r.standard_normal((B, 5, 32)), jnp.float32)
+    mask5 = jnp.broadcast_to(
+        jnp.tril(jnp.ones((5, 5), bool))[None, None], (B, 1, 5, 5)
+    )
+    pos5 = jnp.broadcast_to(jnp.arange(5)[None], (B, 5))
+    _, cache = m.apply(p, x0, cache=cache, mask=mask5, positions=pos5)
+
+    x1 = jnp.asarray(r.standard_normal((B, 1, 32)), jnp.float32)
+    valid = jnp.broadcast_to(
+        (jnp.arange(L) < 6)[None, None, None, :], (B, 1, 1, L)
+    )
+    pos = jnp.full((B, 1), 5)
+    o_scalar, c_s = m.apply(p, x1, cache=cache, positions=pos, mask=valid)
+    cache_v = dict(cache)
+    cache_v["index"] = jnp.full((B,), 5, jnp.int32)
+    o_vec, c_v = m.apply(p, x1, cache=cache_v, positions=pos, mask=valid)
+    np.testing.assert_array_equal(np.asarray(o_scalar), np.asarray(o_vec))
+    np.testing.assert_array_equal(np.asarray(c_s["k"]), np.asarray(c_v["k"]))
+    np.testing.assert_array_equal(
+        np.asarray(c_v["index"]), np.full((B,), 6)
+    )
+
+    # heterogeneous indices: each row writes ITS slot; a row at capacity
+    # drops its write instead of clobbering slot L-1
+    cache_d = dict(cache)
+    cache_d["index"] = jnp.asarray([5, L, 3], jnp.int32)
+    _, c_d = m.apply(p, x1, cache=cache_d, positions=pos, mask=valid)
+    np.testing.assert_array_equal(
+        np.asarray(c_d["k"][1]), np.asarray(cache["k"][1])
+    )
+    assert not np.array_equal(
+        np.asarray(c_d["k"][2, 3]), np.asarray(cache["k"][2, 3])
+    )
+
+
+def test_vector_cache_index_contract_errors():
+    from tensorlink_tpu.nn.attention import MultiHeadAttention
+
+    m = MultiHeadAttention(
+        32, 4, causal=True, rope=True, attn_impl="reference"
+    )
+    p = m.init(KEY)
+    cache = m.init_cache(2, 8, jnp.float32)
+    cache = dict(cache)
+    cache["index"] = jnp.zeros((2,), jnp.int32)
+    x2 = jnp.zeros((2, 2, 32), jnp.float32)
+    with pytest.raises(ValueError, match="single-token"):
+        m.apply(p, x2, cache=cache, positions=jnp.zeros((2, 2), jnp.int32))
+    x1 = jnp.zeros((2, 1, 32), jnp.float32)
+    # rope consumes positions; per-row indices cannot reconstruct them
+    with pytest.raises(ValueError, match="positions"):
+        m.apply(p, x1, cache=cache)
+    with pytest.raises(ValueError, match="cache-width"):
+        m.apply(
+            p, x1, cache=cache, positions=jnp.zeros((2, 1), jnp.int32),
+            mask=jnp.ones((2, 1, 1, 3), bool),
+        )
+    # a rope-less module (learned positions live at the embedding) may
+    # omit positions on the per-row path — nothing consumes them
+    m2 = MultiHeadAttention(32, 4, causal=True, attn_impl="reference")
+    p2 = m2.init(KEY)
+    c2 = dict(m2.init_cache(2, 8, jnp.float32))
+    c2["index"] = jnp.zeros((2,), jnp.int32)
+    out, _ = m2.apply(p2, x1, cache=c2)
+    assert out.shape == (2, 1, 32)
+
+
+# ------------------------------------------- fused decode glue (Pallas)
+@pytest.mark.parametrize("kind", ["layer", "rms"])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_decode_glue_kernel_matches_fallback(kind, dtype):
+    """The fused residual+norm kernel (interpret mode) == the jnp
+    fallback == the unfused layers.py math."""
+    from tensorlink_tpu.nn.layers import LayerNorm, RMSNorm
+    from tensorlink_tpu.ops.pallas.decode_glue import fused_residual_norm
+
+    r = np.random.default_rng(0)
+    D = 256
+    x = jnp.asarray(r.standard_normal((2, 1, D)), dtype)
+    res = jnp.asarray(r.standard_normal((2, 1, D)), dtype)
+    scale = jnp.asarray(r.standard_normal(D), jnp.float32)
+    bias = (
+        jnp.asarray(r.standard_normal(D), jnp.float32)
+        if kind == "layer" else None
+    )
+    eps = 1e-5
+    rk, yk = fused_residual_norm(
+        x, res, scale, bias, eps=eps, kind=kind, interpret=True
+    )
+    rf, yf = fused_residual_norm(x, res, scale, bias, eps=eps, kind=kind)
+    tol = 2e-6 if dtype == jnp.float32 else 2e-2
+    np.testing.assert_allclose(
+        np.asarray(rk, np.float32), np.asarray(rf, np.float32),
+        rtol=tol, atol=tol,
+    )
+    np.testing.assert_allclose(
+        np.asarray(yk, np.float32), np.asarray(yf, np.float32),
+        rtol=tol, atol=tol,
+    )
+    # against the module the block would otherwise run
+    norm = LayerNorm(D, eps=eps) if kind == "layer" else RMSNorm(D, eps=eps)
+    params = {"scale": scale} if bias is None else {
+        "scale": scale, "bias": bias,
+    }
+    y_mod = norm.apply(params, (x + res))
+    np.testing.assert_allclose(
+        np.asarray(yk, np.float32), np.asarray(y_mod, np.float32),
+        rtol=max(tol, 2e-6), atol=max(tol, 2e-6),
+    )
+
+
+def test_decode_glue_rejects_bad_shapes():
+    from tensorlink_tpu.ops.pallas.decode_glue import fused_residual_norm
+
+    x = jnp.zeros((2, 1, 8))
+    with pytest.raises(ValueError, match="mismatch"):
+        fused_residual_norm(x, jnp.zeros((2, 2, 8)), jnp.ones(8))
+    with pytest.raises(ValueError, match="kind"):
+        fused_residual_norm(x, x, jnp.ones(8), kind="batch")
+
+
+# --------------------------------------- flash block-size overrides
+def test_flash_block_override_registry():
+    from tensorlink_tpu.ops.flash import (
+        clear_flash_block_overrides,
+        flash_block_for,
+        set_flash_block_override,
+    )
+
+    clear_flash_block_overrides()
+    try:
+        assert flash_block_for(512) == 512  # heuristic default
+        assert flash_block_for(8192) == 512
+        set_flash_block_override(512, 256)
+        set_flash_block_override(512, 128, batch=8)
+        assert flash_block_for(512, 8) == 128  # exact (seq, batch) wins
+        assert flash_block_for(512, 2) == 256  # any-batch next
+        assert flash_block_for(1024, 8) == 512  # untouched shapes keep
+        with pytest.raises(ValueError, match="divide"):
+            set_flash_block_override(512, 96)
+    finally:
+        clear_flash_block_overrides()
+    assert flash_block_for(512) == 512
+
+
+def test_flash_override_kernel_parity():
+    """An overridden block size changes the grid, not the math."""
+    from tensorlink_tpu.ops.flash import (
+        clear_flash_block_overrides,
+        flash_attention,
+        set_flash_block_override,
+    )
+
+    q, k, v = _qkv(T=256)
+    ref = np.asarray(flash_attention(q, k, v, causal=True, interpret=True))
+    set_flash_block_override(256, 64)
+    try:
+        out = np.asarray(
+            flash_attention(q, k, v, causal=True, interpret=True)
+        )
+    finally:
+        clear_flash_block_overrides()
+    np.testing.assert_allclose(out, ref, atol=2e-5)

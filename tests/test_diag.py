@@ -50,6 +50,41 @@ def test_bench_diff_directions_and_threshold():
     assert "REGRESSION value" in text and "improved" in text
 
 
+def test_bench_diff_serving_and_quality_key_directions():
+    """The ISSUE-5 serving/quality keys carry the right verdict
+    direction: tok/s and the continuous-vs-static ratio are
+    higher-better; TTFT/TPOT latencies and the int8 logit KL are
+    lower-better (a 'bigger KL' improvement verdict would bless a
+    quality regression)."""
+    old = {
+        "serving_continuous_tokens_per_sec": 10000.0,
+        "serving_continuous_vs_static": 0.95,
+        "serving_ttft_p50_s": 0.030,
+        "serving_tpot_p99_s": 0.004,
+        "int8_quality": {"logit_kl_mean": 0.001},
+        "seq512_mfu_xla": 0.40,
+    }
+    new = {
+        "serving_continuous_tokens_per_sec": 8000.0,   # -20% -> regression
+        "serving_continuous_vs_static": 1.05,          # +10% -> improvement
+        "serving_ttft_p50_s": 0.050,                   # +67% -> regression
+        "serving_tpot_p99_s": 0.003,                   # -25% -> improvement
+        "int8_quality": {"logit_kl_mean": 0.01},       # 10x KL -> regression
+        "seq512_mfu_xla": 0.50,                        # +25% -> improvement
+    }
+    d = bench_diff(old, new, threshold=0.05)
+    assert set(d["regressions"]) == {
+        "serving_continuous_tokens_per_sec",
+        "serving_ttft_p50_s",
+        "int8_quality.logit_kl_mean",
+    }
+    assert set(d["improvements"]) == {
+        "serving_continuous_vs_static",
+        "serving_tpot_p99_s",
+        "seq512_mfu_xla",
+    }
+
+
 def test_bench_diff_unwraps_committed_wrapper():
     """BENCH_r*.json wraps the bench line under `parsed` (or, when the
     driver failed to parse, leaves it in the captured `tail`)."""

@@ -465,6 +465,80 @@ def test_kv_seq_shard_requires_seq_axis(tiny_llama):
         )
 
 
+def test_kv_seq_shard_hlo_pin_no_cache_gather(devices):
+    """Pin kv_seq_shard's LOWERING, not just its outputs (VERDICT #5):
+    compile the sharded decode program and assert from the HLO text that
+    the KV cache stays sharded end to end — every cache k/v write
+    operates on the 1/S slot shard, the full-width cache shape appears
+    NOWHERE, and no all-gather materializes more than the admitted
+    one-layer k/v transient. If the partitioner ever regresses to
+    gathering the cache (the failure mode that turns sequence-sharded
+    serving into replicated serving plus collectives), this fails."""
+    import re
+
+    B, T0, N = 2, 64, 1200
+    S = 4  # seq-axis shards
+    cfg = LlamaConfig(
+        vocab_size=64, dim=64, num_layers=2, num_heads=4, num_kv_heads=4,
+        hidden_dim=128, max_len=2048,
+    )
+    m = Llama(cfg)
+    p = m.init(KEY)
+    mesh = make_mesh(MeshConfig(seq=S))
+    eng = InferenceEngine(
+        mesh, m, p, max_len=2048, cache_dtype=jnp.float32,
+        param_dtype=jnp.float32, kv_seq_shard=True,
+    )
+    gen = GenerationConfig(max_new_tokens=N)
+    from tensorlink_tpu.nn.attention import DECODE_BLOCK
+
+    L = -(-(T0 + N) // DECODE_BLOCK) * DECODE_BLOCK
+    assert L % S == 0
+    Hkv, Dh = cfg.num_kv_heads, cfg.dim // cfg.num_heads
+    fn = eng._build(B, T0, gen)
+    compiled = fn.lower(
+        eng.params, jnp.zeros((B, T0), jnp.int32),
+        jnp.ones((B, T0), jnp.int32), jax.random.key(0),
+    ).compile()
+    txt = compiled.as_text()
+
+    # 1. cache writes land on the shard: k and v of every layer, in both
+    # prefill and the decode scan body
+    shard_dus = re.findall(
+        rf"dynamic-update-slice\(f32\[{B},{L // S},{Hkv},{Dh}\]", txt
+    )
+    assert len(shard_dus) >= 2 * cfg.num_layers, (
+        f"expected sharded cache updates, found {len(shard_dus)}"
+    )
+    # 2. the full-width cache tensor must not exist anywhere in the
+    # program — not as a write target, not as a collective result
+    assert f"f32[{B},{L},{Hkv},{Dh}]" not in txt, (
+        "full-width KV cache materialized: the partitioner gathered "
+        "the cache"
+    )
+    # 3. collective budget: an all-gather may transiently assemble AT
+    # MOST one layer's k/v; anything larger means the cache (or several
+    # layers of it) is being gathered per step
+    one_kv = B * L * Hkv * Dh  # elements of one full-width k (or v)
+    gathered = []
+    for line in txt.splitlines():
+        if " all-gather(" not in line:
+            continue
+        mshape = re.search(r"=\s+\S*?\[([\d,]*)\]", line)
+        if not mshape or not mshape.group(1):
+            continue
+        elems = 1
+        for d in mshape.group(1).split(","):
+            elems *= int(d)
+        gathered.append(elems)
+    offenders = [g for g in gathered if g >= 2 * one_kv]
+    assert not offenders, (
+        f"all-gather of {offenders} elements (> one layer's k+v "
+        f"{2 * one_kv}): KV cache sharding regressed"
+    )
+    assert len([g for g in gathered if g >= one_kv]) <= 2, gathered
+
+
 def test_single_token_prompt_matches_naive(tiny_llama):
     """T0==1 prompts build a [B,1,1,1] prefill mask — now classified as
     the fresh single-token prefill (ADVICE r5: as non-fresh it broadcast

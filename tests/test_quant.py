@@ -207,3 +207,38 @@ def test_quantized_random_init_norm_gains_are_ones():
     assert scales, "model has no norm gains? key layout changed"
     for s in scales:
         np.testing.assert_array_equal(s, np.ones_like(s))
+
+
+def test_int8_logit_quality_bounded():
+    """End-to-end int8 quality (VERDICT #8): the mean KL divergence
+    between full-precision and int8 weight-only logits on a fixed eval
+    batch stays under a stated bound. bench.py measures the same
+    quantity on GPT-2 small as ``int8_quality.logit_kl_mean``; this
+    pins the math and the bound on a CI-sized model."""
+    from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config
+    from tensorlink_tpu.ops.quant import quantize_params_int8
+
+    cfg = GPT2Config(
+        vocab_size=256, dim=64, num_layers=2, num_heads=4, max_len=64,
+        dropout=0.0,
+    )
+    model = GPT2(cfg)
+    params = model.init(KEY)
+    qparams = quantize_params_int8(model, params)
+    r = np.random.default_rng(0)
+    ids = jnp.asarray(r.integers(0, cfg.vocab_size, (4, 32)))
+    lp = np.asarray(model.apply(params, ids), np.float32)
+    lq = np.asarray(model.apply(qparams, ids), np.float32)
+
+    def log_softmax(x):
+        x = x - x.max(-1, keepdims=True)
+        return x - np.log(np.exp(x).sum(-1, keepdims=True))
+
+    p = np.exp(log_softmax(lp))
+    kl = (p * (log_softmax(lp) - log_softmax(lq))).sum(-1)
+    assert np.all(np.isfinite(kl))
+    mean_kl = float(kl.mean())
+    # symmetric per-channel int8 keeps the output distribution
+    # essentially intact; 0.02 nats mean KL is ~10x headroom over what
+    # a healthy quantization produces at this size
+    assert mean_kl < 0.02, mean_kl

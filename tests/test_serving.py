@@ -1,0 +1,301 @@
+"""Continuous-batching serving engine (parallel/serving.py).
+
+Pins the scheduler's contract: token-level greedy parity with the
+static engine, slot-exhaustion backpressure, mid-stream EOS freeing a
+slot that is immediately re-admitted, typed rejection of prompts that
+cannot fit a slot's cache region, per-request RNG streams that are
+independent of slot assignment and co-tenant traffic, and TTFT/TPOT
+metrics through the Metrics registry.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.config import MeshConfig
+from tensorlink_tpu.models.llama import Llama, LlamaConfig
+from tensorlink_tpu.parallel.inference import GenerationConfig, InferenceEngine
+from tensorlink_tpu.parallel.serving import (
+    ContinuousBatchingEngine,
+    PromptTooLongError,
+    QueueFullError,
+)
+from tensorlink_tpu.runtime.mesh import make_mesh
+
+KEY = jax.random.key(0)
+
+
+@pytest.fixture(scope="module")
+def tiny_engine():
+    cfg = LlamaConfig.tiny()
+    m = Llama(cfg)
+    p = m.init(KEY)
+    eng = InferenceEngine(
+        make_mesh(MeshConfig()), m, p, max_len=32,
+        cache_dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    return cfg, m, p, eng
+
+
+def _prompts(cfg, lengths, seed=0):
+    r = np.random.default_rng(seed)
+    return [r.integers(0, cfg.vocab_size, (n,)) for n in lengths]
+
+
+def test_greedy_parity_with_static_engine(tiny_engine):
+    """Staggered prompts of mixed lengths through 2 slots must produce
+    EXACTLY the tokens the static engine produces for each prompt alone
+    (greedy): the acceptance bar for continuous batching."""
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=6)
+    prompts = _prompts(cfg, (5, 3, 7, 4, 6, 2))
+    refs = [np.asarray(eng.generate(pr[None], gen))[0] for pr in prompts]
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=3, prefill_block=4
+    )
+    rids = [sch.submit(pr) for pr in prompts]
+    for rid, ref in zip(rids, refs):
+        np.testing.assert_array_equal(sch.result(rid), ref)
+
+
+def test_slot_exhaustion_backpressures_queue(tiny_engine):
+    """More requests than slots: the overflow queues (no error, no loss)
+    and every request still completes with correct tokens."""
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=5)
+    prompts = _prompts(cfg, (4, 4, 4, 4, 4, 4, 4), seed=1)
+    refs = [np.asarray(eng.generate(pr[None], gen))[0] for pr in prompts]
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=2, prefill_block=4
+    )
+    rids = [sch.submit(pr) for pr in prompts]
+    assert sch.stats()["queued"] >= len(prompts) - 2  # admission is lazy
+    sch.run_until_idle()
+    for rid, ref in zip(rids, refs):
+        np.testing.assert_array_equal(sch.result(rid), ref)
+
+
+def test_max_queue_raises_typed_error(tiny_engine):
+    cfg, m, p, eng = tiny_engine
+    sch = ContinuousBatchingEngine(
+        eng, slots=1, gen=GenerationConfig(max_new_tokens=4),
+        prefill_block=4, max_queue=1,
+    )
+    pr = _prompts(cfg, (4,))[0]
+    sch.submit(pr)
+    sch.submit(pr)  # first pending admission fills the queue
+    with pytest.raises(QueueFullError):
+        sch.submit(pr)
+
+
+def test_eos_frees_slot_for_immediate_readmission(tiny_engine):
+    """A request ending at EOS mid-stream releases its slot; a queued
+    request is admitted into that same slot and decodes correctly."""
+    cfg, m, p, eng = tiny_engine
+    pr_a, pr_b = _prompts(cfg, (5, 6), seed=3)
+    free = np.asarray(
+        eng.generate(pr_a[None], GenerationConfig(max_new_tokens=8))
+    )[0]
+    eos = int(free[2])  # the 3rd generated token becomes "eos"
+    gen = GenerationConfig(max_new_tokens=8, eos_token_id=eos)
+    ref_a = np.asarray(eng.generate(pr_a[None], gen))[0]
+    ref_b = np.asarray(eng.generate(pr_b[None], gen))[0]
+    sch = ContinuousBatchingEngine(
+        eng, slots=1, gen=gen, decode_chunk=2, prefill_block=4
+    )
+    ra, rb = sch.submit(pr_a), sch.submit(pr_b)
+    out_a, out_b = sch.result(ra), sch.result(rb)
+    # a ends early at eos; engine output pads with eos after termination
+    assert out_a[-1] == eos and len(out_a) == 3
+    np.testing.assert_array_equal(out_a, ref_a[: len(out_a)])
+    # b re-used the single slot after a's EOS; must match its solo run
+    # up to ITS eos point
+    stop = len(out_b)
+    assert stop == 8 or out_b[-1] == eos
+    np.testing.assert_array_equal(out_b, ref_b[:stop])
+    assert sch.stats()["busy_slots"] == 0
+
+
+def test_prompt_too_long_typed_rejection(tiny_engine):
+    cfg, m, p, eng = tiny_engine
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=GenerationConfig(max_new_tokens=8), prefill_block=4
+    )
+    with pytest.raises(PromptTooLongError):
+        sch.submit(np.arange(40))  # > max_len outright
+    with pytest.raises(PromptTooLongError):
+        sch.submit(np.arange(28))  # prompt + max_new > cache region
+    with pytest.raises(ValueError):
+        sch.submit(np.arange(0))  # empty prompt
+    # a fitting prompt still serves after the rejections
+    ok = sch.submit(np.arange(4) % cfg.vocab_size)
+    assert len(sch.result(ok)) == 8
+
+
+def test_per_request_rng_independent_of_traffic(tiny_engine):
+    """Sampling keys derive from (request seed, logical position) only:
+    the same request yields the same tokens alone on 4 slots and amid
+    co-tenant traffic in a different slot."""
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=8, temperature=0.9, top_k=8)
+    pr = _prompts(cfg, (5,), seed=5)[0]
+    alone = ContinuousBatchingEngine(
+        eng, slots=4, gen=gen, decode_chunk=2, prefill_block=4
+    )
+    a = alone.result(alone.submit(pr, seed=42))
+    busy = ContinuousBatchingEngine(
+        eng, slots=4, gen=gen, decode_chunk=2, prefill_block=4
+    )
+    others = _prompts(cfg, (3, 6, 4), seed=6)
+    for i, o in enumerate(others):
+        busy.submit(o, seed=100 + i)
+    b = busy.result(busy.submit(pr, seed=42))
+    np.testing.assert_array_equal(a, b)
+    # a different seed actually changes the draw
+    c = alone.result(alone.submit(pr, seed=43))
+    assert list(c) != list(a)
+
+
+def test_windowed_model_parity():
+    """Sliding-window model (monotone cache) through the scheduler: the
+    per-row window band must match the engine's scalar-index band."""
+    cfg = LlamaConfig.mistral_tiny()  # window 8
+    m = Llama(cfg)
+    p = m.init(jax.random.key(3))
+    eng = InferenceEngine(
+        make_mesh(MeshConfig()), m, p, max_len=64,
+        cache_dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    gen = GenerationConfig(max_new_tokens=16)
+    prompts = _prompts(cfg, (12, 4), seed=7)  # prompt > window and <
+    refs = [np.asarray(eng.generate(pr[None], gen))[0] for pr in prompts]
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=4, prefill_block=4
+    )
+    rids = [sch.submit(pr) for pr in prompts]
+    for rid, ref in zip(rids, refs):
+        np.testing.assert_array_equal(sch.result(rid), ref)
+
+
+def test_max_new_one_and_per_request_budget(tiny_engine):
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=6)
+    pr = _prompts(cfg, (5,), seed=8)[0]
+    ref = np.asarray(eng.generate(pr[None], gen))[0]
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=3, prefill_block=4
+    )
+    r1 = sch.submit(pr, max_new=1)
+    r2 = sch.submit(pr, max_new=4)
+    np.testing.assert_array_equal(sch.result(r1), ref[:1])
+    np.testing.assert_array_equal(sch.result(r2), ref[:4])
+
+
+def test_ttft_tpot_metrics_and_counters(tiny_engine):
+    from tensorlink_tpu.runtime.metrics import Metrics
+
+    cfg, m, p, eng = tiny_engine
+    metrics = Metrics()
+    gen = GenerationConfig(max_new_tokens=5)
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=2, prefill_block=4,
+        metrics=metrics,
+    )
+    prompts = _prompts(cfg, (4, 5, 3), seed=9)
+    rids = [sch.submit(pr) for pr in prompts]
+    for rid in rids:
+        sch.result(rid)
+    snap = metrics.snapshot()
+    assert snap["counters"]["serving_requests_total"] == 3
+    assert snap["counters"]["serving_tokens_total"] == 15
+    h = snap["histograms"]
+    assert h["serving_ttft_s"]["n"] == 3
+    assert h["serving_tpot_s"]["n"] == 3
+    assert h["serving_ttft_s"]["sum"] > 0
+
+
+def test_user_node_serving_engine_wires_observability(tiny_engine):
+    """The user role's local inference path: serving through
+    UserNode.serving_engine lands TTFT/TPOT in the node's /metrics
+    registry and request lifecycle events in its flight recorder."""
+    from tensorlink_tpu.config import NodeConfig
+    from tensorlink_tpu.roles.user import UserNode
+
+    cfg, m, p, eng = tiny_engine
+    node = UserNode(NodeConfig(role="user", host="127.0.0.1", port=0))
+    sch = node.serving_engine(
+        eng, slots=2, gen=GenerationConfig(max_new_tokens=4),
+        prefill_block=4,
+    )
+    pr = _prompts(cfg, (4,), seed=10)[0]
+    out = sch.result(sch.submit(pr))
+    assert len(out) == 4
+    assert node.metrics.histograms["serving_ttft_s"].n == 1
+    kinds = [e["kind"] for e in node.flight.events()]
+    for k in ("serving.submit", "serving.admit", "serving.finish"):
+        assert k in kinds, kinds
+
+
+def test_rejects_rolling_and_seq_sharded_engines(devices):
+    cfg = LlamaConfig.mistral_tiny()
+    m = Llama(cfg)
+    p = m.init(jax.random.key(1))
+    ring = InferenceEngine(
+        make_mesh(MeshConfig()), m, p, max_len=32,
+        cache_dtype=jnp.float32, param_dtype=jnp.float32,
+        rolling_cache=True,
+    )
+    with pytest.raises(NotImplementedError, match="rolling"):
+        ContinuousBatchingEngine(ring, slots=2)
+    cfg2 = LlamaConfig.tiny()
+    m2 = Llama(cfg2)
+    sharded = InferenceEngine(
+        make_mesh(MeshConfig(seq=4)), m2, m2.init(jax.random.key(2)),
+        max_len=32, cache_dtype=jnp.float32, param_dtype=jnp.float32,
+        kv_seq_shard=True,
+    )
+    with pytest.raises(NotImplementedError, match="kv_seq_shard"):
+        ContinuousBatchingEngine(sharded, slots=2)
+
+
+def test_result_retention_bounded(tiny_engine):
+    """Finished requests stay readable (result() is idempotent) until
+    keep_results newer completions evict them — host memory must not
+    grow with total traffic."""
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=3)
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=2, prefill_block=4,
+        keep_results=2,
+    )
+    prompts = _prompts(cfg, (4, 4, 4, 4), seed=12)
+    rids = [sch.submit(pr) for pr in prompts]
+    sch.run_until_idle()
+    # newest two readable, twice
+    for rid in rids[-2:]:
+        a = sch.result(rid)
+        np.testing.assert_array_equal(a, sch.result(rid))
+    for rid in rids[:2]:
+        with pytest.raises(KeyError, match="evicted"):
+            sch.result(rid)
+    assert sch.stats()["requests"] <= 2
+
+
+def test_async_result_wrapper(tiny_engine):
+    import asyncio
+
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=4)
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=2, prefill_block=4
+    )
+    ref = np.asarray(
+        eng.generate(_prompts(cfg, (4,), seed=11)[0][None], gen)
+    )[0]
+
+    async def go():
+        rid = await sch.asubmit(_prompts(cfg, (4,), seed=11)[0])
+        return await sch.aresult(rid, timeout_s=120)
+
+    np.testing.assert_array_equal(asyncio.run(go()), ref)
