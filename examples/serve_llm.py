@@ -51,8 +51,13 @@ def main() -> None:
                     help="serve staggered requests through the "
                          "continuous-batching scheduler (submit/result) "
                          "instead of one static generate() batch")
+    ap.add_argument("--paged", action="store_true",
+                    help="continuous batching over the paged KV cache "
+                         "(block pool + copy-on-write prefix sharing + "
+                         "chunked prefill); demonstrates shared-system-"
+                         "prompt traffic hitting the prefix cache")
     ap.add_argument("--slots", type=int, default=4,
-                    help="decode slots for --continuous")
+                    help="decode slots for --continuous/--paged")
     args = ap.parse_args()
 
     # tiny config so the example runs on a dev box; swap for
@@ -72,7 +77,10 @@ def main() -> None:
         # windowed models serve from a ring KV cache: O(prompt+window)
         # memory no matter how long the generation runs — the static
         # path only; the continuous scheduler uses the monotone cache
-        rolling_cache=args.window is not None and not args.continuous,
+        rolling_cache=(
+            args.window is not None
+            and not args.continuous and not args.paged
+        ),
     )
     gen = GenerationConfig(
         max_new_tokens=args.max_new,
@@ -81,8 +89,45 @@ def main() -> None:
     )
     rng = np.random.default_rng(0)
     print(f"mesh={dict(mesh.shape)} window={cfg.attn_window} "
-          f"int8={args.int8} continuous={args.continuous}")
-    if args.continuous:
+          f"int8={args.int8} continuous={args.continuous} "
+          f"paged={args.paged}")
+    if args.paged:
+        # shared-prefix traffic: every request opens with the same
+        # "system prompt". The first prefill writes those tokens into
+        # pool blocks and registers them in the prefix index; every
+        # later request maps the resident blocks (refcount++) and
+        # prefills ONLY its unique suffix. A request that would extend
+        # a block other requests still share gets a copy-on-write
+        # duplicate instead. HBM holds live blocks, not slots*max_len.
+        from tensorlink_tpu.parallel.serving import (
+            PagedContinuousBatchingEngine,
+        )
+
+        sch = PagedContinuousBatchingEngine(
+            eng, slots=args.slots, gen=gen, decode_chunk=8,
+            block_size=16, prefill_chunk=16,
+        )
+        system = rng.integers(0, cfg.vocab_size, (24,))
+        rids = [
+            sch.submit(
+                np.concatenate(
+                    [system, rng.integers(0, cfg.vocab_size, (n,))]
+                ),
+                seed=i,
+            )
+            for i, n in enumerate((5, 8, 3, 11, 6, 8))
+        ]
+        for rid in rids:
+            print(f"request {rid}:", sch.result(rid))
+        st = sch.stats()
+        print(
+            f"prefix hit rate {st['prefix_cache_hit_rate']:.2f} "
+            f"({st['prefix_matched_tokens']}/{st['prompt_tokens_total']} "
+            f"prompt tokens served from resident blocks); "
+            f"peak blocks {st['peak_blocks_in_use']} "
+            f"of {st['pool']['num_blocks']}"
+        )
+    elif args.continuous:
         # staggered traffic: variable-length prompts submitted one by
         # one, interleaved prefill+decode over a fixed slot batch;
         # per-request seeds keep each stream deterministic under any

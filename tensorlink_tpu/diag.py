@@ -139,6 +139,7 @@ def node_row(
         "skew": None,
         "anomalies": {},
         "error_events": 0,
+        "kv_pool_pct": None,
         "flags": [],
     }
     if scrape.get("error"):
@@ -175,6 +176,19 @@ def node_row(
             row["flags"].append(
                 f"STRAGGLER(stage {stragglers.get('slowest_stage')})"
             )
+    serving = node.get("serving") or {}
+    pool = serving.get("pool") or {}
+    util = pool.get("utilization")
+    if util is not None:
+        # paged-KV pool pressure (serving nodes): a pool near capacity
+        # is the serving analogue of a stale heartbeat — admissions are
+        # about to backpressure with PoolExhaustedError
+        row["kv_pool_pct"] = round(float(util) * 100, 1)
+        if float(util) >= 0.9:
+            row["flags"].append(
+                f"KV-PRESSURE({pool.get('blocks_in_use')}/"
+                f"{pool.get('num_blocks')})"
+            )
     metrics = _route_body(scrape, "/metrics") or {}
     counters = metrics.get("counters") or {}
     row["anomalies"] = {
@@ -200,9 +214,10 @@ def cluster_table(
 
 def render_table(rows: list[dict[str, Any]]) -> str:
     cols = ("target", "role", "node_id", "healthy", "peers",
-            "max_heartbeat_age_s", "skew", "error_events", "flags")
+            "max_heartbeat_age_s", "skew", "kv_pool_pct",
+            "error_events", "flags")
     titles = ("TARGET", "ROLE", "NODE", "OK", "PEERS", "HB-AGE",
-              "SKEW", "ERR-EVTS", "FLAGS")
+              "SKEW", "KV%", "ERR-EVTS", "FLAGS")
 
     def cell(row: dict, col: str) -> str:
         v = row.get(col)
@@ -239,12 +254,19 @@ _HIGHER_BETTER = (
     # continuous-vs-static serving ratio: 1.0 = parity, higher = the
     # scheduler beats the static batch
     "vs_static",
+    # paged KV cache: prefix sharing served MORE prompt tokens from
+    # resident blocks
+    "hit_rate",
 )
 _LOWER_BETTER_RE = re.compile(
     r"(_s$|_s_per_call$|seconds|latency|bubble_fraction|drop_fraction"
     # serving latency percentiles (TTFT/TPOT histograms) and the int8
     # quality KL: smaller is better even where the unit suffix differs
-    r"|ttft|tpot|(^|_)kl(_|$))"
+    r"|ttft|tpot|(^|_)kl(_|$)"
+    # paged KV cache at fixed bench traffic: fewer blocks / lower pool
+    # pressure / fewer re-prefilled tokens = the sharing is working
+    r"|kv_blocks|kv_pool_utilization|prefilled_tokens|cow_copies"
+    r"|preempt)"
 )
 
 

@@ -445,6 +445,20 @@ class MultiHeadAttention(Module):
                 "the encoder k/v once (project_kv) and pass them per step "
                 "WITHOUT a cache (models/t5.py greedy_decode does)"
             )
+        if cache is not None and "block_table" in cache:
+            # paged KV cache (parallel/kvpool.py pool + serving block
+            # tables): addressing generalizes the per-row slot form from
+            # ``slot_base + pos`` to ``block_table[pos // bs] * bs +
+            # pos % bs``. Shapes are fully static — the block table is
+            # a traced operand, so any request mix reuses one program.
+            if bias is not None:
+                raise NotImplementedError(
+                    "additive attention bias with a paged cache is not "
+                    "supported (no cached cross-attention exists to "
+                    "need it)"
+                )
+            out, new_cache = self._apply_paged(params, q, k, v, cache, mask)
+            return out, new_cache
         if cache is not None:
             rolling = "rolling" in cache
             # per-row cache indices ([B]-shaped ``index``): the
@@ -728,6 +742,111 @@ class MultiHeadAttention(Module):
             return out, new_cache
         return out
 
+    def _apply_paged(self, params, q, k, v, cache, mask):
+        """Paged-cache attention: scatter the T fresh tokens through the
+        per-row block table into the shared block pools, gather each
+        row's logical view back, and attend it mask-authoritatively.
+
+        Cache form (parallel/serving.py paged engine):
+          ``k``/``v``  [num_blocks, block_size, Hkv, D] — POOLS shared
+                       by every row (and owned by the host-side
+                       ``BlockPool``);
+          ``index``    [B] int32 — each row's logical write position
+                       (== its token count: paged rows are never
+                       padded);
+          ``block_table`` [B, max_blocks] int32 — row r's logical block
+                       j lives in pool block ``block_table[r, j]``; the
+                       sentinel value ``num_blocks`` marks unmapped
+                       entries (writes through them are DROPPED — they
+                       must never corrupt another request's block).
+
+        Works for single-token decode (T == 1) AND multi-token chunked
+        prefill (T > 1): token t of row r writes pool slot
+        ``(bt[r, p // bs], p % bs)`` with ``p = index[r] + t``, and
+        queries attend ``kpos <= p`` in the gathered logical view
+        (causality in logical coordinates; the window band folds in the
+        same way). The caller's mask, when given, must be
+        view-width and further restricts (validity); unmapped/garbage
+        view slots are harmless because they are never inside
+        ``kpos <= index``-coverage of a mapped row.
+        """
+        B, T = q.shape[0], q.shape[1]
+        bt = cache["block_table"]
+        idx = cache["index"]
+        if getattr(idx, "ndim", 0) != 1:
+            raise ValueError(
+                f"paged cache needs a per-row [B] index, got ndim "
+                f"{getattr(idx, 'ndim', 0)}"
+            )
+        NB, bs = cache["k"].shape[0], cache["k"].shape[1]
+        MB = bt.shape[1]
+        Lv = MB * bs  # logical view width
+        tpos = idx[:, None] + jnp.arange(T)[None, :]  # [B, T] logical pos
+        bslot = tpos // bs
+        # rows past their table (parked/retired) force the sentinel so
+        # the scatter drops instead of clamping into a real block
+        blk = jnp.take_along_axis(bt, jnp.minimum(bslot, MB - 1), axis=1)
+        blk = jnp.where(bslot >= MB, NB, blk)
+        off = tpos % bs
+        ck = cache["k"].at[blk, off].set(
+            k.astype(cache["k"].dtype), mode="drop"
+        )
+        cv = cache["v"].at[blk, off].set(
+            v.astype(cache["v"].dtype), mode="drop"
+        )
+        new_cache = {
+            "k": ck, "v": cv, "index": idx + T, "block_table": bt,
+        }
+        # gather the logical view: [B, MB, bs, Hkv, D] -> [B, Lv, ...].
+        # Sentinel table entries clamp into the last pool block — pure
+        # garbage, but the positional keep below never reaches them
+        # (a mapped row's attendable range is covered by real blocks).
+        kk = ck[bt].reshape(B, Lv, *ck.shape[2:])
+        vv = cv[bt].reshape(B, Lv, *cv.shape[2:])
+        kpos = jnp.arange(Lv)[None, None, None, :]
+        qpos = tpos[:, None, :, None]  # [B, 1, T, 1]
+        keep = kpos <= qpos  # causal in logical coordinates
+        win = getattr(self, "window", None)
+        win_start = None
+        if win is not None:
+            win_start = jnp.maximum(tpos[:, -1] + 1 - win, 0)  # [B]
+            keep = jnp.logical_and(keep, kpos > qpos - win)
+        if mask is not None:
+            if mask.shape[-1] != Lv:
+                raise ValueError(
+                    f"paged cache attention needs a view-width mask "
+                    f"(last dim {Lv}), got {mask.shape}"
+                )
+            keep = jnp.logical_and(keep, mask)
+        blocks_min = (
+            DECODE_BLOCK if win is not None
+            else DECODE_BLOCKWISE_MIN_WINDOWLESS
+        )
+        if (
+            T == 1 and Lv > blocks_min and Lv % DECODE_BLOCK == 0
+            and getattr(self, "scale", None) is None
+        ):
+            # same length-bounded online-softmax loop as the contiguous
+            # per-row path: per-token cost tracks the longest live
+            # prefix (mask owns per-row truth)
+            out = decode_attention_blockwise(
+                q, kk.astype(q.dtype), vv.astype(q.dtype),
+                jnp.max(idx) + T,
+                mask=jnp.broadcast_to(
+                    keep, jnp.broadcast_shapes(keep.shape, (B, 1, 1, Lv))
+                ),
+                start=jnp.min(win_start) if win is not None else 0,
+            )
+        else:
+            out = self._attn(
+                q, kk.astype(q.dtype), vv.astype(q.dtype),
+                causal=False, mask=keep, q_offset=0,
+                scale=getattr(self, "scale", None), window=None,
+            )
+        out = out.reshape(B, T, self.num_heads * self.head_dim)
+        out = self.children["o"].apply(params["o"], out)
+        return out, new_cache
+
     def project_kv(self, params, src):
         """Project a cross-attention source ONCE: (k, v) [B, Tk, Hkv, D]
         for reuse across a decode loop via ``precomputed_kv=``."""
@@ -763,3 +882,23 @@ class MultiHeadAttention(Module):
             # carries and break the static `rolling` branch in apply
             cache["rolling"] = None
         return cache
+
+    def init_paged_cache(
+        self, num_blocks: int, block_size: int, batch: int,
+        max_blocks: int, dtype=jnp.bfloat16,
+    ):
+        """Paged cache form (see ``_apply_paged``): per-layer k/v POOLS
+        of ``num_blocks`` fixed-size blocks shared by all ``batch``
+        rows, a per-row logical write index, and a per-row block table
+        initialized to the ``num_blocks`` sentinel (unmapped — writes
+        drop). HBM scales with blocks actually mapped by the host-side
+        ``BlockPool``, not ``batch x max_len``."""
+        shape = (num_blocks, block_size, self.num_kv_heads, self.head_dim)
+        return {
+            "k": jnp.zeros(shape, dtype),
+            "v": jnp.zeros(shape, dtype),
+            "index": jnp.zeros((batch,), jnp.int32),
+            "block_table": jnp.full(
+                (batch, max_blocks), num_blocks, jnp.int32
+            ),
+        }

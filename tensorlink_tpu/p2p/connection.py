@@ -60,6 +60,11 @@ class FramedStream:
         self.bytes_out = 0
 
     async def send(self, payload: bytes) -> None:
+        if self.writer.is_closing():
+            # asyncio silently discards writes to a closing transport —
+            # a request() sent here would ride out its full timeout even
+            # though delivery is already impossible. Fail it now.
+            raise ConnectionError("stream is closed")
         codec = "none"
         if (
             self.compression != "none"

@@ -509,6 +509,16 @@ class Node:
         stream = FramedStream(
             reader, writer, self.cfg.compression, self.cfg.compression_min_bytes
         )
+        if self._stopping:
+            # A connection can race out of the accept backlog while (or
+            # just after) stop() runs: its callback task is not in
+            # self._tasks, so nothing cancels it, and a half-dead node
+            # would handshake and serve RPCs from a cleared peer table —
+            # e.g. compute a relay hop and then drop the result on the
+            # floor, leaving the origin to ride out its full timeout.
+            # Close immediately: the dialer fails fast instead.
+            stream.close()
+            return
         try:
             hello = decode_message(
                 await asyncio.wait_for(stream.recv(), self.cfg.handshake_timeout_s)
@@ -554,7 +564,7 @@ class Node:
                 host=host,
                 port=int(hello["listen_port"]),
             )
-            self._register_peer(info, stream)
+            self._register_peer(info, stream)  # refuses if stopping
         except Exception as e:  # noqa: BLE001
             self.log.debug("inbound handshake failed: %s", e)
             stream.close()
@@ -565,6 +575,15 @@ class Node:
         return True
 
     def _register_peer(self, info: PeerInfo, stream: FramedStream) -> Peer:
+        if self._stopping:
+            # An in-flight dial (e.g. a stage-install pre-connect spawned
+            # from a worker thread) can complete after stop() cleared the
+            # peer table. Registering it would resurrect this node as a
+            # reachable peer — the remote side replaces its just-EOF'd
+            # connection and then fires relay hops into a socket nobody
+            # reads, losing them silently. Refuse instead.
+            stream.close()
+            raise ConnectionError("node is stopping")
         old = self.peers.get(info.node_id)
         if old is not None:
             old.stream.close()
@@ -746,6 +765,10 @@ class Node:
                     peer.ghosts += 1
                     self._penalize(peer)
                     continue
+                if self._stopping:
+                    # close so the sender sees EOF (not a silent sink)
+                    peer.stream.close()
+                    break
                 peer.msgs_in += 1
                 peer.last_seen = time.time()
                 if self._traffic_dog is not None:
@@ -804,7 +827,16 @@ class Node:
         if reply is not None and "id" in msg:
             reply.setdefault("type", "RESPONSE")
             reply["re"] = msg["id"]
-            await self.send(peer, reply)
+            try:
+                await self.send(peer, reply)
+            except (ConnectionError, OSError):
+                # peer dropped while the handler ran (send now fails
+                # fast on a closed transport); the requester's side is
+                # already resolving this via its own peer-lost path
+                self.log.debug(
+                    "reply to %s undeliverable (peer gone)",
+                    peer.node_id[:8],
+                )
 
     def _penalize(self, peer: Peer) -> None:
         peer.reputation = max(0.0, peer.reputation - 0.1)
@@ -819,6 +851,10 @@ class Node:
         for sid, st in list(self._streams.items()):
             if st["peer"] == peer.node_id:
                 del self._streams[sid]
+        # close our transport too (recv saw EOF = remote is gone): later
+        # sends on a stale Peer reference fail fast instead of writing
+        # into a half-closed socket and riding out the request timeout
+        peer.stream.close()
         if self.peers.get(peer.node_id) is peer:
             del self.peers[peer.node_id]
             self.flight.record(
@@ -1036,7 +1072,7 @@ class Node:
     def status(self) -> dict:
         """Self-report (reference: get_self_info + node_stats,
         smart_node.py:855-947)."""
-        return {
+        out = {
             "node_id": self.node_id,
             "role": self.role,
             "port": self.port,
@@ -1059,6 +1095,16 @@ class Node:
             # and workers record per micro-batch
             "stragglers": self._straggler_report(),
         }
+        serving = getattr(self, "serving", None)
+        if serving is not None:
+            # scheduler snapshot (queue depth, slot occupancy; paged
+            # engines add KV-pool pressure + prefix hit rate) — tldiag
+            # health tables read this to flag KV-PRESSURE
+            try:
+                out["serving"] = serving.stats()
+            except Exception:  # noqa: BLE001 — status must not 500
+                pass
+        return out
 
     def _straggler_report(self) -> dict:
         from tensorlink_tpu.runtime.tracing import straggler_report

@@ -5,8 +5,14 @@ from tensorlink_tpu.parallel.pp import (  # noqa: F401
     stack_stage_params,
     unstack_stage_params,
 )
+from tensorlink_tpu.parallel.kvpool import (  # noqa: F401
+    BlockPool,
+    PoolExhaustedError,
+    PrefixIndex,
+)
 from tensorlink_tpu.parallel.serving import (  # noqa: F401
     ContinuousBatchingEngine,
+    PagedContinuousBatchingEngine,
     PromptTooLongError,
     QueueFullError,
     ServingError,

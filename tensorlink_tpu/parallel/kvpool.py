@@ -1,0 +1,322 @@
+"""Paged KV-cache pool: fixed-size blocks, refcounts, prefix sharing.
+
+The continuous-batching engine (parallel/serving.py) historically
+reserved one contiguous ``max_len`` cache region per slot, so HBM
+scaled with ``slots x worst-case length`` and two requests sharing a
+system prompt each paid a full prefill. This module is the host-side
+half of the paged alternative (ROADMAP item 1):
+
+- ``BlockPool``: a free-list allocator over ``num_blocks`` fixed-size
+  blocks with per-block refcounts. Blocks whose refcount drops to zero
+  but that still back a registered prompt prefix park in an LRU
+  "reusable" list — they satisfy future prefix hits for free and are
+  evicted (oldest first) only when allocation would otherwise fail.
+  Exhaustion raises the typed ``PoolExhaustedError`` (backpressure,
+  never a shape error) and lands a flight-recorder event.
+
+- ``PrefixIndex``: a refcount-friendly radix-style index over prompt
+  prefixes at block granularity. Keys are CHAINED digests — block i's
+  key is ``H(key_{i-1} || tokens[i*bs:(i+1)*bs])`` — so a lookup walks
+  the prompt block by block exactly like a radix trie walks edges,
+  with O(1) state per step and no collision-prone flat hashing of
+  arbitrary-length prefixes. Partial tail blocks (a prompt whose length
+  is not a block multiple) index under ``(parent key, fill)`` so an
+  exact-prefix request can share them too; writing into a shared block
+  is what triggers copy-on-write in the engine.
+
+The DEVICE half — ``block_table[pos // bs] * bs + pos % bs`` cache
+addressing — lives in nn/attention.py (the paged cache form) and
+parallel/serving.py (the paged engine state); this module is pure
+host-side bookkeeping and deliberately jax-free.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+
+import numpy as np
+
+
+class PoolExhaustedError(RuntimeError):
+    """No free or evictable block can satisfy the allocation — typed
+    backpressure for admission control (the pool-level analogue of
+    serving.QueueFullError), never a shape error downstream."""
+
+
+class BlockPool:
+    """Fixed-size KV block allocator with refcounts and LRU reuse.
+
+    A block id is an index into the device-side per-layer pools
+    ``[num_blocks, block_size, Hkv, D]`` (nn/attention.py paged form).
+    The pool itself never touches device memory — it only decides which
+    block ids are live, shared, reusable (cached prefix, refcount 0),
+    or free.
+
+    States: FREE (never written / fully forgotten) -> LIVE (refcount
+    >= 1) -> REUSABLE (refcount 0 but prefix-registered; an LRU hit
+    revives it, allocation pressure evicts it via ``evict_hook``) ->
+    FREE.
+    """
+
+    def __init__(
+        self,
+        num_blocks: int,
+        block_size: int,
+        *,
+        metrics=None,
+        recorder=None,
+    ):
+        if num_blocks < 1 or block_size < 1:
+            raise ValueError(
+                f"need num_blocks >= 1 and block_size >= 1, got "
+                f"{num_blocks}, {block_size}"
+            )
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.metrics = metrics
+        self.recorder = recorder
+        self._free: collections.deque[int] = collections.deque(
+            range(self.num_blocks)
+        )
+        self._refs = [0] * self.num_blocks
+        # refcount-0 blocks still backing a registered prefix, oldest
+        # first — the prefix cache's eviction order
+        self._reusable: collections.OrderedDict[int, None] = (
+            collections.OrderedDict()
+        )
+        self._cached: set[int] = set()  # prefix-registered block ids
+        # owner wires this to PrefixIndex.forget_block so evicting a
+        # reusable block also drops its index entries
+        self.evict_hook = None
+        self.in_use = 0  # blocks with refcount >= 1
+
+    # ------------------------------------------------------------- events
+    def _event(self, kind: str, severity: str = "info", **data) -> None:
+        if self.recorder is not None:
+            try:
+                self.recorder.record(kind, severity, **data)
+            except Exception:  # noqa: BLE001 — telemetry must not fail allocs
+                pass
+
+    # ---------------------------------------------------------------- API
+    @property
+    def available(self) -> int:
+        """Blocks an alloc() could hand out right now (free + evictable)."""
+        return len(self._free) + len(self._reusable)
+
+    def refcount(self, bid: int) -> int:
+        return self._refs[bid]
+
+    def alloc(self, n: int = 1) -> list[int]:
+        """Allocate ``n`` blocks (refcount 1 each). Prefers never-used
+        free blocks; under pressure evicts the oldest reusable blocks
+        (forgetting their prefix entries). Raises ``PoolExhaustedError``
+        when fewer than ``n`` blocks exist in either state."""
+        if n < 0:
+            raise ValueError(f"alloc of {n} blocks")
+        if self.available < n:
+            self._event(
+                "kvpool.exhausted", "warn",
+                requested=n, free=len(self._free),
+                reusable=len(self._reusable), in_use=self.in_use,
+            )
+            if self.metrics is not None:
+                self.metrics.incr("kv_pool_exhausted_total")
+            raise PoolExhaustedError(
+                f"need {n} KV blocks; {len(self._free)} free + "
+                f"{len(self._reusable)} evictable of {self.num_blocks} "
+                f"({self.in_use} in use)"
+            )
+        out: list[int] = []
+        evicted = 0
+        for _ in range(n):
+            if self._free:
+                bid = self._free.popleft()
+            else:
+                bid, _ = self._reusable.popitem(last=False)  # oldest
+                self._forget(bid)
+                evicted += 1
+            self._refs[bid] = 1
+            self.in_use += 1
+            out.append(bid)
+        self._event(
+            "kvpool.alloc", blocks=n, evicted=evicted, in_use=self.in_use
+        )
+        return out
+
+    def retain(self, bid: int) -> None:
+        """Refcount++ (prefix hit / sharer). Revives a reusable block."""
+        if self._refs[bid] == 0:
+            if bid not in self._reusable:
+                raise ValueError(
+                    f"retain of free block {bid} (never allocated or "
+                    "already forgotten) — allocate it instead"
+                )
+            del self._reusable[bid]
+            self.in_use += 1
+        self._refs[bid] += 1
+
+    def release(self, bid: int) -> None:
+        """Refcount--. At zero the block parks reusable if it still
+        backs a registered prefix, else returns to the free list.
+        A negative refcount is an accounting bug and raises."""
+        if self._refs[bid] <= 0:
+            raise ValueError(
+                f"release of block {bid} with refcount {self._refs[bid]} "
+                "(double free)"
+            )
+        self._refs[bid] -= 1
+        if self._refs[bid] == 0:
+            self.in_use -= 1
+            if bid in self._cached:
+                self._reusable[bid] = None  # newest at the end (LRU)
+            else:
+                self._free.append(bid)
+            self._event("kvpool.free", block=bid, in_use=self.in_use)
+
+    def mark_cached(self, bid: int) -> None:
+        """Flag a block as prefix-registered: at refcount 0 it parks
+        reusable (serving future prefix hits) instead of freeing."""
+        self._cached.add(bid)
+
+    def touch(self, bid: int) -> None:
+        """LRU bump for a reusable block that served a read-only hit."""
+        if bid in self._reusable:
+            self._reusable.move_to_end(bid)
+
+    def _forget(self, bid: int) -> None:
+        self._cached.discard(bid)
+        if self.evict_hook is not None:
+            self.evict_hook(bid)
+        self._event("kvpool.evict", block=bid)
+
+    def stats(self) -> dict:
+        return {
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "blocks_in_use": self.in_use,
+            "blocks_free": len(self._free),
+            "blocks_reusable": len(self._reusable),
+            "utilization": round(self.in_use / self.num_blocks, 4),
+        }
+
+
+class PrefixIndex:
+    """Radix-style prompt-prefix index at block granularity.
+
+    Chained digests make each full block a trie edge: matching a prompt
+    walks ``key_i = H(key_{i-1} || block_tokens)`` until a key misses.
+    Partial tails (the last ``fill < block_size`` tokens of a prompt)
+    register under their parent key so exact-prefix requests can share
+    them; the caller copy-on-writes those before extending them.
+
+    The index stores BLOCK IDS, not contents — the pool's
+    ``evict_hook`` must point at :meth:`forget_block` so an evicted
+    block's entries vanish with it.
+    """
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self._full: dict[bytes, int] = {}  # chain digest -> block id
+        # parent digest -> {fill: (digest over fill tokens, block id)}
+        self._partial: dict[bytes, dict[int, tuple[bytes, int]]] = {}
+        self._by_block: dict[int, list[tuple]] = {}  # bid -> entry keys
+
+    @staticmethod
+    def _digest(parent: bytes, tokens: np.ndarray) -> bytes:
+        return hashlib.sha1(
+            parent + np.ascontiguousarray(tokens, np.int32).tobytes()
+        ).digest()
+
+    def match(
+        self, ids: np.ndarray, *, max_tokens: int | None = None
+    ) -> tuple[list[int], int, tuple[int, int] | None]:
+        """Longest resident prefix of ``ids``.
+
+        Returns ``(full_blocks, matched_tokens, tail)`` where
+        ``full_blocks`` are the block ids covering the first
+        ``len(full_blocks) * block_size`` tokens and ``tail`` is an
+        optional ``(block_id, fill)`` partial-block hit extending the
+        match by ``fill`` more tokens. ``matched_tokens`` counts both.
+        Never matches past ``max_tokens`` (callers pass ``len(ids) - 1``
+        so at least one token remains to prefill — the sampler needs
+        its logits). The caller owns refcounts: nothing is retained
+        here."""
+        ids = np.asarray(ids).reshape(-1)
+        bs = self.block_size
+        cap = len(ids) if max_tokens is None else min(max_tokens, len(ids))
+        blocks: list[int] = []
+        key = b""
+        n = 0
+        while n + bs <= cap:
+            nxt = self._digest(key, ids[n:n + bs])
+            bid = self._full.get(nxt)
+            if bid is None:
+                break
+            blocks.append(bid)
+            key = nxt
+            n += bs
+        tail = None
+        fills = self._partial.get(key)
+        if fills:
+            for fill in sorted(fills, reverse=True):
+                if n + fill > cap:
+                    continue
+                digest, bid = fills[fill]
+                if self._digest(key, ids[n:n + fill]) == digest:
+                    tail = (bid, fill)
+                    n += fill
+                    break
+        return blocks, n, tail
+
+    def register(self, ids: np.ndarray, blocks: list[int]) -> list[int]:
+        """Index a prefilled prompt: every full block under its chain
+        digest, the partial tail (if any) under its parent. Existing
+        entries win (first writer keeps the cache slot — duplicates
+        would just shadow it). Returns the block ids newly indexed, so
+        the caller can ``pool.mark_cached`` them."""
+        ids = np.asarray(ids).reshape(-1)
+        bs = self.block_size
+        newly: list[int] = []
+        key = b""
+        n = 0
+        for bid in blocks:
+            if n + bs <= len(ids):
+                nxt = self._digest(key, ids[n:n + bs])
+                if nxt not in self._full:
+                    self._full[nxt] = bid
+                    self._by_block.setdefault(bid, []).append(("f", nxt))
+                    newly.append(bid)
+                key = nxt
+                n += bs
+            else:
+                fill = len(ids) - n
+                if fill <= 0:
+                    break
+                fills = self._partial.setdefault(key, {})
+                if fill not in fills:
+                    fills[fill] = (self._digest(key, ids[n:n + fill]), bid)
+                    self._by_block.setdefault(bid, []).append(
+                        ("p", key, fill)
+                    )
+                    newly.append(bid)
+                break
+        return newly
+
+    def forget_block(self, bid: int) -> None:
+        """Drop every entry pointing at ``bid`` (pool eviction hook)."""
+        for entry in self._by_block.pop(bid, []):
+            if entry[0] == "f":
+                self._full.pop(entry[1], None)
+            else:
+                fills = self._partial.get(entry[1])
+                if fills is not None:
+                    fills.pop(entry[2], None)
+                    if not fills:
+                        del self._partial[entry[1]]
+
+    def __len__(self) -> int:
+        return len(self._full) + sum(
+            len(f) for f in self._partial.values()
+        )

@@ -30,6 +30,16 @@ API: ``submit() -> rid`` (non-blocking, queue-backpressured),
 ``result(rid)`` (drives the loop until that request finishes),
 ``aresult(rid)`` (asyncio wrapper for node event loops). Per-request
 TTFT/TPOT land in a ``Metrics`` registry as histograms.
+
+``PagedContinuousBatchingEngine`` replaces the per-slot contiguous
+cache regions with a paged KV cache (parallel/kvpool.py): fixed-size
+blocks allocated from a shared pool through per-slot block tables,
+copy-on-write prefix sharing keyed by prompt hash (a request whose
+prompt prefix is already resident maps those blocks and skips their
+prefill entirely), chunked prefill interleaved with decode dispatches
+(a long arriving prompt cannot stall in-flight decodes), and
+block-granular free on EOS/eviction with typed ``PoolExhaustedError``
+backpressure. HBM then scales with LIVE tokens, not slots x max_len.
 """
 
 from __future__ import annotations
@@ -50,6 +60,20 @@ from tensorlink_tpu.parallel.inference import (
     InferenceEngine,
     sample_logits,
 )
+from tensorlink_tpu.parallel.kvpool import (
+    BlockPool,
+    PoolExhaustedError,
+    PrefixIndex,
+)
+
+__all__ = [
+    "ContinuousBatchingEngine",
+    "PagedContinuousBatchingEngine",
+    "PoolExhaustedError",
+    "PromptTooLongError",
+    "QueueFullError",
+    "ServingError",
+]
 
 
 def _is_index_leaf(leaf) -> bool:
@@ -124,6 +148,8 @@ class ContinuousBatchingEngine:
         prefill_block: int = 32,
         max_queue: int | None = None,
         keep_results: int = 1024,
+        prefill_cache_max: int = 32,
+        warm_buckets: bool = False,
         metrics=None,
         recorder=None,
     ):
@@ -164,10 +190,18 @@ class ContinuousBatchingEngine:
         # (device tokens [K, S], dispatch-time slot->request snapshot)
         self._inflight: collections.deque = collections.deque()
         self._next_rid = 0
-        self._prefill_jit: dict[int, object] = {}
+        # bounded LRU of AOT-compiled prefill programs, one per prompt-
+        # length bucket: unbounded growth was a slow host-memory leak
+        # under adversarial prompt-length mixes (ROADMAP item 5)
+        self.prefill_cache_max = max(int(prefill_cache_max), 1)
+        self._prefill_jit: collections.OrderedDict[int, object] = (
+            collections.OrderedDict()
+        )
 
         self._state = self._init_state()
         self._decode = self._build_decode()
+        if warm_buckets:
+            self._warm()
 
     # --------------------------------------------------------- device state
     def _init_state(self):
@@ -198,6 +232,11 @@ class ContinuousBatchingEngine:
                 return jax.device_put(x, NamedSharding(mesh, spec))
 
             state = jax.tree.map(shard, state)
+        else:
+            # COMMIT the fresh state: uncommitted jnp.zeros avals differ
+            # from the committed arrays every program emits, so the very
+            # first dispatch would trace a second copy of each program
+            state = jax.tree.map(jax.device_put, state)
         return state
 
     def _fill_token(self) -> int:
@@ -330,11 +369,75 @@ class ContinuousBatchingEngine:
 
         return jax.jit(prefill, donate_argnums=(1,))
 
+    def _get_prefill(self, Tp: int):
+        """Compiled prefill program for bucket ``Tp`` from the bounded
+        LRU cache — built, AOT-lowered, and compiled on first use with
+        ``compile_s`` logged to the flight recorder (the cold-start
+        number ROADMAP item 5 tracks). Evicting a bucket only means a
+        recompile if that prompt length ever returns."""
+        fn = self._prefill_jit.get(Tp)
+        if fn is not None:
+            self._prefill_jit.move_to_end(Tp)
+            return fn
+        t0 = time.perf_counter()
+        jitfn = self._build_prefill(Tp)
+        i32 = jnp.int32
+        try:
+            # lower/compile ahead of the first call: admission then
+            # dispatches a ready executable, and the compile cost is a
+            # measured, attributable event instead of a mystery stall
+            # inside the first unlucky submit()
+            fn = jitfn.lower(
+                self.engine.params, self._state,
+                jax.ShapeDtypeStruct((1, Tp), i32),
+                jax.ShapeDtypeStruct((1, Tp), i32),
+                jax.ShapeDtypeStruct((), i32),
+                jax.ShapeDtypeStruct((), jnp.uint32),
+                jax.ShapeDtypeStruct((), i32),
+            ).compile()
+            aot = True
+        except Exception:  # noqa: BLE001 — AOT is an optimization only
+            fn = jitfn
+            aot = False
+        compile_s = time.perf_counter() - t0
+        self._event(
+            "serving.compile", program="prefill", bucket=Tp,
+            compile_s=round(compile_s, 4), aot=aot,
+        )
+        if self.metrics is not None:
+            self.metrics.observe("serving_prefill_compile_s", compile_s)
+        self._prefill_jit[Tp] = fn
+        while len(self._prefill_jit) > self.prefill_cache_max:
+            old, _ = self._prefill_jit.popitem(last=False)
+            self._event("serving.prefill_evict", bucket=old)
+        return fn
+
+    def _warm(self) -> None:
+        """Pre-compile the decode chunk and the prefill bucket set at
+        construction (``warm_buckets=True``): first-request TTFT then
+        measures serving, not XLA. Buckets warm smallest-first (typical
+        traffic skews short) up to the prefill-cache bound."""
+        t0 = time.perf_counter()
+        try:
+            self._decode = self._decode.lower(
+                self.engine.params, self._state
+            ).compile()
+        except Exception:  # noqa: BLE001 — fall back to lazy jit
+            pass
+        self._event(
+            "serving.compile", program="decode",
+            compile_s=round(time.perf_counter() - t0, 4),
+        )
+        top = min(self.L, self.engine.max_len)
+        buckets = range(self.prefill_block, top + 1, self.prefill_block)
+        for Tp in list(buckets)[: self.prefill_cache_max]:
+            self._get_prefill(Tp)
+
     # --------------------------------------------------------------- events
-    def _event(self, kind: str, **data) -> None:
+    def _event(self, kind: str, severity: str = "info", **data) -> None:
         if self.recorder is not None:
             try:
-                self.recorder.record(kind, **data)
+                self.recorder.record(kind, severity, **data)
             except Exception:  # noqa: BLE001 — telemetry must not serve 500s
                 pass
 
@@ -353,6 +456,26 @@ class ContinuousBatchingEngine:
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         t0 = int(ids.size)
+        self._check_fit(t0, max_new)
+        with self._lock:
+            # fill free slots first so max_queue bounds genuinely
+            # WAITING work, not work a free slot could take right now
+            self._admit_waiting()
+            self._check_backpressure()
+            rid = self._next_rid
+            self._next_rid += 1
+            req = _Request(
+                rid=rid, ids=ids, max_new=max_new, seed=int(seed),
+                submitted_at=time.perf_counter(),
+            )
+            self._requests[rid] = req
+            self._admit_or_queue(req)
+        if self.metrics is not None:
+            self.metrics.incr("serving_requests_total")
+        self._event("serving.submit", rid=rid, prompt_len=t0)
+        return rid
+
+    def _check_fit(self, t0: int, max_new: int) -> None:
         if t0 + max_new > self.engine.max_len:
             raise PromptTooLongError(
                 f"prompt {t0} + new {max_new} exceeds engine max_len "
@@ -363,34 +486,23 @@ class ContinuousBatchingEngine:
                 f"prompt {t0} (padded {self._bucket(t0)}) + new {max_new} "
                 f"exceeds the slot cache region ({self.L} slots)"
             )
-        with self._lock:
-            # fill free slots first so max_queue bounds genuinely
-            # WAITING work, not work a free slot could take right now
-            self._admit_waiting()
-            if (
-                self.max_queue is not None
-                and not self._free
-                and len(self._queue) >= self.max_queue
-            ):
-                raise QueueFullError(
-                    f"{len(self._queue)} requests pending (max_queue="
-                    f"{self.max_queue})"
-                )
-            rid = self._next_rid
-            self._next_rid += 1
-            req = _Request(
-                rid=rid, ids=ids, max_new=max_new, seed=int(seed),
-                submitted_at=time.perf_counter(),
+
+    def _check_backpressure(self) -> None:
+        if (
+            self.max_queue is not None
+            and not self._free
+            and len(self._queue) >= self.max_queue
+        ):
+            raise QueueFullError(
+                f"{len(self._queue)} requests pending (max_queue="
+                f"{self.max_queue})"
             )
-            self._requests[rid] = req
-            if self._free:
-                self._admit(req)  # prefill dispatches immediately
-            else:
-                self._queue.append(req)
-        if self.metrics is not None:
-            self.metrics.incr("serving_requests_total")
-        self._event("serving.submit", rid=rid, prompt_len=t0)
-        return rid
+
+    def _admit_or_queue(self, req: _Request) -> None:
+        if self._free:
+            self._admit(req)  # prefill dispatches immediately
+        else:
+            self._queue.append(req)
 
     def _admit_waiting(self) -> None:
         while self._free and self._queue:
@@ -406,14 +518,21 @@ class ContinuousBatchingEngine:
         pm = np.zeros((1, Tp), np.int32)
         ids[0, Tp - t0:] = req.ids
         pm[0, Tp - t0:] = 1
-        fn = self._prefill_jit.get(Tp)
-        if fn is None:
-            fn = self._prefill_jit[Tp] = self._build_prefill(Tp)
-        self._state, tok0 = fn(
+        fn = self._get_prefill(Tp)
+        args = (
             self.engine.params, self._state, jnp.asarray(ids),
             jnp.asarray(pm), jnp.int32(slot), jnp.uint32(req.seed),
             jnp.int32(req.max_new),
         )
+        try:
+            self._state, tok0 = fn(*args)
+        except (TypeError, ValueError):
+            # an AOT executable is stricter than jit about input
+            # shardings/avals; if a jax-version quirk rejects the call
+            # (argument checking happens before the donated state is
+            # consumed), fall back to the plain jit path for this bucket
+            fn = self._prefill_jit[Tp] = self._build_prefill(Tp)
+            self._state, tok0 = fn(*args)
         req.first_token = tok0
         self._event("serving.admit", rid=req.rid, slot=slot, padded=Tp)
 
@@ -479,8 +598,11 @@ class ContinuousBatchingEngine:
         """Fold the prefill's first token into the stream (syncs a
         long-since-computed scalar). TTFT is recorded here at the
         latest — _maybe_record_ttft covers every earlier opportunity,
-        including jax builds without Array.is_ready."""
-        if req.first_token is not None and not req.tokens:
+        including jax builds without Array.is_ready. (Guarded on the
+        pending device scalar alone: a paged-engine request resumed
+        after preemption re-prefills with tokens already banked, so
+        ``req.tokens`` may legitimately be non-empty here.)"""
+        if req.first_token is not None:
             t0 = int(np.asarray(req.first_token))
             self._maybe_record_ttft(req)
             req.first_token = None
@@ -570,3 +692,663 @@ class ContinuousBatchingEngine:
                 "inflight_chunks": len(self._inflight),
                 "requests": len(self._requests),
             }
+
+
+class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
+    """Continuous batching over a PAGED KV cache (ROADMAP item 1).
+
+    Instead of one contiguous ``max_len`` cache region per slot, every
+    layer's k/v live in shared pools of ``num_blocks`` fixed-size
+    blocks (``nn/attention.py`` paged form) addressed through per-slot
+    block tables — ``block_table[pos // bs] * bs + pos % bs`` instead
+    of ``slot_base + pos``. The host-side ``BlockPool``/``PrefixIndex``
+    (parallel/kvpool.py) decide which block ids each slot maps:
+
+    - **admission** matches the prompt against the prefix index; full
+      blocks already resident map straight into the block table
+      (refcount++) and their tokens are NEVER re-prefilled. A matched
+      partial tail block is revived exclusively when idle or
+      copy-on-written when it has live sharers.
+    - **chunked prefill**: remaining prompt tokens run in fixed
+      ``prefill_chunk``-token programs, at most one per scheduler step,
+      interleaved with decode dispatches — a long arriving prompt
+      cannot stall in-flight decodes.
+    - **decode** grows a slot's block table lazily (blocks allocated
+      just ahead of the write frontier) and frees block-granular on
+      EOS/eviction. When the pool cannot cover a live slot's next
+      chunk, the newest request is preempted — its blocks free, it
+      re-queues, and the (request-seed, position) sampling keys make
+      the resumed stream token-identical.
+    - **backpressure**: a request that could never fit raises
+      ``PoolExhaustedError`` at submit; a full queue behind a starved
+      pool raises it instead of ``QueueFullError``.
+
+    Every device program is shape-static: ONE decode chunk program and
+    ONE prefill chunk program serve any request mix (block tables,
+    indices, chunk offsets are all traced operands) — strictly fewer
+    programs than the contiguous engine's per-bucket prefills.
+
+    ``num_blocks`` defaults to ``slots * cache_len / block_size``
+    (parity capacity — nothing is ever tighter than the contiguous
+    engine); size it smaller to cap HBM by LIVE tokens instead of
+    ``slots x max_len``.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        *,
+        block_size: int = 16,
+        num_blocks: int | None = None,
+        prefill_chunk: int = 32,
+        prefix_cache: bool = True,
+        **kw,
+    ):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}"
+            )
+        self.block_size = int(block_size)
+        self.prefill_chunk = int(prefill_chunk)
+        self.prefix_cache = bool(prefix_cache)
+        self._num_blocks_arg = num_blocks
+        super().__init__(engine, **kw)
+
+    # --------------------------------------------------------- device state
+    def _init_state(self):
+        eng, S, L, bs = self.engine, self.slots, self.L, self.block_size
+        if L % bs:
+            raise ValueError(
+                f"block_size {bs} must divide the cache view width {L}"
+            )
+        if eng.mesh.shape.get(eng.data_axis, 1) > 1:
+            raise NotImplementedError(
+                "paged serving does not shard over the data axis yet: "
+                "the block pools have no slot-batch dimension to split "
+                "(all slots scatter into the same pool)"
+            )
+        self.max_blocks = MB = L // bs
+        nb = self._num_blocks_arg
+        if nb is None:
+            nb = S * MB  # parity capacity: never tighter than contiguous
+        self.pool = BlockPool(
+            int(nb), bs, metrics=self.metrics, recorder=self.recorder
+        )
+        self.index = PrefixIndex(bs) if self.prefix_cache else None
+        if self.index is not None:
+            self.pool.evict_hook = self.index.forget_block
+        try:
+            stack = eng.model.children["blocks"]
+            attns = [blk.children["attn"] for blk in stack.blocks()]
+            caches = [
+                {"attn": a.init_paged_cache(
+                    self.pool.num_blocks, bs, S, MB, dtype=eng.cache_dtype
+                )}
+                for a in attns
+            ]
+        except (AttributeError, KeyError) as e:
+            raise NotImplementedError(
+                "paged serving requires the standard decoder cache tree "
+                "([{'attn': cache}] per block, models/gpt2.py & "
+                "models/llama.py)"
+            ) from e
+        # host-side mirrors of the device block tables
+        self._slot_blocks: list[list[int]] = [[] for _ in range(S)]
+        self._slot_ub = [0] * S  # device write-frontier upper bound
+        self._slot_limit = [0] * S  # prompt + budget cap, in tokens
+        self._pending: dict[int, dict] = {}  # slot -> prefill job
+        self.prefix_matched_tokens = 0
+        self.prompt_tokens_total = 0
+        self.prefilled_tokens = 0
+        self.peak_blocks_in_use = 0
+        self._prefill_chunk_fn = self._build_prefill_chunk()
+        self._table_op = self._build_table_op()
+        self._retire_op = self._build_retire_op()
+        self._copy_op = self._build_copy_op()
+        state = {
+            "caches": caches,
+            "valid": jnp.zeros((S, L), bool),
+            "n_valid": jnp.zeros((S,), jnp.int32),
+            "tok": jnp.zeros((S,), jnp.int32),
+            "seed": jnp.zeros((S,), jnp.uint32),
+            "remaining": jnp.zeros((S,), jnp.int32),
+            "live": jnp.zeros((S,), bool),
+        }
+        # commit (see the contiguous _init_state): fresh-vs-committed
+        # aval mismatch would double-trace every block-table program
+        return jax.tree.map(jax.device_put, state)
+
+    # ------------------------------------------------------------- programs
+    def _build_prefill_chunk(self):
+        """ONE shape-static program prefills any prompt: ``C`` tokens of
+        slot ``slot`` starting at logical position ``start`` (``nreal <=
+        C`` real, rest right-pad). The whole serving state is donated;
+        the chunk writes through the slot's block table into the shared
+        pools and, on the final chunk, samples the first token with the
+        same ``fold_in(key(seed), n)`` stream as the decode scan."""
+        eng = self.engine
+        model, L, C = eng.model, self.L, self.prefill_chunk
+        gen = self.gen
+        temperature, top_k, top_p = (
+            float(gen.temperature), int(gen.top_k), float(gen.top_p)
+        )
+        eos = gen.eos_token_id
+
+        def chunk(params, state, ids, slot, start, nreal, seed, max_new,
+                  is_final):
+            caches = state["caches"]
+            tmp = [
+                {"attn": {
+                    "k": lc["attn"]["k"],
+                    "v": lc["attn"]["v"],
+                    "index": jnp.full((1,), start, jnp.int32),
+                    "block_table": jax.lax.dynamic_slice_in_dim(
+                        lc["attn"]["block_table"], slot, 1, axis=0
+                    ),
+                }}
+                for lc in caches
+            ]
+            positions = (start + jnp.arange(C))[None, :]
+            # mask=None: the paged attention path builds causality (and
+            # the window band) in logical coordinates from the index
+            logits, new_tmp = model.apply(
+                params, ids, caches=tmp, positions=positions, mask=None
+            )
+            new_caches = [
+                {"attn": {
+                    "k": nt["attn"]["k"],
+                    "v": nt["attn"]["v"],
+                    "index": lc["attn"]["index"].at[slot].set(start + nreal),
+                    "block_table": lc["attn"]["block_table"],
+                }}
+                for lc, nt in zip(caches, new_tmp)
+            ]
+            n_end = start + nreal
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], nreal - 1, axis=0, keepdims=False
+            )
+            key0 = jax.random.fold_in(jax.random.key(seed), n_end)
+            tok0 = sample_logits(
+                last, key0, temperature, top_k, top_p
+            ).astype(jnp.int32)
+            done0 = max_new <= 1
+            if eos is not None:
+                done0 = done0 | (tok0 == eos)
+            return {
+                "caches": new_caches,
+                "valid": state["valid"].at[slot].set(
+                    jnp.arange(L) < n_end
+                ),
+                "n_valid": state["n_valid"].at[slot].set(n_end),
+                "tok": state["tok"].at[slot].set(tok0),
+                "seed": state["seed"].at[slot].set(seed),
+                "remaining": state["remaining"].at[slot].set(
+                    jnp.where(is_final, max_new - 1, 0)
+                ),
+                "live": state["live"].at[slot].set(is_final & ~done0),
+            }, tok0
+
+        return jax.jit(chunk, donate_argnums=(1,))
+
+    def _map_caches(self, state, fn):
+        return {
+            **state,
+            "caches": [
+                {"attn": fn(lc["attn"])} for lc in state["caches"]
+            ],
+        }
+
+    def _build_table_op(self):
+        """Point a slot's device block-table row (every layer) at
+        ``row``; at admission also reset the row's write index to the
+        first position the new request will write (its old parked index
+        could otherwise alias a SHARED block through the new table)."""
+
+        def run(state, slot, row, start, set_start):
+            def upd(c):
+                idx = jnp.where(set_start, start, c["index"][slot])
+                return {
+                    **c,
+                    "index": c["index"].at[slot].set(idx),
+                    "block_table": c["block_table"].at[slot].set(row),
+                }
+
+            return self._map_caches(state, upd)
+
+        return jax.jit(run, donate_argnums=(0,))
+
+    def _build_retire_op(self):
+        """Kill a slot on device: live off, valid row cleared, block
+        table to the sentinel so any in-flight parked write DROPS
+        instead of landing in a block about to be remapped."""
+        NB, L = self.pool.num_blocks, self.L
+
+        def run(state, slot):
+            state = self._map_caches(
+                state,
+                lambda c: {
+                    **c,
+                    "block_table": c["block_table"].at[slot].set(
+                        jnp.full((self.max_blocks,), NB, jnp.int32)
+                    ),
+                },
+            )
+            return {
+                **state,
+                "live": state["live"].at[slot].set(False),
+                "valid": state["valid"].at[slot].set(
+                    jnp.zeros((L,), bool)
+                ),
+            }
+
+        return jax.jit(run, donate_argnums=(0,))
+
+    def _build_copy_op(self):
+        """Copy-on-write: duplicate block ``src`` into ``dst`` across
+        every layer's k/v pools (the sharer keeps ``src`` byte-for-byte;
+        the writer extends ``dst``)."""
+
+        def run(state, src, dst):
+            return self._map_caches(
+                state,
+                lambda c: {
+                    **c,
+                    "k": c["k"].at[dst].set(c["k"][src]),
+                    "v": c["v"].at[dst].set(c["v"][src]),
+                },
+            )
+
+        return jax.jit(run, donate_argnums=(0,))
+
+    def _warm(self) -> None:
+        """AOT-compile the (single) decode and prefill-chunk programs at
+        construction, logging ``compile_s`` per program."""
+        i32 = jnp.int32
+        sds = jax.ShapeDtypeStruct
+        plans = (
+            ("decode", "_decode", (self.engine.params, self._state)),
+            (
+                "prefill_chunk", "_prefill_chunk_fn",
+                (
+                    self.engine.params, self._state,
+                    sds((1, self.prefill_chunk), i32),
+                    sds((), i32), sds((), i32), sds((), i32),
+                    sds((), jnp.uint32), sds((), i32),
+                    sds((), jnp.bool_),
+                ),
+            ),
+        )
+        for program, attr, args in plans:
+            t0 = time.perf_counter()
+            try:
+                setattr(
+                    self, attr, getattr(self, attr).lower(*args).compile()
+                )
+                aot = True
+            except Exception:  # noqa: BLE001 — AOT is an optimization only
+                aot = False
+            self._event(
+                "serving.compile", program=program,
+                compile_s=round(time.perf_counter() - t0, 4), aot=aot,
+            )
+
+    # ------------------------------------------------------------ admission
+    def _check_fit(self, t0: int, max_new: int) -> None:
+        if t0 + max_new > self.engine.max_len:
+            raise PromptTooLongError(
+                f"prompt {t0} + new {max_new} exceeds engine max_len "
+                f"{self.engine.max_len}"
+            )
+        if t0 + max_new > self.L:
+            raise PromptTooLongError(
+                f"prompt {t0} + new {max_new} exceeds the block-table "
+                f"view ({self.L} positions)"
+            )
+        bs = self.block_size
+        need = -(-(t0 + max_new) // bs)
+        if need > self.pool.num_blocks:
+            raise PoolExhaustedError(
+                f"request worst case is {need} blocks of {bs} tokens; "
+                f"the pool holds {self.pool.num_blocks} total"
+            )
+
+    def _check_backpressure(self) -> None:
+        if self.max_queue is None or len(self._queue) < self.max_queue:
+            return
+        if self._free:
+            # slots are free yet admissions back up: the queue is
+            # starved on KV blocks, not on decode width
+            self._event(
+                "serving.reject", "warn", reason="pool_exhausted",
+                queued=len(self._queue), **self.pool.stats(),
+            )
+            raise PoolExhaustedError(
+                f"{len(self._queue)} requests pending on KV blocks "
+                f"({self.pool.in_use}/{self.pool.num_blocks} in use, "
+                f"max_queue={self.max_queue})"
+            )
+        super()._check_backpressure()
+
+    def _admit_or_queue(self, req: _Request) -> None:
+        # a non-empty queue means the head is starved on blocks (slots
+        # may be free): the new arrival must wait behind it — admitting
+        # it now would let steady small-prompt traffic starve a queued
+        # long prompt forever
+        if self._queue or not self._free or not self._try_admit(req):
+            self._queue.append(req)
+
+    def _admit_waiting(self) -> None:
+        # FIFO: when the head cannot get blocks, later arrivals wait too
+        # (no head-of-line bypass — it would starve long prompts)
+        while self._free and self._queue:
+            if not self._try_admit(self._queue[0]):
+                break
+            self._queue.popleft()
+
+    def _try_admit(self, req: _Request) -> bool:
+        """Map a request into a free slot: prefix-match, retain/COW
+        shared blocks, allocate the rest, point the device block table,
+        and queue the chunked prefill. False (request stays queued) when
+        the pool cannot cover the prompt right now."""
+        if req.tokens:
+            # preemption resume: re-prefill prompt + banked tokens; the
+            # positional sampling keys make the continuation exact
+            ids_full = np.concatenate(
+                [np.asarray(req.ids), np.asarray(req.tokens)]
+            ).astype(np.int32)
+        else:
+            ids_full = np.asarray(req.ids, np.int32)
+        t0 = len(ids_full)
+        max_new_eff = req.max_new - len(req.tokens)
+        bs = self.block_size
+        hits: list[int] = []
+        nmatch = 0
+        tail = None
+        if self.index is not None:
+            # never match the whole prompt: the final token must prefill
+            # so its logits can seed the first sample
+            hits, nmatch, tail = self.index.match(
+                ids_full, max_tokens=t0 - 1
+            )
+        n_new = -(-t0 // bs) - len(hits) - (1 if tail is not None else 0)
+        taken: list[int] = []
+        cow_src = None
+        tail_bid = None
+        try:
+            for b in hits:
+                self.pool.retain(b)
+                taken.append(b)
+            if tail is not None:
+                bid, fill = tail
+                if self.pool.refcount(bid) == 0:
+                    # sole owner: revive and extend in place — the index
+                    # entry vouches only for its first `fill` tokens,
+                    # which stay untouched
+                    self.pool.retain(bid)
+                    taken.append(bid)
+                    tail_bid = bid
+                else:
+                    # live sharers: copy-on-write before this request
+                    # may write into the block
+                    (tail_bid,) = self.pool.alloc(1)
+                    taken.append(tail_bid)
+                    cow_src = bid
+            new_blocks = self.pool.alloc(n_new) if n_new > 0 else []
+            taken.extend(new_blocks)
+        except PoolExhaustedError:
+            for b in reversed(taken):
+                self.pool.release(b)
+            return False
+        slot = self._free.pop()
+        req.slot = slot
+        self._slot_req[slot] = req
+        self._slot_blocks[slot] = (
+            hits + ([tail_bid] if tail is not None else []) + new_blocks
+        )
+        self._slot_limit[slot] = min(t0 + max_new_eff, self.L)
+        self._slot_ub[slot] = t0
+        if cow_src is not None:
+            self._state = self._copy_op(
+                self._state, jnp.int32(cow_src), jnp.int32(tail_bid)
+            )
+            if self.metrics is not None:
+                self.metrics.incr("kv_cow_copies_total")
+            self._event(
+                "kvpool.cow", rid=req.rid, src=cow_src, dst=tail_bid,
+                fill=tail[1],
+            )
+        self._set_row(slot, start=nmatch)
+        self._pending[slot] = {
+            "ids": ids_full, "pos": nmatch, "seed": req.seed,
+            "max_new": max_new_eff,
+        }
+        self.prompt_tokens_total += t0
+        self.prefix_matched_tokens += nmatch
+        self.prefilled_tokens += t0 - nmatch
+        if nmatch and self.metrics is not None:
+            self.metrics.incr("prefix_hits_total", nmatch)
+        self._event(
+            "serving.admit", rid=req.rid, slot=slot,
+            prefix_hit_tokens=nmatch,
+            blocks=len(self._slot_blocks[slot]),
+        )
+        return True
+
+    def _set_row(self, slot: int, start: int | None = None) -> None:
+        row = np.full((self.max_blocks,), self.pool.num_blocks, np.int32)
+        blocks = self._slot_blocks[slot]
+        row[: len(blocks)] = blocks
+        self._state = self._table_op(
+            self._state, jnp.int32(slot), jnp.asarray(row),
+            jnp.int32(0 if start is None else start),
+            jnp.bool_(start is not None),
+        )
+
+    # ------------------------------------------------------------- prefill
+    def _dispatch_prefill_chunk(self) -> bool:
+        """At most ONE chunk per scheduler step — the chunked-prefill
+        contract: decode dispatches interleave, so in-flight TPOT stays
+        bounded by one chunk's latency, not a whole prompt's."""
+        if not self._pending:
+            return False
+        slot = min(
+            self._pending, key=lambda s: self._slot_req[s].rid
+        )
+        job = self._pending[slot]
+        ids, pos = job["ids"], job["pos"]
+        C = self.prefill_chunk
+        nreal = min(C, len(ids) - pos)
+        buf = np.zeros((1, C), np.int32)
+        buf[0, :nreal] = ids[pos:pos + nreal]
+        is_final = pos + nreal >= len(ids)
+        self._state, tok0 = self._prefill_chunk_fn(
+            self.engine.params, self._state, jnp.asarray(buf),
+            jnp.int32(slot), jnp.int32(pos), jnp.int32(nreal),
+            jnp.uint32(job["seed"]), jnp.int32(job["max_new"]),
+            jnp.bool_(is_final),
+        )
+        job["pos"] = pos + nreal
+        req = self._slot_req[slot]
+        self._event(
+            "serving.prefill_chunk", rid=req.rid, slot=slot, start=pos,
+            tokens=nreal, final=is_final,
+        )
+        if is_final:
+            req.first_token = tok0
+            del self._pending[slot]
+            self._slot_ub[slot] = len(ids)
+            if self.index is not None:
+                # register the PROMPT prefix (not generated tokens) as
+                # soon as its blocks are written — a concurrent request
+                # sharing the prefix hits while this one still decodes
+                newly = self.index.register(
+                    np.asarray(req.ids, np.int32),
+                    self._slot_blocks[slot],
+                )
+                for b in newly:
+                    self.pool.mark_cached(b)
+        return True
+
+    # ------------------------------------------------------ blocks / decode
+    def _release_slot_blocks(self, slot: int) -> None:
+        for b in self._slot_blocks[slot]:
+            self.pool.release(b)
+        self._slot_blocks[slot] = []
+        self._slot_ub[slot] = 0
+        self._slot_limit[slot] = 0
+        self._pending.pop(slot, None)
+
+    def _finish(self, req: _Request) -> None:
+        slot = req.slot
+        owns = slot is not None and self._slot_req[slot] is req
+        super()._finish(req)
+        if owns:
+            # retire the device row BEFORE the blocks go back to the
+            # pool: the decode program scatter-writes every row's k/v
+            # each step (parked rows included — harmless in the
+            # contiguous engine where the parked index stays inside the
+            # slot's own region), so without the sentinel table this
+            # row's parked write would land, via the stale block table,
+            # in a block the pool may hand to another request. All ops
+            # thread through the one donated state, so chunks dispatched
+            # after this retire see the sentinel and DROP the write.
+            self._state = self._retire_op(self._state, jnp.int32(slot))
+            self._release_slot_blocks(slot)
+
+    def _preempt(self, slot: int) -> None:
+        """Evict a live request to free its blocks: retire the slot on
+        device FIRST (its parked writes must drop before any block is
+        remapped), drain in-flight chunks (their tokens are genuine),
+        then release and re-queue at the FRONT. The resumed request
+        re-prefills prompt+banked tokens and continues token-identical
+        (sampling keys depend on position, not history)."""
+        req = self._slot_req[slot]
+        self._event(
+            "serving.preempt", "warn", rid=req.rid, slot=slot,
+            tokens=len(req.tokens),
+        )
+        if self.metrics is not None:
+            self.metrics.incr("serving_preempt_total")
+        self._state = self._retire_op(self._state, jnp.int32(slot))
+        while self._inflight:
+            self._drain_one()
+        if req.done:
+            return  # finished in flight; _finish already freed everything
+        self._release_slot_blocks(slot)
+        self._slot_req[slot] = None
+        req.slot = None
+        self._free.append(slot)
+        self._queue.appendleft(req)
+
+    def _alloc_with_preemption(self, n: int, protect: int):
+        """Allocate ``n`` blocks, preempting the newest other request
+        under pressure. Returns None when ``protect`` itself had to be
+        preempted (pool too small for the live set)."""
+        while True:
+            try:
+                return self.pool.alloc(n)
+            except PoolExhaustedError:
+                victims = [
+                    s for s, r in enumerate(self._slot_req)
+                    if r is not None and s != protect
+                ]
+                if not victims:
+                    self._preempt(protect)
+                    return None
+                self._preempt(
+                    max(victims, key=lambda s: self._slot_req[s].rid)
+                )
+
+    def _grow_blocks(self, decoding: list[int]) -> list[int]:
+        """Extend block tables ahead of the decode write frontier: the
+        next chunk advances each live row by up to ``decode_chunk``
+        positions with NO host sync, so the blocks must exist before
+        dispatch. Returns the decoding set minus any preempted slots."""
+        bs = self.block_size
+        for slot in decoding:
+            req = self._slot_req[slot]
+            if req is None or slot in self._pending:
+                continue  # preempted (or re-queued) by an earlier growth
+            target = min(
+                self._slot_ub[slot] + self.decode_chunk,
+                self._slot_limit[slot],
+            )
+            need = -(-target // bs)
+            have = len(self._slot_blocks[slot])
+            if need > have:
+                got = self._alloc_with_preemption(need - have, slot)
+                if got is None:
+                    continue  # the slot itself was evicted
+                self._slot_blocks[slot].extend(got)
+                self._set_row(slot)
+            self._slot_ub[slot] = target
+        return [
+            s for s in decoding
+            if self._slot_req[s] is not None and s not in self._pending
+        ]
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit, dispatch at most one prefill
+        chunk, grow block tables, dispatch one decode chunk, drain."""
+        with self._lock:
+            self._admit_waiting()
+            prefilling = self._dispatch_prefill_chunk()
+            decoding = [
+                s for s, r in enumerate(self._slot_req)
+                if r is not None and s not in self._pending
+            ]
+            if decoding:
+                decoding = self._grow_blocks(decoding)
+            if decoding:
+                self._state, toks = self._decode(
+                    self.engine.params, self._state
+                )
+                live = set(decoding)
+                # mid-prefill slots are NOT live on device: their rows
+                # emit fill tokens that must never reach a request
+                snap = tuple(
+                    r if s in live else None
+                    for s, r in enumerate(self._slot_req)
+                )
+                self._inflight.append((toks, snap))
+            for r in self._slot_req:
+                if r is not None:
+                    self._maybe_record_ttft(r)
+            busy = bool(decoding or prefilling)
+            while len(self._inflight) > (self.pipeline_depth if busy else 0):
+                self._drain_one()
+            self.peak_blocks_in_use = max(
+                self.peak_blocks_in_use, self.pool.in_use
+            )
+            if self.metrics is not None:
+                st = self.pool.stats()
+                self.metrics.observe("kv_blocks_in_use", st["blocks_in_use"])
+                self.metrics.observe("kv_pool_utilization", st["utilization"])
+            return bool(
+                busy or self._queue or self._inflight or self._pending
+            )
+
+    # --------------------------------------------------------------- stats
+    def prefix_hit_rate(self) -> float:
+        """Fraction of submitted prompt tokens served from resident
+        prefix blocks (never re-prefilled)."""
+        if not self.prompt_tokens_total:
+            return 0.0
+        return self.prefix_matched_tokens / self.prompt_tokens_total
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update(
+            {
+                "pool": self.pool.stats(),
+                "prefilling": len(self._pending),
+                "peak_blocks_in_use": self.peak_blocks_in_use,
+                "prompt_tokens_total": self.prompt_tokens_total,
+                "prefix_matched_tokens": self.prefix_matched_tokens,
+                "prefilled_tokens": self.prefilled_tokens,
+                "prefix_cache_hit_rate": round(self.prefix_hit_rate(), 4),
+            }
+        )
+        return out

@@ -1050,22 +1050,30 @@ class UserNode(Node):
     def _unregister_job(self, job: "DistributedJob") -> None:
         self._jobs.pop(job.job.job_id, None)
 
-    def serving_engine(self, engine, **kw):
+    def serving_engine(self, engine, *, paged: bool = False, **kw):
         """The user role's LOCAL inference path: a continuous-batching
         scheduler (parallel/serving.py) wired into this node's
         observability — per-request TTFT/TPOT land in ``self.metrics``
         (served at ``GET /metrics``, Prometheus included) and
         submit/admit/finish events in the flight recorder (``GET
-        /events``). Drive it from async handlers via ``await
-        asubmit()`` + ``await aresult(rid)`` — both hop to a worker
-        thread, so neither prefill compiles nor chunk syncs land on the
-        node's event loop; the distributed pipelined path stays
-        ``DistributedJob.forward``."""
-        from tensorlink_tpu.parallel.serving import ContinuousBatchingEngine
+        /events``). ``paged=True`` serves through the paged KV cache
+        (block pool + prefix sharing, parallel/kvpool.py); either way
+        the scheduler is attached as ``self.serving`` so ``GET /node``
+        exposes its stats (tldiag reads pool pressure from there).
+        Drive it from async handlers via ``await asubmit()`` + ``await
+        aresult(rid)`` — both hop to a worker thread, so neither
+        prefill compiles nor chunk syncs land on the node's event loop;
+        the distributed pipelined path stays ``DistributedJob.forward``."""
+        from tensorlink_tpu.parallel.serving import (
+            ContinuousBatchingEngine,
+            PagedContinuousBatchingEngine,
+        )
 
         kw.setdefault("metrics", self.metrics)
         kw.setdefault("recorder", self.flight)
-        return ContinuousBatchingEngine(engine, **kw)
+        cls = PagedContinuousBatchingEngine if paged else ContinuousBatchingEngine
+        self.serving = cls(engine, **kw)
+        return self.serving
 
     def on_peer_lost(self, peer: Peer) -> None:
         for dj in list(self._jobs.values()):

@@ -970,13 +970,21 @@ class WorkerNode(Node):
                 msg.get("step"), msg.get("micro"),
             )
             return
-        await self.send(origin, {
-            **payload,
-            "job_id": msg["job_id"],
-            "step": msg["step"],
-            "micro": msg["micro"],
-            "fence": msg.get("fence", 0),
-        })
+        try:
+            await self.send(origin, {
+                **payload,
+                "job_id": msg["job_id"],
+                "step": msg["step"],
+                "micro": msg["micro"],
+                "fence": msg.get("fence", 0),
+            })
+        except (ConnectionError, OSError):
+            # connection died between lookup and send: same outcome as
+            # origin-missing above — the master's elastic retry resolves
+            self.log.warning(
+                "relay result for step %s micro %s lost origin connection",
+                msg.get("step"), msg.get("micro"),
+            )
 
     async def _relay_error(self, msg: dict, error: str) -> None:
         await self._relay_to_origin(

@@ -85,6 +85,72 @@ def test_bench_diff_serving_and_quality_key_directions():
     }
 
 
+def test_bench_diff_paged_kv_key_directions():
+    """ISSUE-6 paged-KV keys: prefix hit rate is higher-better; blocks
+    in use / pool utilization / re-prefilled tokens are lower-better at
+    fixed bench traffic (a 'more blocks' improvement verdict would
+    bless a sharing regression)."""
+    old = {
+        "prefix_cache_hit_rate": 0.5,
+        "kv_blocks_in_use": 100,
+        "kv_pool_utilization": 0.40,
+        "serving_paged_prefilled_tokens": 800,
+        "serving_paged_tokens_per_sec": 9000.0,
+    }
+    new = {
+        "prefix_cache_hit_rate": 0.3,               # -40% -> regression
+        "kv_blocks_in_use": 80,                     # -20% -> improvement
+        "kv_pool_utilization": 0.50,                # +25% -> regression
+        "serving_paged_prefilled_tokens": 600,      # -25% -> improvement
+        "serving_paged_tokens_per_sec": 10000.0,    # +11% -> improvement
+    }
+    d = bench_diff(old, new, threshold=0.05)
+    assert set(d["regressions"]) == {
+        "prefix_cache_hit_rate", "kv_pool_utilization",
+    }
+    assert set(d["improvements"]) == {
+        "kv_blocks_in_use", "serving_paged_prefilled_tokens",
+        "serving_paged_tokens_per_sec",
+    }
+
+
+def test_node_row_flags_kv_pool_pressure():
+    """A serving node whose /node reports a paged KV pool near capacity
+    is flagged KV-PRESSURE (admissions about to backpressure); a calm
+    pool only fills the KV% column."""
+    hot = node_row({
+        "target": "s:1",
+        "routes": {
+            "/healthz": {"status": 200, "body": {"ok": True}},
+            "/node": {"status": 200, "body": {
+                "role": "user", "node_id": "u" * 64, "peers": {},
+                "serving": {"pool": {
+                    "num_blocks": 100, "blocks_in_use": 95,
+                    "utilization": 0.95,
+                }},
+            }},
+        },
+    })
+    assert hot["kv_pool_pct"] == 95.0
+    assert "KV-PRESSURE(95/100)" in hot["flags"]
+    calm = node_row({
+        "target": "s:2",
+        "routes": {
+            "/healthz": {"status": 200, "body": {"ok": True}},
+            "/node": {"status": 200, "body": {
+                "role": "user", "node_id": "u" * 64, "peers": {},
+                "serving": {"pool": {
+                    "num_blocks": 100, "blocks_in_use": 10,
+                    "utilization": 0.10,
+                }},
+            }},
+        },
+    })
+    assert calm["kv_pool_pct"] == 10.0 and calm["flags"] == []
+    text = render_table([hot, calm])
+    assert "KV%" in text and "KV-PRESSURE" in text
+
+
 def test_bench_diff_unwraps_committed_wrapper():
     """BENCH_r*.json wraps the bench line under `parsed` (or, when the
     driver failed to parse, leaves it in the captured `tail`)."""

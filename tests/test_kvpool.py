@@ -1,0 +1,182 @@
+"""Paged KV-cache pool invariants (parallel/kvpool.py).
+
+Host-side contract the paged serving engine leans on: refcounts never
+go negative (double free raises), exhaustion is TYPED backpressure
+(PoolExhaustedError, never a shape error downstream), refcount-0 blocks
+backing a registered prefix park reusable and are evicted LRU-oldest
+under allocation pressure (with the index entries dropped via the evict
+hook), and the chained-digest prefix index matches exactly the resident
+block-aligned prefixes — never across different parents, never past the
+caller's token cap.
+"""
+
+import numpy as np
+import pytest
+
+from tensorlink_tpu.parallel.kvpool import (
+    BlockPool,
+    PoolExhaustedError,
+    PrefixIndex,
+)
+
+
+def test_alloc_release_refcounts():
+    pool = BlockPool(4, 8)
+    a, b = pool.alloc(2)
+    assert pool.refcount(a) == 1 and pool.refcount(b) == 1
+    assert pool.in_use == 2 and pool.available == 2
+    pool.retain(a)
+    assert pool.refcount(a) == 2
+    pool.release(a)
+    assert pool.refcount(a) == 1 and pool.in_use == 2
+    pool.release(a)
+    assert pool.refcount(a) == 0 and pool.in_use == 1
+    # an uncached block goes straight back to the free list
+    assert pool.available == 3
+
+
+def test_double_free_and_bad_retain_raise():
+    pool = BlockPool(2, 4)
+    (a,) = pool.alloc(1)
+    pool.release(a)
+    with pytest.raises(ValueError, match="double free"):
+        pool.release(a)
+    assert pool.refcount(a) == 0  # never driven negative
+    with pytest.raises(ValueError, match="retain of free block"):
+        pool.retain(a)  # freed without a prefix registration
+
+
+def test_exhaustion_is_typed_backpressure():
+    pool = BlockPool(3, 4)
+    pool.alloc(2)
+    with pytest.raises(PoolExhaustedError, match="2 KV blocks"):
+        pool.alloc(2)
+    # the failed alloc took nothing
+    assert pool.in_use == 2 and pool.available == 1
+    with pytest.raises(ValueError):
+        pool.alloc(-1)
+    assert pool.alloc(0) == []
+
+
+def test_cached_blocks_park_reusable_and_revive():
+    pool = BlockPool(2, 4)
+    (a,) = pool.alloc(1)
+    pool.mark_cached(a)
+    pool.release(a)
+    # parked, not freed: still available to an allocator AND revivable
+    assert pool.available == 2 and pool.in_use == 0
+    pool.retain(a)  # prefix hit revives it
+    assert pool.refcount(a) == 1 and pool.in_use == 1
+
+
+def test_lru_eviction_order_and_hook():
+    evicted = []
+    pool = BlockPool(3, 4)
+    pool.evict_hook = evicted.append
+    a, b, c = pool.alloc(3)
+    for bid in (a, b, c):
+        pool.mark_cached(bid)
+    pool.release(b)  # oldest reusable
+    pool.release(a)
+    pool.touch(b)  # LRU bump: a becomes the eviction candidate
+    (d,) = pool.alloc(1)
+    assert d == a and evicted == [a]
+    (e,) = pool.alloc(1)
+    assert e == b and evicted == [a, b]
+    with pytest.raises(PoolExhaustedError):
+        pool.alloc(1)  # c is still live — never evicted
+
+
+def test_pool_stats_shape():
+    pool = BlockPool(8, 16)
+    pool.alloc(3)
+    st = pool.stats()
+    assert st["blocks_in_use"] == 3 and st["num_blocks"] == 8
+    assert st["blocks_free"] == 5 and st["utilization"] == round(3 / 8, 4)
+
+
+# ------------------------------------------------------------ PrefixIndex
+
+
+def _ids(*tok):
+    return np.asarray(tok, np.int32)
+
+
+def test_register_match_roundtrip_full_blocks():
+    idx = PrefixIndex(4)
+    ids = np.arange(8, dtype=np.int32)
+    newly = idx.register(ids, [10, 11])
+    assert newly == [10, 11] and len(idx) == 2
+    blocks, n, tail = idx.match(ids)
+    assert blocks == [10, 11] and n == 8 and tail is None
+    # a different SECOND block only matches the first
+    other = np.concatenate([ids[:4], _ids(99, 98, 97, 96)])
+    blocks, n, tail = idx.match(other)
+    assert blocks == [10] and n == 4 and tail is None
+
+
+def test_chained_digest_blocks_same_tokens_different_parent():
+    """Block tokens [0,1,2,3] under parent A must NOT match the same
+    tokens under parent B — the chain digest is the radix-trie edge."""
+    idx = PrefixIndex(4)
+    idx.register(_ids(7, 7, 7, 7, 0, 1, 2, 3), [1, 2])
+    blocks, n, tail = idx.match(_ids(8, 8, 8, 8, 0, 1, 2, 3))
+    assert blocks == [] and n == 0 and tail is None
+
+
+def test_partial_tail_match_and_cap():
+    idx = PrefixIndex(4)
+    ids = _ids(5, 6, 7, 8, 9, 10)  # one full block + fill 2
+    idx.register(ids, [3, 4])
+    blocks, n, tail = idx.match(ids)
+    assert blocks == [3] and tail == (4, 2) and n == 6
+    # max_tokens caps the match (callers reserve the final prompt token
+    # for prefill so its logits can seed sampling)
+    blocks, n, tail = idx.match(ids, max_tokens=5)
+    assert blocks == [3] and tail is None and n == 4
+    # a longer registered fill is preferred when it fits
+    ids2 = _ids(5, 6, 7, 8, 9, 10, 11)
+    idx.register(ids2, [3, 8])
+    blocks, n, tail = idx.match(ids2)
+    assert blocks == [3] and tail == (8, 3) and n == 7
+
+
+def test_first_writer_wins():
+    idx = PrefixIndex(4)
+    ids = np.arange(4, dtype=np.int32)
+    assert idx.register(ids, [1]) == [1]
+    assert idx.register(ids, [2]) == []  # duplicate: existing entry kept
+    blocks, n, _ = idx.match(ids)
+    assert blocks == [1] and n == 4
+
+
+def test_forget_block_drops_all_entries():
+    idx = PrefixIndex(4)
+    ids = _ids(1, 2, 3, 4, 5, 6)
+    idx.register(ids, [1, 2])
+    idx.forget_block(1)
+    blocks, n, tail = idx.match(ids)
+    assert blocks == [] and n == 0 and tail is None  # chain broken at 1
+    assert len(idx) == 1  # the partial entry for block 2 survives
+    idx.forget_block(2)
+    assert len(idx) == 0
+
+
+def test_pool_and_index_evict_integration():
+    """Evicting a reusable block under pressure forgets its prefix
+    entries — a later match can never hand out a recycled block id."""
+    pool = BlockPool(2, 4)
+    idx = PrefixIndex(4)
+    pool.evict_hook = idx.forget_block
+    a, b = pool.alloc(2)
+    ids = np.arange(8, dtype=np.int32)
+    for bid in idx.register(ids, [a, b]):
+        pool.mark_cached(bid)
+    pool.release(a)
+    pool.release(b)
+    blocks, n, _ = idx.match(ids)
+    assert blocks == [a, b] and n == 8  # resident while parked
+    (c,) = pool.alloc(1)  # evicts a (oldest)
+    assert c == a
+    blocks, n, _ = idx.match(ids)
+    assert blocks == [] and n == 0  # chain starts at the evicted block

@@ -18,6 +18,8 @@ from tensorlink_tpu.models.llama import Llama, LlamaConfig
 from tensorlink_tpu.parallel.inference import GenerationConfig, InferenceEngine
 from tensorlink_tpu.parallel.serving import (
     ContinuousBatchingEngine,
+    PagedContinuousBatchingEngine,
+    PoolExhaustedError,
     PromptTooLongError,
     QueueFullError,
 )
@@ -157,9 +159,11 @@ def test_per_request_rng_independent_of_traffic(tiny_engine):
     assert list(c) != list(a)
 
 
-def test_windowed_model_parity():
-    """Sliding-window model (monotone cache) through the scheduler: the
-    per-row window band must match the engine's scalar-index band."""
+@pytest.fixture(scope="module")
+def windowed_engine():
+    """Mistral-tiny (window 8) engine + static-engine reference outputs,
+    shared by the contiguous and paged windowed-parity tests (the model
+    init and reference generates compile once per module)."""
     cfg = LlamaConfig.mistral_tiny()  # window 8
     m = Llama(cfg)
     p = m.init(jax.random.key(3))
@@ -170,6 +174,13 @@ def test_windowed_model_parity():
     gen = GenerationConfig(max_new_tokens=16)
     prompts = _prompts(cfg, (12, 4), seed=7)  # prompt > window and <
     refs = [np.asarray(eng.generate(pr[None], gen))[0] for pr in prompts]
+    return eng, gen, prompts, refs
+
+
+def test_windowed_model_parity(windowed_engine):
+    """Sliding-window model (monotone cache) through the scheduler: the
+    per-row window band must match the engine's scalar-index band."""
+    eng, gen, prompts, refs = windowed_engine
     sch = ContinuousBatchingEngine(
         eng, slots=2, gen=gen, decode_chunk=4, prefill_block=4
     )
@@ -299,3 +310,412 @@ def test_async_result_wrapper(tiny_engine):
         return await sch.aresult(rid, timeout_s=120)
 
     np.testing.assert_array_equal(asyncio.run(go()), ref)
+
+
+# ---------------------------------------------------- paged KV cache
+
+
+def test_paged_greedy_parity_with_contiguous_and_static(tiny_engine):
+    """ISSUE-6 acceptance: the paged engine's output is token-identical
+    to the contiguous scheduler AND the static engine for the same
+    prompts/seeds (greedy)."""
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=6)
+    prompts = _prompts(cfg, (5, 3, 7, 4, 6, 2))
+    refs = [np.asarray(eng.generate(pr[None], gen))[0] for pr in prompts]
+    cont = ContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=3, prefill_block=4
+    )
+    paged = PagedContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=3, block_size=4,
+        prefill_chunk=4,
+    )
+    crids = [cont.submit(pr) for pr in prompts]
+    prids = [paged.submit(pr) for pr in prompts]
+    for crid, prid, ref in zip(crids, prids, refs):
+        np.testing.assert_array_equal(cont.result(crid), ref)
+        np.testing.assert_array_equal(paged.result(prid), ref)
+
+
+def test_paged_windowed_model_parity(windowed_engine):
+    """Sliding-window model through block tables: the window band folds
+    in logical coordinates and must match the static engine."""
+    eng, gen, prompts, refs = windowed_engine
+    sch = PagedContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=4, block_size=8,
+        prefill_chunk=8,
+    )
+    rids = [sch.submit(pr) for pr in prompts]
+    for rid, ref in zip(rids, refs):
+        np.testing.assert_array_equal(sch.result(rid), ref)
+
+
+@pytest.fixture(scope="module")
+def paged_small(tiny_engine):
+    """One slots=2 paged engine shared by the prefix-sharing and COW
+    tests: its decode/prefill-chunk programs compile once per module.
+    The tests use disjoint prompt sets and metric DELTAS, so each holds
+    standalone and in any order."""
+    from tensorlink_tpu.runtime.metrics import Metrics
+
+    cfg, m, p, eng = tiny_engine
+    metrics = Metrics()
+    gen = GenerationConfig(max_new_tokens=6)
+    sch = PagedContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=2, block_size=4,
+        prefill_chunk=4, metrics=metrics,
+    )
+    return gen, metrics, sch
+
+
+def test_paged_shared_prefix_skips_prefill(tiny_engine, paged_small):
+    """N requests sharing a system prompt: every request after the
+    first maps the resident prefix blocks (hit rate > 0), the count of
+    actually-prefilled tokens drops below the submitted prompt tokens,
+    and outputs stay token-identical to solo runs."""
+    cfg, m, p, eng = tiny_engine
+    gen, metrics, sch = paged_small
+    r = np.random.default_rng(21)
+    sys_prompt = r.integers(0, cfg.vocab_size, (12,))
+    prompts = [
+        np.concatenate([sys_prompt, r.integers(0, cfg.vocab_size, (n,))])
+        for n in (3, 4, 2)
+    ]
+    refs = [np.asarray(eng.generate(pr[None], gen))[0] for pr in prompts]
+    matched0 = sch.prefix_matched_tokens
+    prefilled0 = sch.prefilled_tokens
+    prompt0 = sch.prompt_tokens_total
+    hits0 = metrics.snapshot()["counters"].get("prefix_hits_total", 0)
+    # sequential so each prefill registers before the next submit
+    for pr, ref in zip(prompts, refs):
+        np.testing.assert_array_equal(sch.result(sch.submit(pr)), ref)
+    assert sch.prefix_hit_rate() > 0
+    assert (
+        sch.prefilled_tokens - prefilled0
+        < sch.prompt_tokens_total - prompt0
+    )
+    # 2 sharers x the 3 resident system-prompt blocks
+    assert sch.prefix_matched_tokens - matched0 >= 2 * 12
+    snap = metrics.snapshot()
+    assert snap["counters"]["prefix_hits_total"] - hits0 >= 2 * 12
+
+
+def test_paged_cow_preserves_sharers_tokens(tiny_engine, paged_small):
+    """Copy-on-write: while request A still decodes (its partial tail
+    block is LIVE-shared), request B whose prompt EXTENDS A's matches
+    that tail and must COW it before writing its own continuation —
+    without the copy, B's prefill and A's decode would scribble
+    different tokens over the same block offsets. A's shared k/v bytes
+    stay intact and both outputs match their solo refs."""
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=6)
+    r = np.random.default_rng(22)
+    pra = r.integers(0, cfg.vocab_size, (10,))  # 2 full blocks + fill 2
+    prb = np.concatenate([pra, r.integers(0, cfg.vocab_size, (2,))])
+    ref_a = np.asarray(eng.generate(pra[None], gen))[0]
+    ref_b = np.asarray(eng.generate(prb[None], gen))[0]
+    from tensorlink_tpu.runtime.metrics import Metrics
+
+    metrics = Metrics()
+    sch = PagedContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=2, block_size=4,
+        prefill_chunk=4, metrics=metrics,
+    )
+    ra = sch.submit(pra)
+    # drive A's prefill to completion (registers the prefix) but keep
+    # it decoding so its blocks stay live-shared
+    while sch._pending:
+        sch.step()
+    tail_bid = sch._slot_blocks[sch._requests[ra].slot][-1]
+    # the registered fill region (A's prompt tokens 8..9) of the shared
+    # tail block, BEFORE the sharer arrives
+    k_fill = np.asarray(
+        sch._state["caches"][0]["attn"]["k"][tail_bid, :2]
+    )
+    rb = sch.submit(prb)  # matches A's LIVE partial tail -> COW
+    out_a, out_b = sch.result(ra), sch.result(rb)
+    np.testing.assert_array_equal(out_a, ref_a)
+    np.testing.assert_array_equal(out_b, ref_b)
+    assert metrics.snapshot()["counters"]["kv_cow_copies_total"] >= 1
+    assert metrics.snapshot()["counters"]["prefix_hits_total"] >= 10
+    # A's shared bytes are byte-for-byte what A's prefill wrote
+    np.testing.assert_array_equal(
+        k_fill,
+        np.asarray(sch._state["caches"][0]["attn"]["k"][tail_bid, :2]),
+    )
+
+
+def test_paged_pool_exhaustion_typed_backpressure(tiny_engine):
+    """A request that can NEVER fit raises PoolExhaustedError at
+    submit; a full queue behind a starved pool raises it too (instead
+    of QueueFullError) — typed backpressure, not a shape error."""
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=4)
+    sch = PagedContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=2, block_size=4,
+        prefill_chunk=4, num_blocks=3, max_queue=1,
+    )
+    with pytest.raises(PoolExhaustedError, match="pool holds 3"):
+        sch.submit(np.arange(12) % cfg.vocab_size)  # needs 4 blocks
+    # a fitting request serves fine afterwards
+    pr = _prompts(cfg, (4,), seed=23)[0]
+    ref = np.asarray(eng.generate(pr[None], gen))[0]
+    np.testing.assert_array_equal(sch.result(sch.submit(pr)), ref)
+
+
+def test_paged_preemption_keeps_streams_token_identical(tiny_engine):
+    """A pool too small for the live set preempts the newest request;
+    its blocks free, it re-queues, and the resumed stream is
+    token-identical (sampling keys depend on position, not history)."""
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=8)
+    r = np.random.default_rng(24)
+    pra = r.integers(0, cfg.vocab_size, (6,))
+    prb = r.integers(0, cfg.vocab_size, (7,))
+    refa = np.asarray(eng.generate(pra[None], gen))[0]
+    refb = np.asarray(eng.generate(prb[None], gen))[0]
+    from tensorlink_tpu.runtime.metrics import Metrics
+
+    metrics = Metrics()
+    # 5 blocks of 4 cannot hold both requests' worst case (4 each):
+    # decode growth must preempt and resume
+    sch = PagedContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=2, block_size=4,
+        prefill_chunk=4, num_blocks=5, prefix_cache=False,
+        metrics=metrics,
+    )
+    ra, rb = sch.submit(pra), sch.submit(prb)
+    np.testing.assert_array_equal(sch.result(ra), refa)
+    np.testing.assert_array_equal(sch.result(rb), refb)
+    assert metrics.snapshot()["counters"]["serving_preempt_total"] >= 1
+
+
+def test_paged_finish_retires_device_block_table(tiny_engine):
+    """A finished slot's device block-table row must go to the sentinel
+    BEFORE its blocks return to the pool: the decode program scatter-
+    writes every row (parked included), so a stale table would keep
+    writing the dead request's last k/v into blocks the pool may have
+    handed to another request (cross-request cache corruption)."""
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=4)
+    sch = PagedContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=2, block_size=4,
+        prefill_chunk=4,
+    )
+    pr = _prompts(cfg, (6,), seed=27)[0]
+    rid = sch.submit(pr)
+    req = sch._requests[rid]
+    sch.result(rid)
+    slot = next(
+        s for s in range(2) if sch._slot_req[s] is None and not sch._slot_blocks[s]
+    )
+    assert req.done and not sch._slot_blocks[slot]
+    NB = sch.pool.num_blocks
+    for c in sch._state["caches"]:
+        tbl = np.asarray(c["attn"]["block_table"][slot])
+        np.testing.assert_array_equal(tbl, np.full_like(tbl, NB))
+
+
+def test_paged_no_head_of_line_bypass_on_submit(tiny_engine):
+    """A submit that arrives while the queue head is starved on blocks
+    must wait BEHIND it (FIFO), even when a slot is free — otherwise
+    steady small-prompt traffic starves a queued long prompt forever."""
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=4)
+    # pool of 3: the live 8-token request pins 2 blocks, so a second
+    # 8-token prompt (needs 2 now) starves with a slot still free
+    sch = PagedContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=2, block_size=4,
+        prefill_chunk=4, num_blocks=3, prefix_cache=False,
+    )
+    pra, prlong, prb = _prompts(cfg, (8, 8, 3), seed=28)
+    refs = [
+        np.asarray(eng.generate(pr[None], gen))[0]
+        for pr in (pra, prlong, prb)
+    ]
+    ra = sch.submit(pra)       # 2 of 3 blocks + slot 0
+    rlong = sch.submit(prlong)  # needs 2, free 1: starved, queues
+    rb = sch.submit(prb)       # fits (needs 1) but must NOT jump ahead
+    assert sch._slot_req.count(None) == 1  # a slot IS free
+    assert [r.rid for r in sch._queue] == [rlong, rb]
+    outs = {r: sch.result(r) for r in (ra, rlong, rb)}
+    for r, ref in zip((ra, rlong, rb), refs):
+        np.testing.assert_array_equal(outs[r], ref)
+
+
+def test_paged_programs_shape_static_across_request_mixes(tiny_engine):
+    """ISSUE-6 acceptance: block tables/indices are traced operands, so
+    the compiled-program counts must NOT grow with the request mix —
+    one decode chunk + one prefill chunk program serve any traffic."""
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=6)
+    sch = PagedContinuousBatchingEngine(
+        eng, slots=3, gen=gen, decode_chunk=3, block_size=4,
+        prefill_chunk=4,
+    )
+    r = np.random.default_rng(25)
+    for n in (5, 3, 7, 4):
+        sch.submit(r.integers(0, cfg.vocab_size, (n,)))
+    sch.run_until_idle()
+    progs = (sch._decode, sch._prefill_chunk_fn, sch._table_op,
+             sch._retire_op, sch._copy_op)
+    if not all(hasattr(f, "_cache_size") for f in progs):
+        pytest.skip("jax build without PjitFunction._cache_size")
+    warm = [f._cache_size() for f in progs]
+    assert warm[0] >= 1 and warm[1] >= 1
+    # a wildly different mix of prompt lengths and budgets afterwards
+    for n in (11, 2, 9, 6, 13, 1, 8, 5, 10, 3):
+        sch.submit(
+            r.integers(0, cfg.vocab_size, (n,)), max_new=int(1 + n % 5)
+        )
+    sch.run_until_idle()
+    assert [f._cache_size() for f in progs] == warm
+
+
+def test_paged_chunked_prefill_does_not_stall_decode(tiny_engine):
+    """A long arriving prompt prefills in fixed chunks interleaved with
+    decode dispatches: the in-flight request keeps gaining tokens WHILE
+    the new prompt is still mid-prefill (bounded TPOT, no full-prompt
+    stall)."""
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=12)
+    r = np.random.default_rng(26)
+    pra = r.integers(0, cfg.vocab_size, (4,))
+    prb = r.integers(0, cfg.vocab_size, (16,))  # 8 prefill chunks of 2
+    refa = np.asarray(eng.generate(pra[None], gen))[0]
+    sch = PagedContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=2, block_size=4,
+        prefill_chunk=2, pipeline_depth=0,  # drain per step: observable
+    )
+    ra = sch.submit(pra)
+    sch.step()  # A finishes prefill
+    sch.step()  # A decodes
+    rb = sch.submit(prb)
+    req_a = sch._requests[ra]
+    gained = 0
+    while sch._pending and not req_a.done:
+        before = len(req_a.tokens)
+        sch.step()  # one prefill chunk for B + one decode chunk for A
+        gained += len(req_a.tokens) - before
+    assert gained >= 3 * sch.decode_chunk  # A progressed during B's prefill
+    np.testing.assert_array_equal(sch.result(ra), refa)
+    np.testing.assert_array_equal(
+        sch.result(rb), np.asarray(eng.generate(prb[None], gen))[0]
+    )
+
+
+def test_paged_footprint_scales_with_live_tokens(tiny_engine):
+    """HBM accounting: peak blocks track live tokens (prompt + budget),
+    nowhere near the contiguous slots*max_len reservation; everything
+    is freed once traffic drains."""
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=4)
+    sch = PagedContinuousBatchingEngine(
+        eng, slots=4, gen=gen, decode_chunk=2, block_size=4,
+        prefill_chunk=4,
+    )
+    prompts = _prompts(cfg, (4, 4, 4, 4), seed=27)
+    rids = [sch.submit(pr) for pr in prompts]
+    sch.run_until_idle()
+    for rid in rids:
+        assert len(sch.result(rid)) == 4
+    # 4 live requests x ceil((4+4)/4)=2 blocks each = 8 blocks peak,
+    # vs the contiguous reservation of slots*L/bs = 32
+    assert sch.peak_blocks_in_use <= 8
+    assert sch.peak_blocks_in_use * sch.block_size < sch.slots * sch.L
+    assert sch.pool.in_use == 0  # block-granular free on finish
+    assert all(pool_ref == 0 for pool_ref in sch.pool._refs)
+
+
+def test_paged_rejects_bad_geometry(tiny_engine):
+    cfg, m, p, eng = tiny_engine
+    with pytest.raises(ValueError, match="must divide"):
+        PagedContinuousBatchingEngine(eng, slots=2, block_size=5)
+    with pytest.raises(ValueError, match="block_size"):
+        PagedContinuousBatchingEngine(eng, slots=2, block_size=0)
+    with pytest.raises(PromptTooLongError):
+        sch = PagedContinuousBatchingEngine(
+            eng, slots=2, gen=GenerationConfig(max_new_tokens=8),
+            block_size=4,
+        )
+        sch.submit(np.arange(30) % cfg.vocab_size)  # 30+8 > L=32
+
+
+def test_prefill_bucket_cache_bounded_lru(tiny_engine):
+    """The contiguous engine's per-bucket prefill cache is a bounded
+    LRU: adversarial prompt-length mixes cannot grow host memory."""
+    cfg, m, p, eng = tiny_engine
+    gen = GenerationConfig(max_new_tokens=2)
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=2, prefill_block=4,
+        prefill_cache_max=2,
+    )
+    for n in (3, 7, 11):  # three distinct buckets (4, 8, 12)
+        sch.result(sch.submit(_prompts(cfg, (n,), seed=n)[0]))
+    assert len(sch._prefill_jit) == 2
+    assert 4 not in sch._prefill_jit  # oldest bucket evicted
+
+
+def test_warm_buckets_records_compile_events(tiny_engine):
+    """warm_buckets=True pre-compiles the decode + prefill programs at
+    construction and logs compile_s per program to the flight recorder
+    (the ROADMAP-5 cold-start number)."""
+    from tensorlink_tpu.runtime.flight import FlightRecorder
+
+    cfg, m, p, eng = tiny_engine
+    rec = FlightRecorder(max_events=64)
+    gen = GenerationConfig(max_new_tokens=3)
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=2, prefill_block=8,
+        warm_buckets=True, prefill_cache_max=3, recorder=rec,
+    )
+    compiles = [
+        e for e in rec.events() if e["kind"] == "serving.compile"
+    ]
+    assert any(e["attrs"]["program"] == "decode" for e in compiles)
+    buckets = [
+        e["attrs"]["bucket"] for e in compiles
+        if e["attrs"]["program"] == "prefill"
+    ]
+    assert buckets == [8, 16, 24]  # smallest-first, capped by the LRU
+    assert all(e["attrs"]["compile_s"] >= 0 for e in compiles)
+    # warmed engine still serves correctly
+    pr = _prompts(cfg, (5,), seed=28)[0]
+    ref = np.asarray(eng.generate(pr[None], gen))[0]
+    np.testing.assert_array_equal(sch.result(sch.submit(pr)), ref)
+    # paged engine warms its (single) prefill-chunk + decode programs
+    rec2 = FlightRecorder(max_events=64)
+    psch = PagedContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=2, block_size=4,
+        prefill_chunk=4, warm_buckets=True, recorder=rec2,
+    )
+    kinds = [
+        e["attrs"]["program"] for e in rec2.events()
+        if e["kind"] == "serving.compile"
+    ]
+    assert set(kinds) == {"decode", "prefill_chunk"}
+    np.testing.assert_array_equal(psch.result(psch.submit(pr)), ref)
+
+
+def test_paged_user_node_exposes_pool_in_status(tiny_engine):
+    """UserNode.serving_engine(paged=True) attaches the scheduler so
+    GET /node carries pool stats — what tldiag's KV-PRESSURE flag
+    reads."""
+    from tensorlink_tpu.config import NodeConfig
+    from tensorlink_tpu.roles.user import UserNode
+
+    cfg, m, p, eng = tiny_engine
+    node = UserNode(NodeConfig(role="user", host="127.0.0.1", port=0))
+    sch = node.serving_engine(
+        eng, paged=True, slots=2,
+        gen=GenerationConfig(max_new_tokens=4), block_size=4,
+        prefill_chunk=4,
+    )
+    pr = _prompts(cfg, (4,), seed=29)[0]
+    assert len(sch.result(sch.submit(pr))) == 4
+    st = node.status()
+    pool = st["serving"]["pool"]
+    assert pool["num_blocks"] > 0 and pool["blocks_in_use"] == 0
+    assert st["serving"]["prefix_cache_hit_rate"] == 0.0
+    kinds = [e["kind"] for e in node.flight.events()]
+    assert "serving.prefill_chunk" in kinds
