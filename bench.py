@@ -818,6 +818,75 @@ def main() -> None:
                 )
             except Exception as e:  # noqa: BLE001
                 out["serving_paged_error"] = str(e)[:200]
+
+            # -- speculative decoding (ISSUE 7 tentpole): the same
+            # shared-prefix workload, decoded speculatively. Draft =
+            # the target's OWN int8 weight-only sibling (the model
+            # zoo's free draft pair: half the weight bytes per draft
+            # step, and int8 provably preserves argmax almost always —
+            # the int8_quality KL below measures exactly that), so
+            # greedy acceptance is a REAL model property, not a
+            # fixture. The headline is accepted_tokens_per_weight_pass:
+            # > 1.0 means decode emits more than one token per full
+            # weight read — past the bandwidth roofline that pins
+            # decode_roofline.fraction_attained. The n-gram variant
+            # (no draft model at all) rides the same verify program.
+            try:
+                from tensorlink_tpu.parallel.serving import SpecConfig
+
+                SYSW = 64
+                NSP, PSP, NNEW, SSL = 12, 24, 48, 6
+                rsp = np.random.default_rng(7)
+                sys_p = rsp.integers(0, cbcfg.vocab_size, (SYSW,))
+                spprompts = [
+                    np.concatenate(
+                        [sys_p, rsp.integers(0, cbcfg.vocab_size, (PSP,))]
+                    )
+                    for _ in range(NSP)
+                ]
+                spgen = GenerationConfig(max_new_tokens=NNEW)
+
+                def run_spec(draft_eng, spec_cfg):
+                    s = ContinuousBatchingEngine(
+                        cbeng, slots=SSL, gen=spgen, decode_chunk=16,
+                        prefill_block=32, draft=draft_eng,
+                        speculative=spec_cfg,
+                    )
+                    s.result(s.submit(spprompts[0]))  # warm/compile
+                    t0 = time.perf_counter()
+                    rids_ = [s.submit(p_) for p_ in spprompts]
+                    s.run_until_idle()
+                    dt_ = time.perf_counter() - t0
+                    ntok_ = sum(len(s.result(r_)) for r_ in rids_)
+                    return ntok_ / dt_, s.stats().get("spec")
+
+                base_tps, _ = run_spec(None, None)  # non-spec baseline
+                drafteng = InferenceEngine(
+                    make_mesh(MeshConfig()), cbmodel, cbeng.params,
+                    max_len=256, quantize="int8",
+                )
+                spec_tps, st = run_spec(drafteng, SpecConfig(k=4, rounds=2))
+                out["accepted_tokens_per_weight_pass"] = st[
+                    "accepted_tokens_per_weight_pass"
+                ]
+                out["spec_acceptance_rate"] = st["acceptance_rate"]
+                out["spec_tokens_per_sec"] = round(spec_tps, 1)
+                out["spec_vs_nonspec"] = round(spec_tps / base_tps, 3)
+                ng_tps, ngst = run_spec(None, SpecConfig(k=4, rounds=2))
+                out["spec_ngram_accepted_tokens_per_weight_pass"] = ngst[
+                    "accepted_tokens_per_weight_pass"
+                ]
+                out["spec_ngram_acceptance_rate"] = ngst["acceptance_rate"]
+                out["spec_ngram_tokens_per_sec"] = round(ng_tps, 1)
+                out["spec_config"] = (
+                    f"GPT-2 small bf16 target + int8 sibling draft "
+                    f"(k=4, rounds=2), {NSP} requests (shared {SYSW} + "
+                    f"{PSP} unique, {NNEW} new) over {SSL} slots, vs the "
+                    "same engine/workload without speculation; ngram = "
+                    "prompt-lookup self-speculation, same verify program"
+                )
+            except Exception as e:  # noqa: BLE001
+                out["spec_error"] = str(e)[:200]
         except Exception as e:  # noqa: BLE001 — must not sink the headline
             out["serving_cb_error"] = str(e)[:200]
 
@@ -1025,6 +1094,53 @@ def main() -> None:
                 f"form), batch {B8}, prompt {P8}, {N8} new tokens, "
                 f"{reps} pipelined calls"
             )
+            # speculation on the 8B: no tiny sibling in the zoo, so the
+            # n-gram/prompt-lookup draft (parallel/speculative.py) —
+            # the self-speculation case the fallback exists for. Same
+            # verify-K program as the draft-model path.
+            try:
+                from tensorlink_tpu.parallel.serving import (
+                    ContinuousBatchingEngine,
+                    SpecConfig,
+                )
+
+                sys8 = np.random.default_rng(1).integers(
+                    0, lcfg.vocab_size, (64,)
+                )
+                l8prompts = [
+                    np.concatenate([
+                        sys8,
+                        np.random.default_rng(10 + i).integers(
+                            0, lcfg.vocab_size, (P8 - 64,)
+                        ),
+                    ])
+                    for i in range(4)
+                ]
+                l8gen = GenerationConfig(max_new_tokens=32)
+                l8s = ContinuousBatchingEngine(
+                    leng, slots=4, gen=l8gen, decode_chunk=8,
+                    prefill_block=64, speculative=SpecConfig(k=4, rounds=1),
+                )
+                l8s.result(l8s.submit(l8prompts[0]))  # warm/compile
+                t0 = time.perf_counter()
+                l8rids = [l8s.submit(p_) for p_ in l8prompts]
+                l8s.run_until_idle()
+                l8dt = time.perf_counter() - t0
+                l8tok = sum(len(l8s.result(r_)) for r_ in l8rids)
+                l8st = l8s.stats()["spec"]
+                out["llama8b_spec_tokens_per_sec"] = round(l8tok / l8dt, 1)
+                out["llama8b_spec_acceptance_rate"] = l8st[
+                    "acceptance_rate"
+                ]
+                out["llama8b_accepted_tokens_per_weight_pass"] = l8st[
+                    "accepted_tokens_per_weight_pass"
+                ]
+                out["llama8b_spec_config"] = (
+                    "n-gram self-speculation (k=4), 4 requests "
+                    "(shared 64-token prefix) over 4 slots, 32 new"
+                )
+            except Exception as e:  # noqa: BLE001
+                out["llama8b_spec_error"] = str(e)[:200]
             del leng, lqp
         except Exception as e:  # noqa: BLE001
             out["llama8b_error"] = str(e)[:200]

@@ -58,7 +58,25 @@ def main() -> None:
                          "prompt traffic hitting the prefix cache")
     ap.add_argument("--slots", type=int, default=4,
                     help="decode slots for --continuous/--paged")
+    ap.add_argument("--speculate", action="store_true",
+                    help="speculative decoding with a DRAFT MODEL (the "
+                         "target's int8 sibling here): the draft "
+                         "proposes K tokens, the target verifies all "
+                         "K+1 positions in one weight pass; greedy "
+                         "output is token-identical, acceptance stats "
+                         "are printed")
+    ap.add_argument("--ngram", action="store_true",
+                    help="speculative decoding WITHOUT a draft model: "
+                         "n-gram/prompt-lookup proposals from the "
+                         "request's own context (the no-tiny-sibling "
+                         "fallback), same verify program")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="drafted tokens per verify pass")
     args = ap.parse_args()
+    if args.speculate and args.ngram:
+        ap.error("--speculate and --ngram are exclusive")
+    if (args.speculate or args.ngram) and not (args.continuous or args.paged):
+        args.continuous = True  # speculation lives in the schedulers
 
     # tiny config so the example runs on a dev box; swap for
     # LlamaConfig.llama3_8b() / .mistral_7b() + HF weights in production
@@ -90,7 +108,36 @@ def main() -> None:
     rng = np.random.default_rng(0)
     print(f"mesh={dict(mesh.shape)} window={cfg.attn_window} "
           f"int8={args.int8} continuous={args.continuous} "
-          f"paged={args.paged}")
+          f"paged={args.paged} speculate={args.speculate} "
+          f"ngram={args.ngram}")
+
+    # speculative decoding: one verify-K weight pass of the TARGET
+    # emits 1..K+1 tokens. --speculate drafts with the target's own
+    # int8 sibling (half the weight bytes per draft step; int8 keeps
+    # the argmax, so greedy acceptance is high — swap in a genuinely
+    # small model when the zoo has one for your target); --ngram drafts
+    # from the request's own context, no second model at all.
+    spec_kw = {}
+    if args.speculate or args.ngram:
+        from tensorlink_tpu.parallel.serving import SpecConfig
+
+        spec_kw["speculative"] = SpecConfig(k=args.spec_k)
+        if args.speculate:
+            spec_kw["draft"] = InferenceEngine(
+                mesh, model, params, max_len=256, quantize="int8",
+            )
+
+    def print_spec(st) -> None:
+        sp = st.get("spec")
+        if sp:
+            print(
+                f"speculation[{sp['mode']}]: "
+                f"{sp['accepted_tokens_per_weight_pass']} accepted "
+                f"tokens/weight-pass (acceptance {sp['acceptance_rate']}, "
+                f"{sp['emitted_tokens']} tokens over "
+                f"{sp['weight_passes']} passes, "
+                f"{sp['fallback_total']} n-gram misses)"
+            )
     if args.paged:
         # shared-prefix traffic: every request opens with the same
         # "system prompt". The first prefill writes those tokens into
@@ -105,7 +152,7 @@ def main() -> None:
 
         sch = PagedContinuousBatchingEngine(
             eng, slots=args.slots, gen=gen, decode_chunk=8,
-            block_size=16, prefill_chunk=16,
+            block_size=16, prefill_chunk=16, **spec_kw,
         )
         system = rng.integers(0, cfg.vocab_size, (24,))
         rids = [
@@ -127,6 +174,7 @@ def main() -> None:
             f"peak blocks {st['peak_blocks_in_use']} "
             f"of {st['pool']['num_blocks']}"
         )
+        print_spec(st)
     elif args.continuous:
         # staggered traffic: variable-length prompts submitted one by
         # one, interleaved prefill+decode over a fixed slot batch;
@@ -136,7 +184,7 @@ def main() -> None:
 
         sch = ContinuousBatchingEngine(
             eng, slots=args.slots, gen=gen, decode_chunk=8,
-            prefill_block=8,
+            prefill_block=8, **spec_kw,
         )
         rids = [
             sch.submit(rng.integers(0, cfg.vocab_size, (n,)), seed=i)
@@ -145,6 +193,7 @@ def main() -> None:
         for rid in rids:
             print(f"request {rid}:", sch.result(rid))
         print("scheduler:", sch.stats())
+        print_spec(sch.stats())
     else:
         prompts = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 8)))
         tokens = eng.generate(prompts, gen, rng=jax.random.key(0))

@@ -210,6 +210,11 @@ class NodeConfig:
     # deadline flips the master's /healthz unhealthy (armed on the first
     # step, disarmed by DistributedJob.shutdown); None disables
     step_watchdog_s: float | None = 300.0
+    # persistent XLA compilation cache (runtime/compile_cache.py): a
+    # restarted node reloads its compiled serving/stage programs from
+    # disk instead of re-paying XLA. None defers to the
+    # TL_COMPILE_CACHE_DIR environment variable; both unset = off.
+    compile_cache_dir: str | None = None
 
     def __post_init__(self):
         # wire serialization (msgpack/json) round-trips tuples as lists;

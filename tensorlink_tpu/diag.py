@@ -140,6 +140,7 @@ def node_row(
         "anomalies": {},
         "error_events": 0,
         "kv_pool_pct": None,
+        "spec_accept_pct": None,
         "flags": [],
     }
     if scrape.get("error"):
@@ -189,6 +190,18 @@ def node_row(
                 f"KV-PRESSURE({pool.get('blocks_in_use')}/"
                 f"{pool.get('num_blocks')})"
             )
+    spec = serving.get("spec") or {}
+    if spec.get("proposed_total"):
+        # speculative serving: pathological acceptance means the draft
+        # (or n-gram lookup) is a bad match for this node's traffic —
+        # every rejected token was a wasted draft step, and below ~0.3
+        # the extra passes can cost more than the accepted tokens buy
+        acc = float(spec.get("acceptance_rate") or 0.0)
+        row["spec_accept_pct"] = round(acc * 100, 1)
+        if acc < 0.3:
+            row["flags"].append(
+                f"LOW-ACCEPT({spec.get('mode')},{acc:.2f})"
+            )
     metrics = _route_body(scrape, "/metrics") or {}
     counters = metrics.get("counters") or {}
     row["anomalies"] = {
@@ -215,9 +228,9 @@ def cluster_table(
 def render_table(rows: list[dict[str, Any]]) -> str:
     cols = ("target", "role", "node_id", "healthy", "peers",
             "max_heartbeat_age_s", "skew", "kv_pool_pct",
-            "error_events", "flags")
+            "spec_accept_pct", "error_events", "flags")
     titles = ("TARGET", "ROLE", "NODE", "OK", "PEERS", "HB-AGE",
-              "SKEW", "KV%", "ERR-EVTS", "FLAGS")
+              "SKEW", "KV%", "SPEC%", "ERR-EVTS", "FLAGS")
 
     def cell(row: dict, col: str) -> str:
         v = row.get(col)
@@ -257,6 +270,10 @@ _HIGHER_BETTER = (
     # paged KV cache: prefix sharing served MORE prompt tokens from
     # resident blocks
     "hit_rate",
+    # speculative decoding: more accepted draft tokens per target
+    # weight pass / higher acceptance = more tokens per weight read
+    # (the decode-roofline lever); vs_nonspec is spec-over-baseline
+    "tokens_per_weight_pass", "acceptance_rate", "vs_nonspec",
 )
 _LOWER_BETTER_RE = re.compile(
     r"(_s$|_s_per_call$|seconds|latency|bubble_fraction|drop_fraction"
@@ -266,7 +283,9 @@ _LOWER_BETTER_RE = re.compile(
     # paged KV cache at fixed bench traffic: fewer blocks / lower pool
     # pressure / fewer re-prefilled tokens = the sharing is working
     r"|kv_blocks|kv_pool_utilization|prefilled_tokens|cow_copies"
-    r"|preempt)"
+    # speculation at fixed traffic: fewer n-gram misses = the lookup
+    # is finding real recurrences
+    r"|preempt|spec_fallback)"
 )
 
 

@@ -89,12 +89,12 @@ DECODE_BLOCKWISE_MIN_WINDOWLESS = 8 * DECODE_BLOCK
 
 
 def decode_attention_blockwise(
-    q: jax.Array,  # [B, 1, H, D] — single decode step
+    q: jax.Array,  # [B, Tq, H, D] — decode step (Tq==1) or verify-K chunk
     k: jax.Array,  # [B, L, Hkv, D] — full cache
     v: jax.Array,
     live_len: jax.Array,  # scalar int32: slots [0, live_len) may be real
     *,
-    mask: jax.Array | None = None,  # [B, 1|H, 1, L] bool over cache slots
+    mask: jax.Array | None = None,  # [B, 1|H, 1|Tq, L] bool over cache slots
     block: int = DECODE_BLOCK,
     start: jax.Array | int = 0,  # first attendable slot (sliding window)
 ) -> jax.Array:
@@ -105,31 +105,43 @@ def decode_attention_blockwise(
     no longer pays 2048 slots of score/mask work every step (VERDICT r3
     weak #8; the bench previously shrank the cache to dodge this).
 
+    ``Tq > 1`` is the speculative verify-K form: the K+1 candidate
+    queries share the block loop (live_len bounds the FARTHEST query;
+    per-query causality must come from ``mask``), so a verify pass
+    stays length-bounded exactly like the K+1 decode steps it replaces.
+
     Requires L % block == 0 (callers round the cache capacity up);
     validity/causality comes entirely from ``mask`` — slots at or beyond
     live_len MUST be masked False by the caller.
     """
     B, Tq, H, D = q.shape
     L = k.shape[1]
-    if Tq != 1 or L % block:
+    if L % block:
         # not an assert: under python -O a violated contract would
         # silently double-count clamped slice overlap in the softmax
         raise ValueError(
-            f"blockwise decode needs Tq==1 and cache {L} % block {block} "
-            f"== 0 (got Tq={Tq})"
+            f"blockwise decode needs cache {L} % block {block} == 0"
         )
     Hkv = k.shape[2]
     rep = H // Hkv
     scale = D ** -0.5
-    nb = (live_len.astype(jnp.int32) + block - 1) // block
+    # clamp to capacity: a verify-K frontier within K slots of the
+    # region end yields live_len up to L+K (the scatter DROPPED those
+    # writes), and an unclamped bound would run one extra fori_loop
+    # iteration whose clamped dynamic_slice re-adds the last block's
+    # k/v and mask to the online softmax — double-counted mass,
+    # silently wrong outputs for every row reaching the last block
+    nb = jnp.minimum(
+        (live_len.astype(jnp.int32) + block - 1) // block, L // block
+    )
     # sliding window: blocks wholly below ``start`` are fully masked —
     # skip them so windowed decode cost tracks the WINDOW, not the
     # prefix (correctness still comes from ``mask``; this is pure skip)
     b0 = jnp.asarray(start, jnp.int32) // block
 
-    m0 = jnp.full((B, H, 1, 1), -1e30, jnp.float32)
-    l0 = jnp.zeros((B, H, 1, 1), jnp.float32)
-    acc0 = jnp.zeros((B, 1, H, D), jnp.float32)
+    m0 = jnp.full((B, H, Tq, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((B, H, Tq, 1), jnp.float32)
+    acc0 = jnp.zeros((B, Tq, H, D), jnp.float32)
 
     def body(j, carry):
         m, l, acc = carry
@@ -464,23 +476,18 @@ class MultiHeadAttention(Module):
             # per-row cache indices ([B]-shaped ``index``): the
             # continuous-batching serving form — each batch row is an
             # independent request slot with its own write position
-            # (parallel/serving.py). Single-token decode only; the
-            # caller owns positions and the validity mask (slot order is
+            # (parallel/serving.py). T == 1 decode and T > 1
+            # speculative verify-K frontier writes; the caller owns
+            # positions and the history validity mask (slot order is
             # logical order per row up to its constant left-pad offset,
-            # so causality is implied by validity and the positional
-            # predicate is never consulted).
+            # so causality folds as a per-query slot bound and the
+            # positional predicate is never consulted).
             vec_index = getattr(cache["index"], "ndim", 0) == 1
             if vec_index and rolling:
                 raise NotImplementedError(
                     "per-row cache indices with a rolling cache would "
                     "need per-row wrap bookkeeping; serve windowed "
                     "models from the monotone cache"
-                )
-            if vec_index and T != 1:
-                raise ValueError(
-                    f"per-row cache indices require single-token decode "
-                    f"(T == 1), got T={T}; prefill a slot through a "
-                    "batch-1 scalar-index cache instead"
                 )
             # rolling (ring-buffer) cache for sliding-window serving:
             # write position wraps modulo capacity, so the cache stays
@@ -490,20 +497,30 @@ class MultiHeadAttention(Module):
             # parallel/inference.py rolling_cache.
             cap = cache["k"].shape[1]
             if vec_index:
-                # one scatter per k/v: row r writes its own slot
-                # index[r]. mode="drop" — a row whose region filled to
-                # capacity (index == cap) must write nothing (a clamp
-                # would corrupt its last real slot). Retired-but-not-
-                # readmitted serving rows park BELOW capacity and do
-                # keep writing; that garbage is harmless because the
-                # scheduler never validates their slots and prefill
-                # grafts the whole region on re-admission.
-                rows = jnp.arange(B)
-                ck = cache["k"].at[rows, cache["index"]].set(
-                    k[:, 0].astype(cache["k"].dtype), mode="drop"
+                # one scatter per k/v: token t of row r writes slot
+                # index[r] + t. mode="drop" — a row whose region filled
+                # to capacity (and any speculative overshoot past it)
+                # must write nothing (a clamp would corrupt its last
+                # real slot). Retired-but-not-readmitted serving rows
+                # park BELOW capacity and do keep writing; that garbage
+                # is harmless because the scheduler never validates
+                # their slots and prefill grafts the whole region on
+                # re-admission. T == 1 is the decode step; T > 1 is the
+                # speculative verify-K form (parallel/speculative.py):
+                # K+1 candidate tokens advance the decode frontier in
+                # ONE weight pass, with per-query causality folded below
+                # (query t attends slots <= index+t only), so a rejected
+                # suffix never influenced its own prefix and the caller
+                # rolls the frontier back by resetting the index —
+                # nothing at or below the rolled-back frontier was
+                # touched (rollback-safe).
+                rows = jnp.arange(B)[:, None]
+                wslots = cache["index"][:, None] + jnp.arange(T)[None, :]
+                ck = cache["k"].at[rows, wslots].set(
+                    k.astype(cache["k"].dtype), mode="drop"
                 )
-                cv = cache["v"].at[rows, cache["index"]].set(
-                    v[:, 0].astype(cache["v"].dtype), mode="drop"
+                cv = cache["v"].at[rows, wslots].set(
+                    v.astype(cache["v"].dtype), mode="drop"
                 )
                 new_cache = {"k": ck, "v": cv, "index": cache["index"] + T}
                 fresh = False
@@ -514,17 +531,22 @@ class MultiHeadAttention(Module):
                     )
                 Tk = cap
                 k, v = ck, cv
-                live = cache["index"] + T  # [B]
-                valid = (
-                    jnp.arange(Tk)[None, None, None, :]
-                    < live[:, None, None, None]
-                )
+                live_t = wslots + 1  # [B, T] frontier after each query
+                kslot = jnp.arange(Tk)[None, None, None, :]
+                # per-query causal bound over history + the chunk's own
+                # prefix; the caller's mask (validity over history, open
+                # at/after the frontier for T > 1) further restricts
+                valid = kslot < live_t[:, None, :, None]  # [B, 1, T, Tk]
                 mask = valid if mask is None else jnp.logical_and(mask, valid)
                 win = getattr(self, "window", None)
                 blocks_min = (
                     DECODE_BLOCK if win is not None
                     else DECODE_BLOCKWISE_MIN_WINDOWLESS
                 )
+                # T > 1 (verify-K) shares the block loop: the K+1
+                # queries ride one length-bounded pass instead of
+                # paying full cache width (the mask already carries
+                # per-query causality)
                 use_blockwise = (
                     Tk > blocks_min and Tk % DECODE_BLOCK == 0
                     and bias is None and getattr(self, "scale", None) is None
@@ -533,15 +555,14 @@ class MultiHeadAttention(Module):
                     # slot-space band == logical band: slot s holds
                     # logical position s - pads with pads constant per
                     # row, so s > live-1-window iff pos > q_pos-window
-                    win_start = jnp.maximum(live - win, 0)  # [B]
-                    kpos = jnp.arange(Tk)[None, None, None, :]
+                    win_start = jnp.maximum(live_t - win, 0)  # [B, T]
                     mask = jnp.logical_and(
-                        mask, kpos >= win_start[:, None, None, None]
+                        mask, kslot >= win_start[:, None, :, None]
                     )
                 if use_blockwise:
                     out = decode_attention_blockwise(
                         q, k.astype(q.dtype), v.astype(q.dtype),
-                        jnp.max(live),  # bound: mask owns per-row truth
+                        jnp.max(live_t),  # bound: mask owns per-row truth
                         mask=jnp.broadcast_to(
                             mask,
                             jnp.broadcast_shapes(mask.shape, (B, 1, 1, Tk)),
@@ -550,7 +571,7 @@ class MultiHeadAttention(Module):
                     )
                 else:
                     # mask is the sole authority (causality is implied:
-                    # every valid slot is at or before the lone query)
+                    # every attendable slot is at or before its query)
                     out = self._attn(
                         q, k.astype(q.dtype), v.astype(q.dtype),
                         causal=False, mask=mask, q_offset=0,
@@ -809,7 +830,10 @@ class MultiHeadAttention(Module):
         win = getattr(self, "window", None)
         win_start = None
         if win is not None:
-            win_start = jnp.maximum(tpos[:, -1] + 1 - win, 0)  # [B]
+            # block-skip bound from the EARLIEST query (T > 1 verify:
+            # later queries' bands start later; the skip must be
+            # conservative — per-query band truth stays in ``keep``)
+            win_start = jnp.maximum(tpos[:, 0] + 1 - win, 0)  # [B]
             keep = jnp.logical_and(keep, kpos > qpos - win)
         if mask is not None:
             if mask.shape[-1] != Lv:
@@ -823,7 +847,7 @@ class MultiHeadAttention(Module):
             else DECODE_BLOCKWISE_MIN_WINDOWLESS
         )
         if (
-            T == 1 and Lv > blocks_min and Lv % DECODE_BLOCK == 0
+            Lv > blocks_min and Lv % DECODE_BLOCK == 0
             and getattr(self, "scale", None) is None
         ):
             # same length-bounded online-softmax loop as the contiguous

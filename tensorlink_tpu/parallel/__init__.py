@@ -17,3 +17,7 @@ from tensorlink_tpu.parallel.serving import (  # noqa: F401
     QueueFullError,
     ServingError,
 )
+from tensorlink_tpu.parallel.speculative import (  # noqa: F401
+    SpecConfig,
+    SpeculativeDecoder,
+)

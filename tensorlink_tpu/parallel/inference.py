@@ -40,15 +40,14 @@ class GenerationConfig:
     eos_token_id: int | None = None
 
 
-def sample_logits(logits, key, temperature, top_k, top_p=1.0):
-    """One home for the sampling math ([..., V] logits -> token ids):
-    the engine's in-scan decode and the continuous-batching scheduler
-    (parallel/serving.py) must draw from EXACTLY the same distribution
-    or greedy token parity between the two serving paths breaks."""
-    logits = logits.astype(jnp.float32)
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
-    logits = logits / temperature
+def _filter_logits(logits, temperature, top_k, top_p=1.0):
+    """The temperature/top-k/top-p transform ``sample_logits`` draws
+    from, as (unnormalized, possibly -inf-masked) f32 logits. Factored
+    out so the speculative verify path (``spec_verify``) scores the
+    EXACT distribution the non-speculative sampler uses — rejection
+    sampling is only distribution-preserving against the true target.
+    ``temperature`` must be > 0 here (greedy never filters)."""
+    logits = logits.astype(jnp.float32) / temperature
     if top_k:
         # lax.top_k is O(V log k) and TPU-optimized; this runs inside
         # the per-token decode scan, so a full vocab sort would be on
@@ -66,7 +65,94 @@ def sample_logits(logits, key, temperature, top_k, top_p=1.0):
             jnp.where(keep, srt, jnp.inf), axis=-1, keepdims=True
         )
         logits = jnp.where(logits >= thr, logits, -jnp.inf)
-    return jax.random.categorical(key, logits, axis=-1)
+    return logits
+
+
+def sample_logits(logits, key, temperature, top_k, top_p=1.0):
+    """One home for the sampling math ([..., V] logits -> token ids):
+    the engine's in-scan decode and the continuous-batching scheduler
+    (parallel/serving.py) must draw from EXACTLY the same distribution
+    or greedy token parity between the two serving paths breaks."""
+    if temperature == 0.0:
+        return jnp.argmax(logits.astype(jnp.float32), axis=-1)
+    return jax.random.categorical(
+        key, _filter_logits(logits, temperature, top_k, top_p), axis=-1
+    )
+
+
+def spec_verify(tgt_logits, proposals, key, temperature, top_k,
+                top_p=1.0, draft_logits=None):
+    """Speculative accept/reject for ONE row: K drafted tokens against
+    the K+1 target positions of a single verify-K weight pass
+    (vectorize over serving slots with ``jax.vmap``).
+
+    ``tgt_logits`` [K+1, V]: target logits at the K+1 fed positions —
+    the fed tokens were ``[tok, d_1, .., d_K]``, so position i's logits
+    are the target's distribution for the token AFTER fed token i.
+    ``proposals`` [K]: the drafted tokens ``d_1..d_K``.
+    ``draft_logits`` [K, V] or None: the draft distribution each
+    proposal was drawn from; None means a DETERMINISTIC proposer (the
+    n-gram / prompt-lookup draft), i.e. a delta distribution at the
+    proposal — the rejection test then degenerates to accepting with
+    the target's own probability of the proposal.
+
+    Returns ``(n_emit, emitted)`` with ``emitted`` [K+1]: the first
+    ``n_emit`` entries extend the sequence (``emitted[i] ==
+    proposals[i]`` for ``i < n_emit - 1``; the last entry is the
+    correction at the first rejection, or the free bonus token when all
+    K were accepted). ``n_emit`` is always >= 1 — a verify pass never
+    yields fewer tokens than a plain decode step.
+
+    Greedy (``temperature == 0``): exact argmax match, so speculation
+    on/off is token-identical. ``temperature > 0``: standard
+    speculative rejection sampling (accept d_i with prob
+    min(1, p_tgt/p_draft); on rejection sample the clamped residual
+    max(p_tgt - p_draft, 0) renormalized) — the OUTPUT DISTRIBUTION is
+    provably the target's, whatever the draft proposes."""
+    K = proposals.shape[0]
+    proposals = proposals.astype(jnp.int32)
+    if temperature == 0.0:
+        t = jnp.argmax(tgt_logits.astype(jnp.float32), -1).astype(jnp.int32)
+        match = (proposals == t[:K]).astype(jnp.int32)
+        n_acc = jnp.cumprod(match).sum()
+        # for i < n_acc, t[i] == proposals[i]; t[n_acc] is the
+        # correction (or the bonus when n_acc == K)
+        return n_acc + 1, t
+    lt = jax.nn.log_softmax(
+        _filter_logits(tgt_logits, temperature, top_k, top_p), axis=-1
+    )  # [K+1, V]
+    V = lt.shape[-1]
+    lt_at = jnp.take_along_axis(lt[:K], proposals[:, None], axis=-1)[:, 0]
+    if draft_logits is None:
+        ld_at = jnp.zeros((K,), jnp.float32)  # delta: log q(d_i) = 0
+        q = jax.nn.one_hot(proposals, V, dtype=jnp.float32)
+    else:
+        ld = jax.nn.log_softmax(
+            _filter_logits(draft_logits, temperature, top_k, top_p),
+            axis=-1,
+        )
+        ld_at = jnp.take_along_axis(ld, proposals[:, None], axis=-1)[:, 0]
+        q = jnp.exp(ld)
+    ku, kr = jax.random.split(key)
+    u = jax.random.uniform(ku, (K,))
+    # a proposal the filtered target excludes has lt_at = -inf -> accept
+    # prob 0; min(., 0) keeps the ratio a probability
+    accept = u < jnp.exp(jnp.minimum(lt_at - ld_at, 0.0))
+    n_acc = jnp.cumprod(accept.astype(jnp.int32)).sum()
+    p_t = jnp.exp(lt)  # [K+1, V]
+    resid = jnp.maximum(p_t[:K] - q, 0.0)
+    rs = jnp.sum(resid, axis=-1, keepdims=True)
+    # degenerate residual (draft covers the target exactly at this
+    # position): fall back to the target itself — still correct, the
+    # rejection branch then just resamples from p_tgt
+    resid = jnp.where(rs > 0, resid / jnp.where(rs > 0, rs, 1.0), p_t[:K])
+    cand = jnp.concatenate([resid, p_t[K:]], axis=0)  # [K+1, V]
+    corr = jax.random.categorical(
+        kr, jnp.log(cand + 1e-38), axis=-1
+    ).astype(jnp.int32)
+    emitted = jnp.concatenate([proposals, jnp.zeros((1,), jnp.int32)])
+    emitted = emitted.at[n_acc].set(corr[n_acc])
+    return n_acc + 1, emitted
 
 
 class InferenceEngine:

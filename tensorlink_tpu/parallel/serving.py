@@ -40,6 +40,16 @@ prefill entirely), chunked prefill interleaved with decode dispatches
 (a long arriving prompt cannot stall in-flight decodes), and
 block-granular free on EOS/eviction with typed ``PoolExhaustedError``
 backpressure. HBM then scales with LIVE tokens, not slots x max_len.
+
+Both engines optionally decode SPECULATIVELY (``draft=`` /
+``speculative=``, parallel/speculative.py): each dispatched chunk runs
+``rounds`` rounds of draft-K-tokens + verify-all-K(+1 bonus)-in-one-
+target-weight-pass, rolling the KV write frontier back to the first
+rejection (contiguous: an index reset inside the slot region; paged:
+logical-index truncation — no block churn, rejected scatter writes
+land in blocks the very next verify overwrites before reading).
+Greedy output is token-identical with speculation on or off; the
+bench headline becomes ``accepted_tokens_per_weight_pass``.
 """
 
 from __future__ import annotations
@@ -59,11 +69,21 @@ from tensorlink_tpu.parallel.inference import (
     GenerationConfig,
     InferenceEngine,
     sample_logits,
+    spec_verify,
 )
 from tensorlink_tpu.parallel.kvpool import (
     BlockPool,
     PoolExhaustedError,
     PrefixIndex,
+)
+from tensorlink_tpu.parallel.speculative import (
+    SpecConfig,
+    SpeculativeDecoder,
+    ngram_propose,
+)
+from tensorlink_tpu.runtime.compile_cache import (
+    cache_entries,
+    enable_compile_cache,
 )
 
 __all__ = [
@@ -73,7 +93,12 @@ __all__ = [
     "PromptTooLongError",
     "QueueFullError",
     "ServingError",
+    "SpecConfig",
 ]
+
+# per-request acceptance-rate histogram bounds (a rate lives in [0, 1];
+# the latency-shaped default buckets would bin every value together)
+_ACCEPTANCE_BUCKETS = tuple(i / 10 for i in range(1, 11))
 
 
 def _is_index_leaf(leaf) -> bool:
@@ -123,6 +148,10 @@ class _Request:
     tokens: list[int] = field(default_factory=list)
     done: bool = False
     finished_at: float | None = None
+    # speculative-decoding accounting (0 when speculation is off)
+    spec_rounds: int = 0  # verify passes this request was live for
+    spec_proposed: int = 0  # drafted tokens verified on its behalf
+    spec_accepted: int = 0  # drafted tokens accepted into its stream
 
 
 class ContinuousBatchingEngine:
@@ -150,6 +179,9 @@ class ContinuousBatchingEngine:
         keep_results: int = 1024,
         prefill_cache_max: int = 32,
         warm_buckets: bool = False,
+        draft: InferenceEngine | None = None,
+        speculative: SpecConfig | bool | None = None,
+        compile_cache_dir: str | None = None,
         metrics=None,
         recorder=None,
     ):
@@ -198,6 +230,29 @@ class ContinuousBatchingEngine:
             collections.OrderedDict()
         )
 
+        # speculative decoding (parallel/speculative.py): a draft
+        # engine implies draft-model speculation; ``speculative`` alone
+        # (True or a SpecConfig) enables n-gram self-speculation
+        self.spec: SpeculativeDecoder | None = None
+        if draft is not None or speculative:
+            cfg = (
+                speculative if isinstance(speculative, SpecConfig)
+                else SpecConfig()
+            )
+            self.spec = SpeculativeDecoder(engine, draft, cfg)
+        self.spec_rounds_total = 0  # (live row, verify pass) pairs
+        self.spec_emitted_total = 0
+        self.spec_accepted_total = 0
+        self.spec_proposed_total = 0
+        self.spec_fallback_total = 0
+
+        # persistent XLA compilation cache (ROADMAP item 5): restarts
+        # reuse kernels; compile events below report per-program hits
+        self._cc_dir = enable_compile_cache(
+            compile_cache_dir, recorder=recorder
+        )
+        self._cc_entries = cache_entries(self._cc_dir) if self._cc_dir else 0
+
         self._state = self._init_state()
         self._decode = self._build_decode()
         if warm_buckets:
@@ -224,6 +279,7 @@ class ContinuousBatchingEngine:
             "remaining": jnp.zeros((S,), jnp.int32),
             "live": jnp.zeros((S,), bool),
         }
+        self._add_spec_state(state)
         mesh = eng.mesh
         if mesh.shape.get(eng.data_axis, 1) > 1 and S % mesh.shape[eng.data_axis] == 0:
             # slots ride the data axis exactly like engine batch rows
@@ -239,11 +295,26 @@ class ContinuousBatchingEngine:
             state = jax.tree.map(jax.device_put, state)
         return state
 
+    def _add_spec_state(self, state: dict) -> None:
+        """Speculation state riding the donated serving tree: a per-slot
+        draft KV cache (draft-model mode, same slot layout/capacity as
+        the target view so one frontier and one validity mask serve
+        both) or a slot-aligned token-id buffer (n-gram mode — the
+        context prompt-lookup drafts from, entirely on device)."""
+        if self.spec is None:
+            return
+        if self.spec.mode == "draft":
+            state["draft"] = self.spec.init_draft_caches(self.slots, self.L)
+        else:
+            state["ids"] = jnp.zeros((self.slots, self.L), jnp.int32)
+
     def _fill_token(self) -> int:
         return self.gen.eos_token_id if self.gen.eos_token_id is not None else 0
 
     # ------------------------------------------------------------- programs
     def _build_decode(self):
+        if self.spec is not None:
+            return self._build_spec_chunk()
         eng = self.engine
         model, S, L, K = eng.model, self.slots, self.L, self.decode_chunk
         gen = self.gen
@@ -308,6 +379,180 @@ class ContinuousBatchingEngine:
         # across chunk calls instead of being copied per dispatch
         return jax.jit(chunk, donate_argnums=(1,))
 
+    # ----------------------------------------------------- speculative chunk
+    def _spec_open_mask(self, state, f0):
+        """History-validity mask for the verify/draft passes, OPEN at and
+        after the frontier: the T==K+1 per-row attention path bounds each
+        query at ``kslot <= index + t`` internally, so opening the fresh
+        region here cannot leak future slots — it only admits the chunk's
+        own causal prefix. (The paged engine overrides this to None: its
+        rows are never padded, so the in-logical-coordinates causality of
+        the paged attention path is already exact.)"""
+        ar = jnp.arange(self.L)[None, :]
+        return (state["valid"] | (ar >= f0[:, None]))[:, None, None, :]
+
+    @property
+    def _chunk_advance(self) -> int:
+        """Max tokens one dispatched chunk advances a live row by (the
+        paged engine grows block tables ahead of dispatch by this)."""
+        if self.spec is not None:
+            return self.spec.cfg.rounds * (self.spec.cfg.k + 1)
+        return self.decode_chunk
+
+    def _build_spec_chunk(self):
+        """ONE jitted program for speculative serving: ``rounds`` rounds
+        of draft-K + verify-K-in-one-target-weight-pass, whole state
+        donated. Per round and live row it emits 1..K+1 tokens (the
+        accepted prefix plus the correction/bonus) and rolls the KV
+        write frontier back to the first rejection — an index reset
+        only: rejected scatter writes sit at/after the rolled-back
+        frontier, are never validated, and the next round's verify
+        overwrites them before reading (nn/attention.py T>1 per-row
+        path / the paged path's logical-coordinate causality).
+
+        Outputs per dispatch: ``toks [R, K+1, S]``, ``n_emit [R, S]``
+        (0 marks a row that was not live that round — the host's
+        liveness signal), ``n_acc [R, S]`` (accepted proposals BEFORE
+        the EOS/budget clips — the draft-quality signal), and
+        ``fallback [R, S]`` (n-gram rows that found no match and
+        burned the pass)."""
+        eng, spec = self.engine, self.spec
+        model, S, L = eng.model, self.slots, self.L
+        K, R = spec.cfg.k, spec.cfg.rounds
+        gen = self.gen
+        temperature, top_k, top_p = (
+            float(gen.temperature), int(gen.top_k), float(gen.top_p)
+        )
+        eos = gen.eos_token_id
+        draft_mode = spec.mode == "draft"
+        draft_fn = spec.build_draft_fn(gen) if draft_mode else None
+
+        def round_fn(params, dparams, state):
+            caches, valid = state["caches"], state["valid"]
+            live, tok = state["live"], state["tok"]
+            n_valid, remaining = state["n_valid"], state["remaining"]
+            seed = state["seed"]
+            f0 = _cache_index(caches)  # [S] target write frontier
+            open_mask = self._spec_open_mask(state, f0)
+            if draft_mode:
+                props, dlg, dcaches = draft_fn(
+                    dparams, state["draft"], tok, n_valid, seed, open_mask
+                )
+                fb = jnp.zeros((S,), bool)
+            else:
+                props, found = ngram_propose(
+                    state["ids"], valid, f0, tok, K, spec.cfg.ngram
+                )
+                dlg = None
+                fb = live & ~found
+            # ONE target weight pass verifies all K proposals (+ the
+            # bonus position): feed [tok, d_1..d_K]
+            toks_in = jnp.concatenate([tok[:, None], props], axis=1)
+            positions = n_valid[:, None] + jnp.arange(K + 1)[None, :]
+            logits, caches = model.apply(
+                params, toks_in, caches=caches, positions=positions,
+                mask=open_mask,
+            )
+            if dlg is None:
+                def vrow(lg, pr, s, n):
+                    return spec_verify(
+                        lg, pr, spec.verify_key(s, n),
+                        temperature, top_k, top_p,
+                    )
+
+                n_raw, emitted = jax.vmap(vrow)(logits, props, seed, n_valid)
+            else:
+                def vrow(lg, pr, dl, s, n):
+                    return spec_verify(
+                        lg, pr, spec.verify_key(s, n),
+                        temperature, top_k, top_p, draft_logits=dl,
+                    )
+
+                n_raw, emitted = jax.vmap(vrow)(
+                    logits, props, dlg, seed, n_valid
+                )
+            idxk = jnp.arange(K + 1)
+            # draft-quality truth BEFORE the EOS/budget clips below: a
+            # clipped emission is the REQUEST ending, not the draft
+            # being wrong — charging it as rejection would deflate
+            # acceptance_rate (and trip tldiag LOW-ACCEPT) on
+            # short-generation traffic with a perfectly good draft
+            n_acc = jnp.where(live, jnp.minimum(n_raw - 1, K), 0)
+            if eos is not None:
+                hit = (emitted == eos) & (idxk[None, :] < n_raw[:, None])
+                eos_pos = jnp.min(
+                    jnp.where(hit, idxk[None, :], K + 1), axis=1
+                )
+                n_raw = jnp.minimum(n_raw, eos_pos + 1)
+            # budget clip keeps host and device token counts aligned
+            # (remaining >= 1 on live rows; max guards parked garbage)
+            n_raw = jnp.minimum(n_raw, jnp.maximum(remaining, 1))
+            n_emit = jnp.where(live, n_raw, 0).astype(jnp.int32)
+            new_remaining = remaining - n_emit
+            ended = new_remaining <= 0
+            if eos is not None:
+                ended = ended | (eos_pos < n_emit)
+            tok_new = jnp.take_along_axis(
+                emitted, jnp.maximum(n_emit - 1, 0)[:, None], axis=1
+            )[:, 0]
+            ar = jnp.arange(L)[None, :]
+            newly = (ar >= f0[:, None]) & (ar < (f0 + n_emit)[:, None])
+            nf = f0 + n_emit  # rolled-back frontier (rollback = reset)
+            new_state = {
+                **state,
+                "caches": _with_cache_index(caches, nf),
+                "valid": valid | newly,
+                "n_valid": n_valid + n_emit,
+                "tok": jnp.where(live, tok_new, tok),
+                "remaining": new_remaining,
+                "live": live & ~ended,
+            }
+            if draft_mode:
+                # draft frontier follows the target's exactly (the K+1
+                # draft steps covered every slot up to f0+K, so no hole)
+                new_state["draft"] = _with_cache_index(dcaches, nf)
+            else:
+                # bank the fed tokens for future prompt-lookups: slots
+                # [f0, f0+n_emit) now hold genuine sequence tokens;
+                # later slots hold rejected garbage past the frontier
+                rows = jnp.arange(S)[:, None]
+                new_state["ids"] = state["ids"].at[
+                    rows, f0[:, None] + idxk[None, :]
+                ].set(toks_in, mode="drop")
+            return new_state, (emitted.T, n_emit, n_acc.astype(jnp.int32), fb)
+
+        def chunk(params, dparams, state):
+            state, out = jax.lax.scan(
+                lambda st, _: round_fn(params, dparams, st),
+                state, None, length=R,
+            )
+            return (state, *out)
+
+        return self._jit_program(chunk)
+
+    def _jit_program(self, fn):
+        """jit one serving program written as ``fn(params, dparams,
+        state, *rest)``: draft mode threads the draft weights as a real
+        argument (a closure capture would bake them into the program as
+        constants); otherwise ``dparams`` is bound to None and dropped
+        from the traced signature. The donated-state protocol matching
+        ``_program_args`` lives HERE and nowhere else — the spec chunk
+        and both prefill forms must never diverge on it."""
+        if self.spec is not None and self.spec.mode == "draft":
+            return jax.jit(fn, donate_argnums=(2,))
+        return jax.jit(
+            lambda params, state, *a: fn(params, None, state, *a),
+            donate_argnums=(1,),
+        )
+
+    def _dispatch_decode(self) -> tuple:
+        """Dispatch one decode/spec chunk; returns the device payload
+        for the in-flight queue ((toks,) plain, (toks, n_emit, n_acc,
+        fallback) speculative)."""
+        out = self._decode(*self._program_args())
+        self._state = out[0]
+        return out[1:]
+
     def _bucket(self, t0: int) -> int:
         b = -(-t0 // self.prefill_block) * self.prefill_block
         return min(b, self.L)
@@ -320,8 +565,11 @@ class ContinuousBatchingEngine:
             float(gen.temperature), int(gen.top_k), float(gen.top_p)
         )
         eos = gen.eos_token_id
+        spec = self.spec
+        draft_mode = spec is not None and spec.mode == "draft"
 
-        def prefill(params, state, ids, pad_mask, slot, seed, max_new):
+        def prefill(params, dparams, state, ids, pad_mask, slot, seed,
+                    max_new):
             pos = jnp.maximum(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
             nv = pad_mask.sum(-1)[0].astype(jnp.int32)
             small = model.init_caches(1, Tp, dtype=eng.cache_dtype)
@@ -355,7 +603,8 @@ class ContinuousBatchingEngine:
             valid_row = jnp.zeros((L,), bool).at[:Tp].set(
                 pad_mask[0].astype(bool)
             )
-            return {
+            new_state = {
+                **state,
                 "caches": caches,
                 "valid": state["valid"].at[slot].set(valid_row),
                 "n_valid": state["n_valid"].at[slot].set(nv),
@@ -365,9 +614,29 @@ class ContinuousBatchingEngine:
                     (max_new - 1).astype(jnp.int32)
                 ),
                 "live": state["live"].at[slot].set(~done0),
-            }, tok0
+            }
+            if draft_mode:
+                # the draft's own prompt pass: identical slot layout, so
+                # the same graft lands it beside the target's cache
+                dmodel = spec.draft.model
+                dsmall = dmodel.init_caches(
+                    1, Tp, dtype=spec.draft.cache_dtype
+                )
+                _, dsmall = dmodel.apply(
+                    dparams, ids, caches=dsmall, positions=pos, mask=causal
+                )
+                new_state["draft"] = jax.tree.map(
+                    graft, state["draft"], dsmall
+                )
+            elif spec is not None:
+                # n-gram context buffer: prompt ids in slot layout (pads
+                # stay garbage — excluded via the validity mask)
+                new_state["ids"] = jax.lax.dynamic_update_slice(
+                    state["ids"], ids, (slot, 0)
+                )
+            return new_state, tok0
 
-        return jax.jit(prefill, donate_argnums=(1,))
+        return self._jit_program(prefill)
 
     def _get_prefill(self, Tp: int):
         """Compiled prefill program for bucket ``Tp`` from the bounded
@@ -388,7 +657,7 @@ class ContinuousBatchingEngine:
             # measured, attributable event instead of a mystery stall
             # inside the first unlucky submit()
             fn = jitfn.lower(
-                self.engine.params, self._state,
+                *self._program_args(),
                 jax.ShapeDtypeStruct((1, Tp), i32),
                 jax.ShapeDtypeStruct((1, Tp), i32),
                 jax.ShapeDtypeStruct((), i32),
@@ -399,11 +668,7 @@ class ContinuousBatchingEngine:
         except Exception:  # noqa: BLE001 — AOT is an optimization only
             fn = jitfn
             aot = False
-        compile_s = time.perf_counter() - t0
-        self._event(
-            "serving.compile", program="prefill", bucket=Tp,
-            compile_s=round(compile_s, 4), aot=aot,
-        )
+        compile_s = self._record_compile("prefill", t0, aot, bucket=Tp)
         if self.metrics is not None:
             self.metrics.observe("serving_prefill_compile_s", compile_s)
         self._prefill_jit[Tp] = fn
@@ -412,22 +677,31 @@ class ContinuousBatchingEngine:
             self._event("serving.prefill_evict", bucket=old)
         return fn
 
+    def _program_args(self) -> tuple:
+        """Leading (params[, draft params], state) args EVERY serving
+        program (decode/spec chunk and the prefill forms) takes — the
+        draft-model form threads the draft weights as a real argument
+        (a closure capture would bake them into the program as
+        constants). One method on purpose: decode and prefill diverging
+        here would mean two incompatible donated-state protocols."""
+        if self.spec is not None and self.spec.mode == "draft":
+            return (self.engine.params, self.spec.draft_params, self._state)
+        return (self.engine.params, self._state)
+
     def _warm(self) -> None:
         """Pre-compile the decode chunk and the prefill bucket set at
         construction (``warm_buckets=True``): first-request TTFT then
         measures serving, not XLA. Buckets warm smallest-first (typical
         traffic skews short) up to the prefill-cache bound."""
         t0 = time.perf_counter()
+        aot = True
         try:
             self._decode = self._decode.lower(
-                self.engine.params, self._state
+                *self._program_args()
             ).compile()
         except Exception:  # noqa: BLE001 — fall back to lazy jit
-            pass
-        self._event(
-            "serving.compile", program="decode",
-            compile_s=round(time.perf_counter() - t0, 4),
-        )
+            aot = False
+        self._record_compile("decode", t0, aot)
         top = min(self.L, self.engine.max_len)
         buckets = range(self.prefill_block, top + 1, self.prefill_block)
         for Tp in list(buckets)[: self.prefill_cache_max]:
@@ -440,6 +714,43 @@ class ContinuousBatchingEngine:
                 self.recorder.record(kind, severity, **data)
             except Exception:  # noqa: BLE001 — telemetry must not serve 500s
                 pass
+
+    def _record_compile(self, program: str, t0: float, aot: bool = True,
+                        **extra) -> float:
+        """Emit one ``serving.compile`` event; when the persistent
+        compilation cache is active, report whether this compile was
+        served from it (no new cache entries = hit — the ROADMAP-5
+        restart-reuses-kernels evidence)."""
+        compile_s = time.perf_counter() - t0
+        data = dict(
+            program=program, compile_s=round(compile_s, 4), aot=aot,
+            **extra,
+        )
+        if self._cc_dir:
+            n = cache_entries(self._cc_dir)
+            # aot=False means the AOT compile FAILED and fell back to
+            # lazy jit: nothing compiled yet, so "no new entries" is
+            # not a hit — stamping one would fake the restart-reuses-
+            # kernels evidence exactly when it's broken. The counter
+            # still refreshes so the lazy compile (whenever it lands)
+            # is not misattributed to the next recorded program.
+            if aot:
+                # n > 0 guards a silently-inoperative cache (backend
+                # pinned off, read-only dir): an empty directory that
+                # never grows must read as misses, not as a perfect
+                # hit streak fabricating the restart evidence
+                data["compile_cache_hit"] = bool(
+                    0 < n <= self._cc_entries
+                )
+                if self.metrics is not None:
+                    self.metrics.incr(
+                        "compile_cache_hits_total"
+                        if data["compile_cache_hit"]
+                        else "compile_cache_misses_total"
+                    )
+            self._cc_entries = n
+        self._event("serving.compile", **data)
+        return compile_s
 
     # ----------------------------------------------------------------- API
     def submit(
@@ -520,7 +831,7 @@ class ContinuousBatchingEngine:
         pm[0, Tp - t0:] = 1
         fn = self._get_prefill(Tp)
         args = (
-            self.engine.params, self._state, jnp.asarray(ids),
+            *self._program_args(), jnp.asarray(ids),
             jnp.asarray(pm), jnp.int32(slot), jnp.uint32(req.seed),
             jnp.int32(req.max_new),
         )
@@ -569,6 +880,16 @@ class ContinuousBatchingEngine:
                     (req.finished_at - req.first_token_at)
                     / (len(req.tokens) - 1),
                 )
+            if req.spec_proposed:
+                # per-request acceptance rate, alongside TTFT/TPOT in
+                # the same registry (tldiag reads the aggregate from
+                # /node; pathological acceptance means the draft is a
+                # bad match for this traffic, not a correctness issue)
+                self.metrics.observe_hist(
+                    "serving_spec_acceptance",
+                    req.spec_accepted / req.spec_proposed,
+                    buckets=_ACCEPTANCE_BUCKETS,
+                )
         self._event(
             "serving.finish", rid=req.rid, tokens=len(req.tokens),
         )
@@ -584,15 +905,63 @@ class ContinuousBatchingEngine:
             self._finish(req)
 
     def _drain_one(self) -> None:
-        toks, snapshot = self._inflight.popleft()
-        arr = np.asarray(toks)  # [K, S] — THE host sync point
+        payload, snapshot = self._inflight.popleft()
         for req in snapshot:
             if req is not None:
                 self._take_first(req)
-        for k in range(arr.shape[0]):
+        if self.spec is None:
+            arr = np.asarray(payload[0])  # [K, S] — THE host sync point
+            for k in range(arr.shape[0]):
+                for s, req in enumerate(snapshot):
+                    if req is not None and not req.done:
+                        self._append_token(req, arr[k, s])
+            return
+        self._drain_spec(payload, snapshot)
+
+    def _drain_spec(self, payload, snapshot) -> None:
+        """Drain one speculative chunk: ``toks [R, K+1, S]`` gated by
+        ``n_emit [R, S]`` (0 = the row was not live that round), with
+        ``n_acc [R, S]`` the verifier's PRE-CLIP accepted-proposal
+        count (EOS/budget truncation is the request ending, not a
+        rejection). Per live (row, round) pair tokens-per-weight-pass
+        is exactly ``n_emit``; acceptance rate comes from ``n_acc``."""
+        toks = np.asarray(payload[0])  # THE host sync point
+        ne = np.asarray(payload[1])
+        na = np.asarray(payload[2])
+        fb = np.asarray(payload[3])
+        K = self.spec.cfg.k
+        rounds = emitted = accepted = rejected = 0
+        for r in range(toks.shape[0]):
             for s, req in enumerate(snapshot):
-                if req is not None and not req.done:
-                    self._append_token(req, arr[k, s])
+                cnt = int(ne[r, s])
+                if req is None or cnt <= 0:
+                    continue
+                rounds += 1
+                emitted += cnt
+                acc = int(na[r, s])
+                accepted += acc
+                rejected += K - acc
+                if not req.done:
+                    req.spec_rounds += 1
+                    req.spec_proposed += K
+                    req.spec_accepted += acc
+                for k in range(cnt):
+                    if req.done:
+                        break
+                    self._append_token(req, toks[r, k, s])
+        self.spec_rounds_total += rounds
+        self.spec_emitted_total += emitted
+        self.spec_accepted_total += accepted
+        self.spec_proposed_total += rounds * K
+        nfb = int(fb.sum())
+        self.spec_fallback_total += nfb
+        if self.metrics is not None:
+            if accepted:
+                self.metrics.incr("spec_accepted_total", accepted)
+            if rejected:
+                self.metrics.incr("spec_rejected_total", rejected)
+            if nfb:
+                self.metrics.incr("spec_fallback_total", nfb)
 
     def _take_first(self, req: _Request) -> None:
         """Fold the prefill's first token into the stream (syncs a
@@ -617,10 +986,8 @@ class ContinuousBatchingEngine:
             self._admit_waiting()
             busy = any(r is not None for r in self._slot_req)
             if busy:
-                self._state, toks = self._decode(
-                    self.engine.params, self._state
-                )
-                self._inflight.append((toks, tuple(self._slot_req)))
+                payload = self._dispatch_decode()
+                self._inflight.append((payload, tuple(self._slot_req)))
             for r in self._slot_req:
                 if r is not None:
                     self._maybe_record_ttft(r)
@@ -680,10 +1047,43 @@ class ContinuousBatchingEngine:
         while self.step():
             pass
 
+    def _spec_stats(self) -> dict:
+        """Aggregate speculation counters. A "weight pass" is one
+        (live row, verify round) pair — the per-sequence unit the
+        non-speculative decode spends one full weight read per token
+        on; ``accepted_tokens_per_weight_pass`` > 1.0 is the bandwidth-
+        roofline win."""
+        prop = self.spec_proposed_total
+        wp = self.spec_rounds_total
+        return {
+            "mode": self.spec.mode,
+            "k": self.spec.cfg.k,
+            "rounds": self.spec.cfg.rounds,
+            "weight_passes": wp,
+            "emitted_tokens": self.spec_emitted_total,
+            "accepted_total": self.spec_accepted_total,
+            "proposed_total": prop,
+            "acceptance_rate": (
+                round(self.spec_accepted_total / prop, 4) if prop else 0.0
+            ),
+            "accepted_tokens_per_weight_pass": (
+                round(self.spec_emitted_total / wp, 4) if wp else 0.0
+            ),
+            "fallback_total": self.spec_fallback_total,
+            # per-request acceptance of the streams live RIGHT NOW —
+            # the /node view an operator reads when one tenant's
+            # traffic defeats the draft while the aggregate looks fine
+            "live_requests": {
+                r.rid: round(r.spec_accepted / r.spec_proposed, 4)
+                for r in self._slot_req
+                if r is not None and r.spec_proposed
+            },
+        }
+
     def stats(self) -> dict:
         """Host-side scheduler snapshot (queue depth, slot occupancy)."""
         with self._lock:
-            return {
+            out = {
                 "slots": self.slots,
                 "busy_slots": sum(
                     1 for r in self._slot_req if r is not None
@@ -692,6 +1092,9 @@ class ContinuousBatchingEngine:
                 "inflight_chunks": len(self._inflight),
                 "requests": len(self._requests),
             }
+            if self.spec is not None:
+                out["spec"] = self._spec_stats()
+            return out
 
 
 class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
@@ -816,6 +1219,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             "remaining": jnp.zeros((S,), jnp.int32),
             "live": jnp.zeros((S,), bool),
         }
+        # speculation rides the same donated tree; the draft cache is
+        # CONTIGUOUS per slot even here (the draft is small — paging it
+        # would buy little and cost a second block-table program)
+        self._add_spec_state(state)
         # commit (see the contiguous _init_state): fresh-vs-committed
         # aval mismatch would double-trace every block-table program
         return jax.tree.map(jax.device_put, state)
@@ -835,9 +1242,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             float(gen.temperature), int(gen.top_k), float(gen.top_p)
         )
         eos = gen.eos_token_id
+        spec = self.spec
+        draft_mode = spec is not None and spec.mode == "draft"
 
-        def chunk(params, state, ids, slot, start, nreal, seed, max_new,
-                  is_final):
+        def chunk(params, dparams, state, ids, slot, start, nreal, seed,
+                  max_new, is_final):
             caches = state["caches"]
             tmp = [
                 {"attn": {
@@ -876,7 +1285,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             done0 = max_new <= 1
             if eos is not None:
                 done0 = done0 | (tok0 == eos)
-            return {
+            new_state = {
+                **state,
                 "caches": new_caches,
                 "valid": state["valid"].at[slot].set(
                     jnp.arange(L) < n_end
@@ -888,9 +1298,58 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     jnp.where(is_final, max_new - 1, 0)
                 ),
                 "live": state["live"].at[slot].set(is_final & ~done0),
-            }, tok0
+            }
+            if draft_mode:
+                # the draft prefills the same chunk through its
+                # CONTIGUOUS per-slot cache: a 1-row scalar-index slice,
+                # cache-width masking implied (paged rows are unpadded,
+                # so slot order == logical order — the module's own
+                # causal/window predicates are exact)
+                dmodel = spec.draft.model
+                dc = state["draft"]
+                tmp_d = [
+                    {"attn": {
+                        "k": jax.lax.dynamic_slice_in_dim(
+                            lc["attn"]["k"], slot, 1, axis=0
+                        ),
+                        "v": jax.lax.dynamic_slice_in_dim(
+                            lc["attn"]["v"], slot, 1, axis=0
+                        ),
+                        "index": start,
+                    }}
+                    for lc in dc
+                ]
+                _, new_d = dmodel.apply(
+                    dparams, ids, caches=tmp_d, positions=positions,
+                    mask=None,
+                )
+                new_state["draft"] = [
+                    {"attn": {
+                        "k": jax.lax.dynamic_update_slice(
+                            lc["attn"]["k"], nt["attn"]["k"],
+                            (slot, 0, 0, 0),
+                        ),
+                        "v": jax.lax.dynamic_update_slice(
+                            lc["attn"]["v"], nt["attn"]["v"],
+                            (slot, 0, 0, 0),
+                        ),
+                        "index": lc["attn"]["index"].at[slot].set(
+                            start + nreal
+                        ),
+                    }}
+                    for lc, nt in zip(dc, new_d)
+                ]
+            elif spec is not None:
+                # n-gram context buffer: paged rows are unpadded, so the
+                # chunk lands at its logical positions directly (the pad
+                # tail past nreal is overwritten by the next chunk and
+                # never becomes valid)
+                new_state["ids"] = jax.lax.dynamic_update_slice(
+                    state["ids"], ids, (slot, start)
+                )
+            return new_state, tok0
 
-        return jax.jit(chunk, donate_argnums=(1,))
+        return self._jit_program(chunk)
 
     def _map_caches(self, state, fn):
         return {
@@ -968,11 +1427,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         i32 = jnp.int32
         sds = jax.ShapeDtypeStruct
         plans = (
-            ("decode", "_decode", (self.engine.params, self._state)),
+            ("decode", "_decode", self._program_args()),
             (
                 "prefill_chunk", "_prefill_chunk_fn",
                 (
-                    self.engine.params, self._state,
+                    *self._program_args(),
                     sds((1, self.prefill_chunk), i32),
                     sds((), i32), sds((), i32), sds((), i32),
                     sds((), jnp.uint32), sds((), i32),
@@ -989,10 +1448,15 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 aot = True
             except Exception:  # noqa: BLE001 — AOT is an optimization only
                 aot = False
-            self._event(
-                "serving.compile", program=program,
-                compile_s=round(time.perf_counter() - t0, 4), aot=aot,
-            )
+            self._record_compile(program, t0, aot)
+
+    def _spec_open_mask(self, state, f0):
+        """Paged rows are never padded and attend in LOGICAL
+        coordinates (nn/attention.py paged path: every slot at or
+        before a query's position is genuine history, causality and the
+        window band fold internally), so the verify/draft passes need
+        no caller mask at all."""
+        return None
 
     # ------------------------------------------------------------ admission
     def _check_fit(self, t0: int, max_new: int) -> None:
@@ -1164,7 +1628,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         buf[0, :nreal] = ids[pos:pos + nreal]
         is_final = pos + nreal >= len(ids)
         self._state, tok0 = self._prefill_chunk_fn(
-            self.engine.params, self._state, jnp.asarray(buf),
+            *self._program_args(), jnp.asarray(buf),
             jnp.int32(slot), jnp.int32(pos), jnp.int32(nreal),
             jnp.uint32(job["seed"]), jnp.int32(job["max_new"]),
             jnp.bool_(is_final),
@@ -1263,16 +1727,28 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def _grow_blocks(self, decoding: list[int]) -> list[int]:
         """Extend block tables ahead of the decode write frontier: the
-        next chunk advances each live row by up to ``decode_chunk``
-        positions with NO host sync, so the blocks must exist before
-        dispatch. Returns the decoding set minus any preempted slots."""
+        next chunk advances each live row by up to ``_chunk_advance``
+        positions (``decode_chunk``, or ``rounds * (k+1)`` under
+        speculation) with NO host sync, so the blocks must exist before
+        dispatch. Returns the decoding set minus any preempted slots.
+
+        Under low-acceptance speculation ``_slot_ub`` overshoots the
+        true frontier (rejected rounds advance less than the bound),
+        so a slot can hold blocks ahead of need — DELIBERATELY never
+        clamped back from drained ``n_emit``: the drain runs
+        ``pipeline_depth`` chunks behind dispatch and slots re-admit
+        between the two, so a host-side clamp that guessed low would
+        leave table entries at the sentinel and the device would DROP
+        that token's k/v — silent output corruption, vs. bounded
+        padding (the bound saturates at the request's own
+        prompt+budget limit, and preemption handles real pressure)."""
         bs = self.block_size
         for slot in decoding:
             req = self._slot_req[slot]
             if req is None or slot in self._pending:
                 continue  # preempted (or re-queued) by an earlier growth
             target = min(
-                self._slot_ub[slot] + self.decode_chunk,
+                self._slot_ub[slot] + self._chunk_advance,
                 self._slot_limit[slot],
             )
             need = -(-target // bs)
@@ -1302,9 +1778,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             if decoding:
                 decoding = self._grow_blocks(decoding)
             if decoding:
-                self._state, toks = self._decode(
-                    self.engine.params, self._state
-                )
+                payload = self._dispatch_decode()
                 live = set(decoding)
                 # mid-prefill slots are NOT live on device: their rows
                 # emit fill tokens that must never reach a request
@@ -1312,7 +1786,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     r if s in live else None
                     for s, r in enumerate(self._slot_req)
                 )
-                self._inflight.append((toks, snap))
+                self._inflight.append((payload, snap))
             for r in self._slot_req:
                 if r is not None:
                     self._maybe_record_ttft(r)

@@ -1,0 +1,234 @@
+"""Speculative decoding for the serving engines (ROADMAP item 2).
+
+Decode is pinned at 0.63-0.68 of the bandwidth roofline (BENCH_r05
+``fraction_attained``) because every emitted token pays a full weight
+pass. The only way past that bound is emitting MORE THAN ONE token per
+weight pass: a cheap draft proposes K tokens ahead, and the target
+model verifies all K (+1 bonus) positions in ONE jitted pass — the
+``verify-K`` form of the per-row cache machinery in nn/attention.py
+(T == K+1 decode-frontier writes with per-query causality, so a
+rejected suffix never influenced its accepted prefix and rollback is
+just an index reset).
+
+Two drafting strategies share the exact same verify program:
+
+- **draft model** (``SpeculativeDecoder(draft=...)``): a small sibling
+  from the model zoo runs K+1 single-token steps over its OWN per-slot
+  KV cache (kept in lockstep with the target's frontier — the extra
+  step writes the k/v of the last proposal so a fully-accepted round
+  leaves no hole in the draft cache);
+- **n-gram / prompt-lookup** (``draft=None``): proposals come from the
+  request's own context — the most recent recurrence of the trailing
+  n-gram, continued. No second model, no draft cache; covers targets
+  with no small sibling (Llama-8B) for free. Any proposal is
+  correctness-safe — verification fixes it — so a row with no match
+  just proposes its pending token (counted as a fallback).
+
+Acceptance math: per-token acceptance rate ``a`` yields an expected
+``(1 - a^(K+1)) / (1 - a)`` emitted tokens per target weight pass
+(plus the bonus); the serving engines report the realized
+``accepted_tokens_per_weight_pass`` per request and in aggregate.
+
+Greedy output is token-identical with speculation on or off; at
+``temperature > 0`` the standard rejection-sampling test
+(``parallel/inference.py spec_verify``) keeps the output distribution
+exactly the target's.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from tensorlink_tpu.parallel.inference import sample_logits
+
+__all__ = ["SpecConfig", "SpeculativeDecoder", "ngram_propose"]
+
+# RNG stream salts: speculation draws (draft proposals, accept/reject
+# uniforms + residual resampling) must not collide with the engine's
+# per-position sampling stream fold_in(key(seed), position)
+SALT_DRAFT = 0x5D
+SALT_VERIFY = 0x5E
+
+
+@dataclass(frozen=True)
+class SpecConfig:
+    """``k``: drafted tokens per verify pass (each pass emits 1..k+1
+    tokens). ``rounds``: (draft + verify) rounds per dispatched chunk —
+    the spec analogue of ``decode_chunk``; one dispatch advances a live
+    row by up to ``rounds * (k + 1)`` tokens. ``ngram``: match length
+    for prompt-lookup drafting (draft-model mode ignores it)."""
+
+    k: int = 4
+    rounds: int = 2
+    ngram: int = 2
+
+    def __post_init__(self):
+        if self.k < 1:
+            raise ValueError(f"spec k must be >= 1, got {self.k}")
+        if self.rounds < 1:
+            raise ValueError(f"spec rounds must be >= 1, got {self.rounds}")
+        if self.ngram < 2:
+            raise ValueError(
+                f"ngram must be >= 2 (1 would match every token), "
+                f"got {self.ngram}"
+            )
+
+
+def ngram_propose(ids, valid, index, tok, k: int, n: int):
+    """Prompt-lookup drafting, fully on device: for each row find the
+    most recent slot where the trailing n-gram (the last ``n-1``
+    committed tokens followed by the pending token ``tok``) already
+    occurred, and propose the ``k`` tokens that followed it.
+
+    ``ids`` [S, L] slot-aligned token ids (pads hold garbage — excluded
+    via ``valid``); ``valid`` [S, L] real-token slots; ``index`` [S]
+    write frontier (the pending token's slot); ``tok`` [S].
+
+    Returns ``(proposals [S, k] int32, found [S] bool)``. A row with no
+    match proposes its pending token repeated — verification makes any
+    proposal safe, it just wastes the pass (callers count it as a
+    fallback)."""
+    S, L = ids.shape
+    pos = jnp.arange(L)
+    # match window [p, p+n-1] must sit entirely in committed history:
+    # valid[p] plus an end bound suffices (the valid region of a row is
+    # one contiguous [pad_end, index) span)
+    ok = valid & ((pos + n - 1)[None, :] < index[:, None])
+    ok = ok & (index[:, None] >= n)  # enough history for a gram at all
+    # the trailing gram itself must be COMMITTED tokens: contiguous
+    # serving rows are left-padded (index counts pads + real tokens),
+    # so a short history would otherwise read pad garbage as the gram
+    # and hunt for a sequence that never occurred (wasting the pass
+    # without even counting as a fallback)
+    hist_ok = jnp.ones((ids.shape[0],), bool)
+    for j in range(n - 1):
+        slot_j = jnp.clip(index[:, None] - (n - 1) + j, 0, L - 1)
+        gram_j = jnp.take_along_axis(ids, slot_j, axis=1)  # [S, 1]
+        hist_ok = hist_ok & jnp.take_along_axis(valid, slot_j, axis=1)[:, 0]
+        ok = ok & (ids[:, jnp.minimum(pos + j, L - 1)] == gram_j)
+    ok = ok & hist_ok[:, None]
+    ok = ok & (ids[:, jnp.minimum(pos + n - 1, L - 1)] == tok[:, None])
+    best = jnp.max(jnp.where(ok, pos[None, :], -1), axis=1)  # [S]
+    found = best >= 0
+    p_idx = best[:, None] + n + jnp.arange(k)[None, :]  # [S, k]
+    props = jnp.take_along_axis(ids, jnp.clip(p_idx, 0, L - 1), axis=1)
+    real = found[:, None] & (p_idx < index[:, None])
+    props = jnp.where(real, props, tok[:, None])
+    return props.astype(jnp.int32), found
+
+
+class SpeculativeDecoder:
+    """Drafting side of speculative serving, shared by the contiguous
+    and paged engines (parallel/serving.py): owns the draft engine (if
+    any), the per-slot draft cache layout, and the traced draft-scan /
+    n-gram proposal functions the engines splice into their ONE spec
+    chunk program. The verify side is the target model itself plus
+    ``inference.spec_verify``."""
+
+    def __init__(self, engine, draft, cfg: SpecConfig):
+        self.engine = engine
+        self.draft = draft
+        self.cfg = cfg
+        self.mode = "draft" if draft is not None else "ngram"
+        if draft is not None:
+            if draft.rolling or draft.kv_seq_shard:
+                raise NotImplementedError(
+                    "draft engines must use the plain monotone cache "
+                    "(no rolling_cache / kv_seq_shard)"
+                )
+            tv = getattr(
+                getattr(engine.model, "cfg_obj", None), "vocab_size", None
+            )
+            dv = getattr(
+                getattr(draft.model, "cfg_obj", None), "vocab_size", None
+            )
+            if tv is not None and dv is not None and tv != dv:
+                raise ValueError(
+                    f"draft vocab {dv} != target vocab {tv}: drafted "
+                    "token ids would be meaningless to the target"
+                )
+
+    @property
+    def draft_params(self):
+        return self.draft.params if self.draft is not None else None
+
+    # ------------------------------------------------------------- state
+    def init_draft_caches(self, slots: int, length: int):
+        """Per-slot draft KV cache in the serving (vec-index) form:
+        same slot layout and capacity as the target's cache view, so
+        the two frontiers stay in lockstep and one validity mask
+        serves both."""
+        caches = self.draft.model.init_caches(
+            slots, length, dtype=self.draft.cache_dtype
+        )
+        return jax.tree.map(
+            lambda c: jnp.zeros((slots,), jnp.int32)
+            if getattr(c, "ndim", None) == 0
+            and jnp.issubdtype(c.dtype, jnp.integer) else c,
+            caches,
+        )
+
+    # ----------------------------------------------------------- drafting
+    def build_draft_fn(self, gen):
+        """Traced K+1-step draft scan: feeds ``tok`` then its own
+        proposals through the draft model's per-slot cache, returning
+        ``(proposals [S, K], draft_logits [S, K, V], new_caches)``.
+
+        The scan runs K+1 steps (not K): the last step writes the k/v
+        of proposal d_K into the draft cache and discards its own
+        proposal, so when the verify pass accepts all K (+ bonus) the
+        draft cache has no hole at the new frontier."""
+        model = self.draft.model
+        K = self.cfg.k
+        temperature = float(gen.temperature)
+        top_k, top_p = int(gen.top_k), float(gen.top_p)
+
+        def run(dparams, dcaches, tok, n_valid, seed, mask):
+            def step(carry, t):
+                dcaches, tok = carry
+                positions = (n_valid + t)[:, None]
+                logits, dcaches = model.apply(
+                    dparams, tok[:, None], caches=dcaches,
+                    positions=positions, mask=mask,
+                )
+                lg = logits[:, -1]
+                if temperature == 0.0:
+                    nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                else:
+                    def samp(s, n, row):
+                        key = jax.random.fold_in(
+                            jax.random.fold_in(jax.random.key(s), n),
+                            SALT_DRAFT,
+                        )
+                        return sample_logits(
+                            row, key, temperature, top_k, top_p
+                        )
+
+                    nxt = jax.vmap(samp)(
+                        seed, n_valid + t + 1, lg
+                    ).astype(jnp.int32)
+                return (dcaches, nxt), (nxt, lg)
+
+            (dcaches, _), (props, dlg) = jax.lax.scan(
+                step, (dcaches, tok), jnp.arange(K + 1)
+            )
+            # props[t] = d_{t+1}; keep d_1..d_K and their distributions
+            return (
+                props[:K].T,               # [S, K]
+                dlg[:K].transpose(1, 0, 2),  # [S, K, V]
+                dcaches,
+            )
+
+        return run
+
+    def verify_key(self, seed, n_valid):
+        """Per-row rejection-sampling key: a function of (request seed,
+        logical position) only — like the engine's sampling stream, so
+        a request's draws are independent of slot assignment and
+        co-tenant traffic."""
+        return jax.random.fold_in(
+            jax.random.fold_in(jax.random.key(seed), n_valid), SALT_VERIFY
+        )

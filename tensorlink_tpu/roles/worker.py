@@ -481,6 +481,12 @@ class WorkerNode(Node):
     def __init__(self, cfg: NodeConfig | None = None, registry=None, **kw):
         cfg = cfg or NodeConfig(role="worker")
         super().__init__(cfg, **kw)
+        # persistent compilation cache BEFORE any stage compiles: a
+        # restarted worker re-offered the same stage reloads its jitted
+        # train/forward programs from disk (ROADMAP item 5)
+        from tensorlink_tpu.runtime.compile_cache import enable_compile_cache
+
+        enable_compile_cache(cfg.compile_cache_dir, recorder=self.flight)
         self.registry = registry  # optional: verifies validator identity
         self.stages: dict[tuple[str, int], StageRunner] = {}
         # DP replica grad exchange: (job, stage, step, sender) -> (g, n)

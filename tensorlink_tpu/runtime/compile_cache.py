@@ -1,0 +1,107 @@
+"""Persistent XLA compilation cache (ROADMAP item 5 down payment).
+
+JAX ships a content-addressed on-disk compilation cache: the cache key
+hashes the optimized HLO + compile options + backend version, so a
+restarted process (or a second node on identical hardware) that lowers
+the same serving program loads the compiled executable from disk
+instead of paying XLA all over again. The serving engines compile a
+small, fixed program set (ONE decode/spec chunk + prefill buckets), so
+a warm cache turns their multi-second cold start into file reads.
+
+This module is the one switch for it:
+
+- :func:`enable_compile_cache` resolves the directory from an explicit
+  argument or the ``TL_COMPILE_CACHE_DIR`` environment variable, points
+  JAX at it (process-wide, first caller wins — the cache is global, so
+  a second engine asking for a DIFFERENT directory gets a warning event
+  and the original), and drops the min-size/min-compile-time floors so
+  even the small CI/CPU programs cache (the defaults skip sub-second
+  compiles — exactly the ones our tests can observe).
+- :func:`cache_entries` counts on-disk entries; the serving engines
+  diff it around each compile to label ``serving.compile`` flight
+  events with ``compile_cache_hit`` (no new entry = the executable came
+  from the cache) — the restart-reuses-kernels evidence a bench or an
+  operator can read straight off ``/events``.
+
+Callers treat a ``None`` return as "cache off" and skip the
+bookkeeping; failures to initialize degrade to that (an unwritable
+directory must not take down serving).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import jax
+
+from tensorlink_tpu.runtime.flight import default_recorder
+
+__all__ = ["cache_entries", "enable_compile_cache"]
+
+ENV_VAR = "TL_COMPILE_CACHE_DIR"
+
+_active_dir: str | None = None
+
+
+def enable_compile_cache(cache_dir: str | None = None, *,
+                         recorder=None) -> str | None:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (or
+    ``$TL_COMPILE_CACHE_DIR``); returns the active directory or None
+    when unconfigured. Idempotent; the cache is process-global, so the
+    first configured directory wins and later conflicting requests are
+    recorded (not honored)."""
+    global _active_dir
+    rec = recorder if recorder is not None else default_recorder()
+    d = cache_dir if cache_dir is not None else os.environ.get(ENV_VAR)
+    if not d:
+        return _active_dir
+    d = str(Path(d).expanduser())
+    if _active_dir is not None:
+        if _active_dir != d:
+            rec.record(
+                "compile_cache.conflict", severity="warn",
+                active=_active_dir, requested=d,
+            )
+        return _active_dir
+    try:
+        Path(d).mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        # cache EVERYTHING: the defaults skip small/fast compiles, which
+        # on CPU (CI) is every program — a floor here would make the
+        # feature untestable and silently useless off-TPU
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        # jax initializes its cache backend LAZILY on the first compile
+        # and never re-reads the directory config afterwards — any jit
+        # that ran before this call (model init, mesh probes) would pin
+        # the cache to "disabled" without this reset
+        try:
+            from jax._src.compilation_cache import reset_cache
+
+            reset_cache()
+        except Exception:  # noqa: BLE001 — private API; best effort
+            pass
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        rec.record(
+            "compile_cache.init_failed", severity="warn",
+            dir=d, error=repr(e),
+        )
+        return None
+    _active_dir = d
+    rec.record("compile_cache.enabled", dir=d, entries=cache_entries(d))
+    return d
+
+
+def cache_entries(cache_dir: str | None) -> int:
+    """Number of persisted executables in the cache directory (0 for
+    missing/None — callers diff this around compiles to detect hits)."""
+    if not cache_dir:
+        return 0
+    try:
+        return sum(
+            1 for p in Path(cache_dir).iterdir()
+            if p.is_file() and not p.name.startswith(".")
+        )
+    except OSError:
+        return 0

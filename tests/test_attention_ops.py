@@ -735,6 +735,43 @@ def test_sliding_window_blockwise_decode_parity():
     )
 
 
+def test_blockwise_decode_multi_query_parity():
+    """Tq > 1 (the speculative verify-K form): the K+1 candidate
+    queries share one length-bounded block loop; per-query causal
+    masks must reproduce the reference attention at every query."""
+    from tensorlink_tpu.nn.attention import (
+        DECODE_BLOCK,
+        decode_attention_blockwise,
+    )
+
+    r = np.random.default_rng(4)
+    B, T, H, D, L = 2, 5, 4, 16, 2 * DECODE_BLOCK
+    f0 = L - 40  # per-row frontier (uniform here; mask carries truth)
+    q = jnp.asarray(r.normal(size=(B, T, H, D)), jnp.float32)
+    k = jnp.asarray(r.normal(size=(B, L, H, D)), jnp.float32)
+    v = jnp.asarray(r.normal(size=(B, L, H, D)), jnp.float32)
+    kpos = np.arange(L)[None, None, None, :]
+    qend = (f0 + np.arange(T) + 1)[None, None, :, None]
+    mask = jnp.asarray(np.broadcast_to(kpos < qend, (B, 1, T, L)))
+    out = decode_attention_blockwise(
+        q, k, v, jnp.int32(f0 + T), mask=mask
+    )
+    ref = dot_product_attention(q, k, v, causal=True, q_offset=f0)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5
+    )
+    # a frontier within K slots of the region end yields a bound past
+    # capacity (those scatter writes were dropped); the loop must clamp
+    # instead of re-running the clamped last block, which double-counts
+    # its softmax mass (review repro: 5.9e-2 output error unclamped)
+    over = decode_attention_blockwise(
+        q, k, v, jnp.int32(L + T), mask=mask
+    )
+    np.testing.assert_allclose(
+        np.asarray(over), np.asarray(ref), atol=1e-5, rtol=1e-5
+    )
+
+
 def test_sliding_window_impl_support():
     from tensorlink_tpu.nn.attention import MultiHeadAttention
 
@@ -1072,9 +1109,15 @@ def test_vector_cache_index_contract_errors():
     cache = m.init_cache(2, 8, jnp.float32)
     cache = dict(cache)
     cache["index"] = jnp.zeros((2,), jnp.int32)
+    # T > 1 on the per-row path is the speculative verify-K form (ISSUE
+    # 7): token t of row r writes slot index[r] + t and the frontier
+    # advances by T — no longer a contract error
     x2 = jnp.zeros((2, 2, 32), jnp.float32)
-    with pytest.raises(ValueError, match="single-token"):
-        m.apply(p, x2, cache=cache, positions=jnp.zeros((2, 2), jnp.int32))
+    out2, c2up = m.apply(
+        p, x2, cache=cache, positions=jnp.zeros((2, 2), jnp.int32)
+    )
+    assert out2.shape == (2, 2, 32)
+    np.testing.assert_array_equal(np.asarray(c2up["index"]), [2, 2])
     x1 = jnp.zeros((2, 1, 32), jnp.float32)
     # rope consumes positions; per-row indices cannot reconstruct them
     with pytest.raises(ValueError, match="positions"):

@@ -114,6 +114,35 @@ def test_bench_diff_paged_kv_key_directions():
     }
 
 
+def test_bench_diff_speculation_key_directions():
+    """ISSUE-7 speculation keys: accepted tokens per weight pass,
+    acceptance rate, spec tok/s, and the spec-vs-nonspec ratio are
+    higher-better; n-gram fallbacks at fixed traffic are lower-better
+    (a 'more misses' improvement verdict would bless a lookup
+    regression)."""
+    old = {
+        "accepted_tokens_per_weight_pass": 2.0,
+        "spec_acceptance_rate": 0.6,
+        "spec_tokens_per_sec": 9000.0,
+        "spec_vs_nonspec": 1.5,
+        "spec_fallback_total": 100,
+    }
+    new = {
+        "accepted_tokens_per_weight_pass": 1.5,  # -25% -> regression
+        "spec_acceptance_rate": 0.7,             # +17% -> improvement
+        "spec_tokens_per_sec": 8000.0,           # -11% -> regression
+        "spec_vs_nonspec": 1.8,                  # +20% -> improvement
+        "spec_fallback_total": 80,               # -20% -> improvement
+    }
+    d = bench_diff(old, new, threshold=0.05)
+    assert set(d["regressions"]) == {
+        "accepted_tokens_per_weight_pass", "spec_tokens_per_sec",
+    }
+    assert set(d["improvements"]) == {
+        "spec_acceptance_rate", "spec_vs_nonspec", "spec_fallback_total",
+    }
+
+
 def test_node_row_flags_kv_pool_pressure():
     """A serving node whose /node reports a paged KV pool near capacity
     is flagged KV-PRESSURE (admissions about to backpressure); a calm
