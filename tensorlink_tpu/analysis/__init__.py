@@ -4,7 +4,7 @@ The reference implementation's defect catalog (SURVEY.md §2.9) is dominated
 by statically detectable failures: handlers for messages nobody sends, calls
 to methods that exist nowhere, shared state mutated across concurrent paths,
 and host syncs silently serializing jitted code. ``tensorlink_tpu.analysis``
-is a purpose-built linter for exactly those classes — four checker families
+is a purpose-built linter for exactly those classes — seven checker families
 over a shared package index:
 
 - **jit hygiene** (``TL0xx``, `jit_hygiene.py`): host syncs, state mutation,
@@ -17,11 +17,28 @@ over a shared package index:
   registered handler has a sender.
 - **API existence** (``TL3xx``, `api_exists.py`): ``self.method()`` and
   ``module.func()`` calls that resolve to nothing.
+- **donation safety** (``TL4xx``, `donation.py`): values read/returned/
+  aliased after being handed to a ``donate_argnums`` position, and donate
+  specs that match nothing on the wrapped function.
+- **retrace hazards** (``TL5xx``, `retrace.py`): jitted-call argument
+  shapes derived from per-call values instead of the bucket helpers,
+  per-call values in ``static_argnums`` positions, and unsanctioned
+  ``jax.clear_caches()``.
+- **thread/lock discipline** (``TL6xx``, `lock_discipline.py`): fields
+  written under a class's lock in one method but touched without it in
+  another, and thread-body/async-handler sharing with no lock at all.
+
+The TL4xx-TL6xx families run on the dataflow layer (`dataflow.py`):
+per-function CFG def-use chains, a per-class-hierarchy field/lock/call
+index, and jit-binding resolution (``self._decode = jax.jit(...)``).
 
 Run ``python -m tensorlink_tpu.analysis tensorlink_tpu/`` (or the ``tlint``
 console script). Accepted findings live in a committed baseline
-(``tlint.baseline.json``) so CI fails only on regressions; line-level
-``# tlint: disable=TLxxx`` comments suppress single sites.
+(``tlint.baseline.json`` — every entry carries a one-line justification)
+so CI fails only on regressions; line-level ``# tlint: disable=TLxxx
+[justification]`` comments suppress single sites. ``--fix`` applies the
+mechanical autofixes; repeated runs skip unchanged files through an
+mtime+size parse cache.
 """
 
 from tensorlink_tpu.analysis.core import (

@@ -69,17 +69,11 @@ _BLOCKING_CALLS = {
 def _iter_own_nodes(fn: ast.AST):
     """Walk a function body WITHOUT descending into nested defs/lambdas —
     their bodies run on someone else's schedule (often a worker thread via
-    to_thread), so their calls don't block THIS coroutine."""
-    stack = list(fn.body)
-    while stack:
-        node = stack.pop()
-        yield node
-        for child in ast.iter_child_nodes(node):
-            if isinstance(
-                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-            ):
-                continue
-            stack.append(child)
+    to_thread), so their calls don't block THIS coroutine. Shared with
+    the dataflow families (analysis/dataflow.py)."""
+    from tensorlink_tpu.analysis.dataflow import iter_own_nodes
+
+    yield from iter_own_nodes(fn)
 
 
 def _check_blocking(mod: ModuleInfo, fn: ast.AsyncFunctionDef, out: list):

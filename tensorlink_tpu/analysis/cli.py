@@ -2,19 +2,24 @@
 
 Exit codes: 0 clean (or every finding baselined/suppressed), 1 findings,
 2 usage error. `--write-baseline` accepts the current findings as the new
-baseline — the triage workflow is: run, read, fix what's real, baseline
-what's accepted, commit the baseline.
+baseline (preserving recorded justifications) — the triage workflow is:
+run, read, fix what's real, baseline what's accepted WITH a one-line
+reason, commit the baseline. `--fix` applies the mechanical autofixes
+(fix.py) before reporting; `--format github` emits workflow annotations
+so findings land inline on PR diffs.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 from tensorlink_tpu.analysis.core import (
     ALL_CHECKERS,
     BASELINE_NAME,
+    CACHE_NAME,
     PackageIndex,
     all_rules,
     find_default_baseline,
@@ -29,8 +34,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tlint",
         description=(
-            "AST static analysis for JAX retrace/host-sync hazards, "
-            "asyncio races, p2p RPC schema drift, and missing APIs."
+            "AST + dataflow static analysis for JAX retrace/host-sync/"
+            "donation hazards, asyncio and thread/lock races, p2p RPC "
+            "schema drift, and missing APIs."
         ),
     )
     p.add_argument(
@@ -38,8 +44,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="files or directories to analyze (default: tensorlink_tpu)",
     )
     p.add_argument(
-        "--format", choices=("text", "json"), default="text",
-        help="output format",
+        "--format", choices=("text", "json", "github"), default="text",
+        help=(
+            "output format (github: ::error workflow annotations for "
+            "inline PR findings)"
+        ),
     )
     p.add_argument(
         "--baseline", metavar="FILE", default=None,
@@ -50,11 +59,29 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--write-baseline", action="store_true",
-        help="write the current findings to the baseline file and exit 0",
+        help=(
+            "write the current findings to the baseline file and exit 0 "
+            "(justifications for surviving entries are preserved)"
+        ),
     )
     p.add_argument(
         "--family", action="append", choices=sorted(ALL_CHECKERS) or None,
         help="run only these checker families (repeatable)",
+    )
+    p.add_argument(
+        "--fix", action="store_true",
+        help=(
+            "apply the mechanical autofixes (TL103 get_event_loop, stale "
+            "disable comments) in place, then report what remains"
+        ),
+    )
+    p.add_argument(
+        "--cache", metavar="FILE", default=None,
+        help=(
+            "parse-cache file keyed on mtime+size so unchanged files "
+            f"skip re-parsing (default: {CACHE_NAME} beside the "
+            "baseline, or $TLINT_CACHE; 'none' disables)"
+        ),
     )
     p.add_argument(
         "--explain", metavar="RULE",
@@ -67,13 +94,29 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _resolve_cache(args, baseline_path: str | None) -> str | None:
+    if args.cache == "none":
+        return None
+    if args.cache is not None:
+        return args.cache
+    env = os.environ.get("TLINT_CACHE")
+    if env:
+        return None if env == "none" else env
+    if baseline_path is not None:
+        return os.path.join(os.path.dirname(baseline_path), CACHE_NAME)
+    return None
+
+
 def main(argv: list[str] | None = None) -> int:
     # importing the families fills the rule/checker registries the parser
     # and --explain/--list-rules read
     from tensorlink_tpu.analysis import (  # noqa: F401
         api_exists,
         async_safety,
+        donation,
         jit_hygiene,
+        lock_discipline,
+        retrace,
         rpc_schema,
     )
 
@@ -91,8 +134,15 @@ def main(argv: list[str] | None = None) -> int:
         print(f"{args.explain}: {doc}")
         return 0
 
+    baseline_path = args.baseline
+    if baseline_path is None:
+        baseline_path = find_default_baseline(args.paths[0])
+    elif baseline_path == "none":
+        baseline_path = None
+    cache_path = _resolve_cache(args, baseline_path)
+
     try:
-        index = PackageIndex.from_paths(args.paths)
+        index = PackageIndex.from_paths(args.paths, cache_path=cache_path)
     except (OSError, SyntaxError) as e:
         print(f"tlint: cannot analyze: {e}", file=sys.stderr)
         return 2
@@ -100,13 +150,19 @@ def main(argv: list[str] | None = None) -> int:
         print("tlint: no python files found", file=sys.stderr)
         return 2
 
-    findings = run_analysis(index, families=args.family)
+    if args.fix:
+        from tensorlink_tpu.analysis.fix import apply_fixes
 
-    baseline_path = args.baseline
-    if baseline_path is None:
-        baseline_path = find_default_baseline(args.paths[0])
-    elif baseline_path == "none":
-        baseline_path = None
+        edited = apply_fixes(index)
+        for notes in edited.values():
+            for note in notes:
+                # stderr: --format json/github stdout must stay parseable
+                print(f"tlint: fixed {note}", file=sys.stderr)
+        if edited:
+            # edited files must be re-read (never served from cache)
+            index = PackageIndex.from_paths(args.paths, cache_path=cache_path)
+
+    findings = run_analysis(index, families=args.family)
 
     if args.write_baseline:
         path = baseline_path or BASELINE_NAME
@@ -130,9 +186,27 @@ def main(argv: list[str] | None = None) -> int:
                 "findings": [f.to_json() for f in fresh],
                 "baselined": known,
                 "files": len(index.modules),
+                "cache_hits": index.cache_hits,
+                "cache_misses": index.cache_misses,
             },
             indent=2,
         ))
+    elif args.format == "github":
+        for f in fresh:
+            # https://docs.github.com/actions: workflow commands; the
+            # message must be single-line (escape % first)
+            msg = (
+                f.message.replace("%", "%25")
+                .replace("\r", "%0D").replace("\n", "%0A")
+            )
+            print(
+                f"::error file={f.path},line={f.line},"
+                f"title=tlint {f.rule}::{msg}"
+            )
+        print(
+            f"tlint: {len(fresh)} finding(s) in {len(index.modules)} "
+            f"file(s) ({known} baselined)"
+        )
     else:
         for f in fresh:
             print(f)

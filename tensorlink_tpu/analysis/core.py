@@ -11,12 +11,15 @@ from __future__ import annotations
 import ast
 import json
 import os
+import pickle
 import tokenize
 from dataclasses import dataclass, field
 from io import StringIO
 from typing import Callable, Iterable
 
 BASELINE_NAME = "tlint.baseline.json"
+CACHE_NAME = ".tlint-cache.pkl"
+_CACHE_VERSION = 1
 _DISABLE_MARK = "tlint: disable="
 
 
@@ -112,10 +115,23 @@ def _collect_disables(mod: ModuleInfo) -> None:
             text = tok.string
             if "tlint:" not in text:
                 continue
+            # a DIRECTIVE must start the comment ("# tlint: ...") — a
+            # comment that merely MENTIONS the syntax (docs, examples)
+            # is not one, and --fix must never strip it
+            if not text.lstrip("#").lstrip().startswith("tlint:"):
+                continue
             if _DISABLE_MARK in text:
+                # rule ids may be followed by a free-form justification:
+                # (disable=TL503 tuning must retrace)
                 spec = text.split(_DISABLE_MARK, 1)[1].split("#")[0]
-                rules = {r.strip() for r in spec.split(",") if r.strip()}
-                mod.disabled[tok.start[0]] = rules
+                rules = set()
+                for chunk in spec.replace(",", " ").split():
+                    if chunk.startswith("TL") and chunk[2:].isdigit():
+                        rules.add(chunk)
+                    else:
+                        break  # justification text starts here
+                if rules:
+                    mod.disabled[tok.start[0]] = rules
             elif text.split("tlint:", 1)[1].strip() == "disable":
                 mod.disabled[tok.start[0]] = set()
     except tokenize.TokenizeError:  # pragma: no cover - parse already passed
@@ -129,9 +145,23 @@ class PackageIndex:
         self.modules = modules
         self.by_path = {m.path: m for m in modules}
         self.by_dotted = {m.dotted: m for m in modules}
+        # incremental-cache accounting (from_paths with cache_path)
+        self.cache_hits = 0
+        self.cache_misses = 0
+        # canonical path -> filesystem path, for tools that edit files
+        self.fs_paths: dict[str, str] = {}
 
     @classmethod
-    def from_paths(cls, paths: Iterable[str]) -> "PackageIndex":
+    def from_paths(
+        cls, paths: Iterable[str], cache_path: str | None = None
+    ) -> "PackageIndex":
+        """Build the index, optionally through an on-disk parse cache.
+
+        The cache maps canonical path -> ((mtime_ns, size), ModuleInfo)
+        so repeated runs (CI, pre-commit) skip re-parsing unchanged
+        files — only (mtime, size) is checked, never content. A stale,
+        corrupt, or version-mismatched cache is silently discarded;
+        the cache file is rewritten only when something changed."""
         files: list[str] = []
         for p in paths:
             if os.path.isdir(p):
@@ -147,12 +177,59 @@ class PackageIndex:
                     )
             elif p.endswith(".py"):
                 files.append(p)
+        cached: dict = {}
+        if cache_path is not None and os.path.exists(cache_path):
+            try:
+                with open(cache_path, "rb") as fh:
+                    payload = pickle.load(fh)
+                if payload.get("version") == _CACHE_VERSION:
+                    cached = payload.get("modules", {})
+            except Exception:  # noqa: BLE001 — a bad cache is just cold
+                cached = {}
         modules = []
+        fs_paths: dict[str, str] = {}
+        hits = misses = 0
+        fresh: dict = {}
         for f in files:
+            key = cls._canonical_path(f)
+            fs_paths[key] = f
+            st = os.stat(f)
+            stamp = (st.st_mtime_ns, st.st_size)
+            hit = cached.get(key)
+            if hit is not None and hit[0] == stamp:
+                modules.append(hit[1])
+                fresh[key] = hit
+                hits += 1
+                continue
             with open(f, encoding="utf-8") as fh:
                 src = fh.read()
-            modules.append(cls._parse(cls._canonical_path(f), src))
-        return cls(modules)
+            mod = cls._parse(key, src)
+            modules.append(mod)
+            fresh[key] = (stamp, mod)
+            misses += 1
+        if cache_path is not None and misses:
+            try:
+                tmp = cache_path + ".tmp"
+                with open(tmp, "wb") as fh:
+                    # MERGE into the existing cache: a narrower run
+                    # (`tlint pkg/sub`) must not evict every other
+                    # target's entries from the shared file (entries
+                    # for since-deleted files linger harmlessly — the
+                    # stamp check ignores them)
+                    pickle.dump(
+                        {
+                            "version": _CACHE_VERSION,
+                            "modules": {**cached, **fresh},
+                        },
+                        fh,
+                    )
+                os.replace(tmp, cache_path)
+            except OSError:
+                pass  # read-only checkout: run uncached
+        index = cls(modules)
+        index.cache_hits, index.cache_misses = hits, misses
+        index.fs_paths = fs_paths
+        return index
 
     @staticmethod
     def _canonical_path(f: str) -> str:
@@ -224,15 +301,23 @@ def all_rules() -> dict[str, str]:
 
 
 def run_analysis(
-    index: PackageIndex, families: Iterable[str] | None = None
+    index: PackageIndex,
+    families: Iterable[str] | None = None,
+    apply_disables: bool = True,
 ) -> list[Finding]:
-    """Run checkers (all by default) and drop line-level-suppressed hits."""
+    """Run checkers (all by default) and drop line-level-suppressed hits
+    (``apply_disables=False`` keeps them — the --fix machinery needs the
+    raw findings to tell a load-bearing disable comment from a stale
+    one)."""
     # late import so `import tensorlink_tpu.analysis.core` alone doesn't
     # register half a table — the registry must be full before any run
     from tensorlink_tpu.analysis import (  # noqa: F401
         api_exists,
         async_safety,
+        donation,
         jit_hygiene,
+        lock_discipline,
+        retrace,
         rpc_schema,
     )
 
@@ -241,32 +326,80 @@ def run_analysis(
     for name in names:
         findings.extend(ALL_CHECKERS[name](index))
     kept = []
+    seen: set[tuple] = set()
     for f in findings:
         mod = index.by_path.get(f.path)
-        if mod is not None and mod.suppressed(f.rule, f.line):
+        if (
+            apply_disables
+            and mod is not None
+            and mod.suppressed(f.rule, f.line)
+        ):
             continue
+        sig = (f.rule, f.path, f.line, f.symbol or f.message)
+        if sig in seen:
+            continue
+        seen.add(sig)
         kept.append(f)
     kept.sort(key=lambda f: (f.path, f.line, f.rule))
     return kept
 
 
 # --------------------------------------------------------------- baseline
+# An entry is either a bare fingerprint string (legacy) or
+# {"fingerprint": ..., "reason": "<one-line justification>"} — the
+# committed baselines use the reasoned form so every accepted finding
+# explains WHY it is accepted (the acceptance-gate requirement).
+def _entry_fingerprint(entry) -> str:
+    if isinstance(entry, str):
+        return entry
+    if isinstance(entry, dict) and "fingerprint" in entry:
+        return entry["fingerprint"]
+    raise ValueError(f"bad baseline entry: {entry!r}")
+
+
 def load_baseline(path: str) -> set[str]:
     with open(path, encoding="utf-8") as fh:
         data = json.load(fh)
     if not isinstance(data, dict) or "suppress" not in data:
         raise ValueError(f"{path}: not a tlint baseline (missing 'suppress')")
-    return set(data["suppress"])
+    return {_entry_fingerprint(e) for e in data["suppress"]}
+
+
+def load_baseline_reasons(path: str) -> dict[str, str]:
+    """fingerprint -> justification ('' for legacy bare-string entries)."""
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    out: dict[str, str] = {}
+    for e in data.get("suppress", []):
+        if isinstance(e, str):
+            out[e] = ""
+        elif isinstance(e, dict) and "fingerprint" in e:
+            out[e["fingerprint"]] = e.get("reason", "")
+    return out
 
 
 def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    """Write the current findings as the new baseline, PRESERVING any
+    justifications already recorded for surviving fingerprints. New
+    entries get an empty reason — fill it in before committing."""
+    old: dict[str, str] = {}
+    if os.path.exists(path):
+        try:
+            old = load_baseline_reasons(path)
+        except (OSError, ValueError, json.JSONDecodeError):
+            old = {}
+    entries = [
+        {"fingerprint": fp, "reason": old.get(fp, "")}
+        for fp in sorted({f.fingerprint for f in findings})
+    ]
     data = {
         "comment": (
             "Accepted tlint findings; python -m tensorlink_tpu.analysis "
             "fails only on findings NOT fingerprinted here. Regenerate "
-            "with --write-baseline after triaging new findings."
+            "with --write-baseline after triaging new findings; every "
+            "entry must carry a one-line reason before commit."
         ),
-        "suppress": sorted({f.fingerprint for f in findings}),
+        "suppress": entries,
     }
     with open(path, "w", encoding="utf-8") as fh:
         json.dump(data, fh, indent=2)

@@ -1,0 +1,950 @@
+"""Intraprocedural dataflow layer over the PackageIndex.
+
+The TL4xx (donation safety), TL5xx (retrace hazards), and TL6xx
+(thread/lock discipline) families all need more than per-node AST
+pattern matching: "is this value read after that call on any path",
+"who calls this method and does every caller hold the lock", "which
+jit-wrapped program does ``self._decode`` name". This module provides
+those three building blocks once:
+
+- :class:`FuncFlow` — a statement-level CFG for one function body with
+  per-statement def/use facts over plain names AND ``self.X``
+  pseudo-names, answering the use-after-donate query
+  (:meth:`FuncFlow.first_reads_after`).
+- :class:`ClassUnit` — one per class HIERARCHY (package-resolvable
+  bases merged, so a subclass method touching a base-class field is
+  one unit): every ``self.X`` read/write with the lexical lock set
+  held, the self-call graph with per-site lock context, lock fields,
+  thread-entry methods, and async methods.
+- :func:`collect_jit_bindings` / :class:`JitBinding` — which local
+  names / module globals / ``self.attr`` fields are bound to
+  ``jax.jit``-wrapped programs, with their ``donate_argnums`` /
+  ``donate_argnames`` / ``static_argnums`` / ``static_argnames`` and
+  (when resolvable) the wrapped function's def node.
+
+Known limits (documented in the README): the analysis is
+INTRAPROCEDURAL — the only cross-function facts are the per-class
+indexes above; there is NO alias analysis through containers (a
+donated array stored into a dict and read back is invisible, as is a
+lock passed as an argument); lock tracking is LEXICAL (``with
+self._lock:`` blocks — manual ``acquire()``/``release()`` pairs are
+not modeled).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tensorlink_tpu.analysis.core import (
+    ModuleInfo,
+    PackageIndex,
+    resolve_call,
+)
+from tensorlink_tpu.analysis.jit_hygiene import _JIT_WRAPPERS
+
+_EXIT = -1
+_SELF = "self."
+
+
+def access_name(node: ast.AST) -> str | None:
+    """'x' for a Name, 'self.x' for a self-attribute, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return _SELF + node.attr
+    return None
+
+
+def iter_own_nodes(fn: ast.AST):
+    """Walk a function body WITHOUT descending into nested defs or
+    lambdas — each def gets its own analysis pass. The skip tests the
+    POPPED node, not just pushed children: a nested def that is a
+    direct statement of fn.body arrives on the initial stack."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def iter_functions(mod: ModuleInfo):
+    """Every def in the module (top-level, methods, nested), once."""
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def module_defs(mod: ModuleInfo) -> dict[str, ast.AST]:
+    return {
+        n.name: n for n in mod.tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+# =====================================================================
+# FuncFlow: per-function CFG + def/use
+# =====================================================================
+class FuncFlow:
+    """Statement-level control-flow graph for ONE function body.
+
+    Each simple statement (and each compound statement's HEADER — an
+    ``if`` test, a ``for`` iterator, a ``with`` context expression) is
+    one node carrying (name, ast-node) read pairs and a set of defined
+    (killed) names. Names cover plain locals and ``self.X``. Back
+    edges exist for loops, so "read after X on any path" includes the
+    next loop iteration; ``try`` bodies edge into their handlers."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.stmts: list[ast.stmt] = []
+        self.succ: list[list[int]] = []
+        self.reads: list[list[tuple[str, ast.AST]]] = []
+        self.defs: list[set[str]] = []
+        self._owner: dict[int, int] = {}  # id(expr node) -> stmt index
+        self._loops: list[tuple[int, int]] = []  # (continue_to, break_to)
+        body = fn.body if isinstance(fn.body, list) else [ast.Expr(fn.body)]
+        self.entry = self._seq(body, _EXIT)
+
+    # ------------------------------------------------------------ build
+    def _seq(self, stmts: list[ast.stmt], follow: int) -> int:
+        entry = follow
+        for s in reversed(stmts):
+            entry = self._stmt(s, entry)
+        return entry
+
+    def _node(self, stmt: ast.stmt, succ: list[int]) -> int:
+        idx = len(self.stmts)
+        self.stmts.append(stmt)
+        self.succ.append(succ)
+        self.reads.append([])
+        self.defs.append(set())
+        return idx
+
+    def _stmt(self, s: ast.stmt, follow: int) -> int:
+        if isinstance(s, ast.If):
+            n = self._node(s, [])
+            self._scan_reads(n, s.test)
+            b = self._seq(s.body, follow)
+            o = self._seq(s.orelse, follow)
+            self.succ[n] = [b, o]
+            return n
+        if isinstance(s, (ast.While, ast.For, ast.AsyncFor)):
+            n = self._node(s, [])
+            if isinstance(s, ast.While):
+                self._scan_reads(n, s.test)
+            else:
+                self._scan_reads(n, s.iter)
+                self._scan_store(n, s.target)
+            self._loops.append((n, follow))
+            b = self._seq(s.body, n)  # back edge to the loop head
+            self._loops.pop()
+            o = self._seq(s.orelse, follow)
+            self.succ[n] = [b, o]
+            return n
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            n = self._node(s, [])
+            for item in s.items:
+                self._scan_reads(n, item.context_expr)
+                if item.optional_vars is not None:
+                    self._scan_store(n, item.optional_vars)
+            self.succ[n] = [self._seq(s.body, follow)]
+            return n
+        if isinstance(s, ast.Try) or s.__class__.__name__ == "TryStar":
+            f = self._seq(s.finalbody, follow) if s.finalbody else follow
+            handlers = [self._seq(h.body, f) for h in s.handlers]
+            o = self._seq(s.orelse, f) if s.orelse else f
+            lo = len(self.stmts)
+            b = self._seq(s.body, o)
+            # any body statement may raise into any handler (coarse)
+            for i in range(lo, len(self.stmts)):
+                self.succ[i] = list(self.succ[i]) + handlers
+            if s.body:
+                return b
+            return handlers[0] if handlers else o
+        if isinstance(s, ast.Match):
+            n = self._node(s, [])
+            self._scan_reads(n, s.subject)
+            succs = []
+            for case in s.cases:
+                # pattern captures bind names (coarse: treated as defs
+                # at the head); guards read
+                for sub in ast.walk(case.pattern):
+                    name = getattr(sub, "name", None)
+                    if isinstance(name, str):
+                        self.defs[n].add(name)
+                if case.guard is not None:
+                    self._scan_reads(n, case.guard)
+                succs.append(self._seq(case.body, follow))
+            succs.append(follow)  # no case may match
+            self.succ[n] = succs
+            return n
+        if isinstance(s, ast.Break):
+            return self._node(s, [self._loops[-1][1] if self._loops else _EXIT])
+        if isinstance(s, ast.Continue):
+            return self._node(s, [self._loops[-1][0] if self._loops else _EXIT])
+        if isinstance(s, (ast.Return, ast.Raise)):
+            n = self._node(s, [_EXIT])
+            for v in (getattr(s, "value", None), getattr(s, "exc", None),
+                      getattr(s, "cause", None)):
+                if v is not None:
+                    self._scan_reads(n, v)
+            return n
+        # simple statement
+        n = self._node(s, [follow])
+        self._simple_facts(n, s)
+        return n
+
+    def _simple_facts(self, idx: int, s: ast.stmt) -> None:
+        if isinstance(s, ast.Assign):
+            self._scan_reads(idx, s.value)
+            for t in s.targets:
+                self._scan_store(idx, t)
+        elif isinstance(s, ast.AugAssign):
+            self._scan_reads(idx, s.value)
+            # the target is read THEN written
+            name = access_name(s.target)
+            if name is not None:
+                self.reads[idx].append((name, s.target))
+                self.defs[idx].add(name)
+                self._owner.setdefault(id(s.target), idx)
+            else:
+                self._scan_store(idx, s.target)
+        elif isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self._scan_reads(idx, s.value)
+                self._scan_store(idx, s.target)
+        elif isinstance(s, ast.Delete):
+            for t in s.targets:
+                name = access_name(t)
+                if name is not None:
+                    self.defs[idx].add(name)
+                else:
+                    self._scan_store(idx, t)
+        elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def closes over names: its body loads count as
+            # reads at the def site (conservative — the closure may run
+            # any time after); the def itself binds its name
+            self.defs[idx].add(s.name)
+            for sub in s.body:
+                self._scan_reads(idx, sub, loads_only=True)
+        elif isinstance(s, ast.ClassDef):
+            self.defs[idx].add(s.name)
+        elif isinstance(s, (ast.Import, ast.ImportFrom)):
+            for a in s.names:
+                self.defs[idx].add((a.asname or a.name).split(".")[0])
+        elif isinstance(s, (ast.Expr, ast.Assert)):
+            for v in ast.iter_child_nodes(s):
+                self._scan_reads(idx, v)
+
+    def _scan_reads(self, idx: int, expr: ast.AST, loads_only: bool = False) -> None:
+        """Record every Name/self-attr LOAD in ``expr`` as a read (and
+        walrus targets as defs)."""
+        for sub in ast.walk(expr):
+            self._owner.setdefault(id(sub), idx)
+            if isinstance(sub, ast.Name):
+                if sub.id == "self":
+                    continue
+                if isinstance(sub.ctx, ast.Load):
+                    self.reads[idx].append((sub.id, sub))
+                elif not loads_only and isinstance(sub.ctx, ast.Store):
+                    self.defs[idx].add(sub.id)  # walrus / comprehension
+            elif isinstance(sub, ast.Attribute):
+                name = access_name(sub)
+                if name is not None and isinstance(sub.ctx, ast.Load):
+                    self.reads[idx].append((name, sub))
+
+    def _scan_store(self, idx: int, target: ast.AST) -> None:
+        """Record assignment-target facts: a direct Name/self-attr is a
+        def (kill); storing THROUGH a subscript/attribute reads the
+        base (``x[i] = v`` uses buffer ``x``)."""
+        self._owner.setdefault(id(target), idx)
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._scan_store(idx, e)
+            return
+        if isinstance(target, ast.Starred):
+            self._scan_store(idx, target.value)
+            return
+        name = access_name(target)
+        if name is not None:
+            self.defs[idx].add(name)
+            return
+        # x[i] = v / obj.attr = v : the base object is READ (mutated)
+        if isinstance(target, (ast.Subscript, ast.Attribute)):
+            self._scan_reads(idx, target.value)
+
+    # ------------------------------------------------------------ query
+    def stmt_index(self, node: ast.AST) -> int | None:
+        """Index of the statement whose header/expressions contain
+        ``node`` (None for nodes in nested statements not yet scanned)."""
+        return self._owner.get(id(node))
+
+    def first_reads_after(
+        self, anchor: int, names: set[str]
+    ) -> dict[str, ast.AST]:
+        """For each name NOT rebound by the anchor statement itself:
+        the first read reachable on some path after the anchor, before
+        any rebinding on that path. Loop back edges count, so a
+        donate-in-a-loop without rebinding reports the next iteration's
+        use."""
+        out: dict[str, ast.AST] = {}
+        for name in names:
+            if name in self.defs[anchor]:
+                continue  # rebound by the anchor: nothing lives on
+            seen: set[int] = set()
+            stack = list(self.succ[anchor])
+            while stack:
+                i = stack.pop()
+                if i < 0 or i in seen:
+                    continue
+                seen.add(i)
+                hit = next(
+                    (nd for nm, nd in self.reads[i] if nm == name), None
+                )
+                if hit is not None:
+                    out[name] = hit
+                    break
+                if name in self.defs[i]:
+                    continue  # killed on this path
+                stack.extend(self.succ[i])
+        return out
+
+    def reads_in_stmt_outside(
+        self, anchor: int, call: ast.Call, names: set[str]
+    ) -> dict[str, ast.AST]:
+        """Reads of ``names`` in the anchor statement itself that are
+        OUTSIDE the given call's subtree — ``y = f(state) + state``
+        style same-statement use."""
+        inside = {id(n) for n in ast.walk(call)}
+        out: dict[str, ast.AST] = {}
+        for nm, nd in self.reads[anchor]:
+            if nm in names and id(nd) not in inside and nm not in out:
+                out[nm] = nd
+        return out
+
+
+# =====================================================================
+# JitBinding: names bound to jit-wrapped programs (+ donate/static info)
+# =====================================================================
+@dataclass(frozen=True)
+class JitBinding:
+    """One ``name = jax.jit(fn, ...)`` / ``@partial(jax.jit, ...)``
+    binding with the donation/static facts TL4xx/TL5xx key on."""
+
+    donate_nums: tuple[int, ...] = ()
+    donate_names: tuple[str, ...] = ()
+    static_nums: tuple[int, ...] = ()
+    static_names: tuple[str, ...] = ()
+    fn_node: ast.AST | None = None  # wrapped def/lambda when resolvable
+    line: int = 0
+    # jax.jit(self._chunk, ...) wraps a BOUND method: argument 0 at the
+    # call site is the method's SECOND parameter — position mapping
+    # must drop the leading `self`
+    bound_method: bool = False
+
+    @property
+    def donates(self) -> bool:
+        return bool(self.donate_nums or self.donate_names)
+
+
+def _const_ints(node: ast.AST) -> tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+def _const_strs(node: ast.AST) -> tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
+
+
+def _is_jit_func(mod: ModuleInfo, node: ast.AST) -> bool:
+    return resolve_call(mod, node) in _JIT_WRAPPERS
+
+
+def parse_jit_call(
+    mod: ModuleInfo, call: ast.Call, resolver=None
+) -> JitBinding | None:
+    """``jax.jit(f, donate_argnums=..., static_argnums=...)`` or
+    ``functools.partial(jax.jit, ...)`` (as a decorator factory) →
+    JitBinding; None when the call is not a jit wrap. ``resolver``
+    maps a bare function name to its def node (module top level, class
+    methods, or enclosing-scope locals — supplied by the caller)."""
+    if not isinstance(call, ast.Call):
+        return None
+    keywords = list(call.keywords)
+    wrapped: ast.AST | None = None
+    if _is_jit_func(mod, call.func):
+        if call.args:
+            wrapped = call.args[0]
+    elif (
+        resolve_call(mod, call.func) == "functools.partial"
+        and call.args
+        and _is_jit_func(mod, call.args[0])
+    ):
+        # partial(jax.jit, donate_argnums=...): the wrapped fn arrives
+        # later (decorator application); a second positional arg to the
+        # partial itself would be the fn
+        if len(call.args) > 1:
+            wrapped = call.args[1]
+    else:
+        return None
+    nums = names = snums = snames = ()
+    for kw in keywords:
+        if kw.arg == "donate_argnums":
+            nums = _const_ints(kw.value)
+        elif kw.arg == "donate_argnames":
+            names = _const_strs(kw.value)
+        elif kw.arg == "static_argnums":
+            snums = _const_ints(kw.value)
+        elif kw.arg == "static_argnames":
+            snames = _const_strs(kw.value)
+    fn_node: ast.AST | None = None
+    bound = False
+    if isinstance(wrapped, ast.Lambda):
+        fn_node = wrapped
+    elif isinstance(wrapped, ast.Name) and resolver is not None:
+        fn_node = resolver(wrapped.id)
+    elif resolver is not None:
+        # jax.jit(self._chunk, ...): resolve the bound method by name
+        wname = access_name(wrapped) if wrapped is not None else None
+        if wname is not None and wname.startswith(_SELF):
+            fn_node = resolver(wname[len(_SELF):])
+            bound = fn_node is not None
+    return JitBinding(
+        donate_nums=nums, donate_names=names,
+        static_nums=snums, static_names=snames,
+        fn_node=fn_node, line=call.lineno, bound_method=bound,
+    )
+
+
+def collect_jit_bindings(
+    mod: ModuleInfo,
+    stmts: list[ast.stmt],
+    resolver,
+    *,
+    self_prefix: bool = False,
+) -> dict[str, JitBinding]:
+    """Scan one scope's statements (module body, class body, or a
+    function body) for jit-program bindings:
+
+    - ``name = jax.jit(...)`` and ``self.attr = jax.jit(...)`` (the
+      latter keyed ``"self.attr"`` so method call sites resolve it),
+    - ``@jax.jit`` / ``@partial(jax.jit, donate_argnums=...)``
+      decorated defs (keyed by the def's name).
+    """
+    out: dict[str, JitBinding] = {}
+    # ONE scope only: walk this scope's statements without descending
+    # into nested function/class bodies — a function-local binding
+    # leaking into the module map would attribute one function's
+    # donation spec to every same-named call site in the file
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # the def BINDS its name in this scope (decorated form),
+            # but its body is a different scope
+            for dec in node.decorator_list:
+                b = None
+                if isinstance(dec, ast.Call):
+                    b = parse_jit_call(mod, dec, resolver)
+                elif _is_jit_func(mod, dec):
+                    b = JitBinding(line=node.lineno)
+                if b is not None:
+                    out[node.name] = JitBinding(
+                        donate_nums=b.donate_nums,
+                        donate_names=b.donate_names,
+                        static_nums=b.static_nums,
+                        static_names=b.static_names,
+                        fn_node=node, line=node.lineno,
+                    )
+                    break
+            continue
+        if isinstance(node, (ast.ClassDef, ast.Lambda)):
+            continue  # class fields arrive via class_jit_fields
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            b = parse_jit_call(mod, node.value, resolver)
+            if b is not None:
+                for t in node.targets:
+                    name = access_name(t)
+                    if name is not None:
+                        out[name] = b
+        stack.extend(ast.iter_child_nodes(node))
+    if self_prefix:
+        out = {
+            (k if k.startswith(_SELF) else _SELF + k): v
+            for k, v in out.items()
+        }
+    return out
+
+
+def fn_param_names(fn: ast.AST) -> list[str] | None:
+    """Positional parameter names of a def/lambda; None when the
+    signature is open-ended (*args)."""
+    args = getattr(fn, "args", None)
+    if args is None:
+        return None
+    if args.vararg is not None:
+        return None
+    return [a.arg for a in list(args.posonlyargs) + list(args.args)]
+
+
+def binding_params(binding: JitBinding) -> list[str] | None:
+    """The wrapped callable's positional params AS SEEN BY THE CALL
+    SITE: a bound method drops its leading ``self``."""
+    if binding.fn_node is None:
+        return None
+    params = fn_param_names(binding.fn_node)
+    if params is None:
+        return None
+    if binding.bound_method and params and params[0] == "self":
+        params = params[1:]
+    return params
+
+
+# =====================================================================
+# ClassUnit: per-hierarchy field/lock/call index
+# =====================================================================
+_LOCK_CTORS = {
+    "threading.Lock",
+    "threading.RLock",
+    "asyncio.Lock",
+    "multiprocessing.Lock",
+    "Lock",
+    "RLock",
+}
+# method calls that mutate the receiver: `self.q.append(x)` is a WRITE
+# to the field for lock-discipline purposes
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "extendleft", "add", "insert",
+    "remove", "discard", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "put", "put_nowait", "move_to_end", "sort", "reverse",
+}
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_TO_THREAD = {"asyncio.to_thread"}
+
+INIT_METHODS = {"__init__", "__post_init__", "__new__", "__set_name__"}
+
+
+@dataclass
+class FieldAccess:
+    mod: ModuleInfo
+    cls: str
+    method: str
+    attr: str
+    node: ast.AST
+    line: int
+    write: bool
+    locks: frozenset[str]  # lock attrs lexically held at the access
+
+
+@dataclass
+class CallSite:
+    caller: str
+    callee: str
+    locks: frozenset[str]
+    line: int
+
+
+@dataclass
+class ClassUnit:
+    """One class hierarchy (package-resolvable bases merged): methods
+    share ``self``, so field accesses, lock ownership, and the
+    self-call graph are all hierarchy-level facts."""
+
+    key: str  # representative dotted name (the root-most class seen)
+    class_names: list[str] = field(default_factory=list)
+    methods: dict[str, list[tuple[ModuleInfo, ast.AST]]] = field(
+        default_factory=dict
+    )
+    lock_attrs: set[str] = field(default_factory=set)
+    accesses: list[FieldAccess] = field(default_factory=list)
+    calls: list[CallSite] = field(default_factory=list)
+    thread_targets: set[str] = field(default_factory=set)
+    async_methods: set[str] = field(default_factory=set)
+    # self.attr -> [(mod, rhs expr)] for every `self.attr = <Call>` —
+    # the donation checker resolves `self._decode(...)` through this
+    field_rhs: dict[str, list[tuple[ModuleInfo, ast.expr]]] = field(
+        default_factory=dict
+    )
+    dynamic: bool = False  # __getattr__ / setattr(self, ...) anywhere
+
+    # ------------------------------------------------- derived (cached)
+    def callers_of(self) -> dict[str, list[CallSite]]:
+        out: dict[str, list[CallSite]] = {}
+        for c in self.calls:
+            out.setdefault(c.callee, []).append(c)
+        return out
+
+    def init_only_methods(self) -> set[str]:
+        """Private methods reachable ONLY from __init__-like methods —
+        they run before the object is shared, so unlocked accesses
+        there are pre-publication, not races."""
+        callers = self.callers_of()
+        init_only = set(INIT_METHODS)
+        changed = True
+        while changed:
+            changed = False
+            for m in self.methods:
+                if m in init_only or not m.startswith("_") or m.startswith("__"):
+                    continue
+                sites = callers.get(m, [])
+                if sites and all(c.caller in init_only for c in sites):
+                    init_only.add(m)
+                    changed = True
+        return init_only
+
+    def always_locked_methods(self) -> set[str]:
+        """Private methods whose EVERY in-unit call site either holds a
+        lock lexically, comes from another always-locked method, or
+        comes from an __init__-only context (pre-publication). Their
+        field accesses inherit lock protection."""
+        callers = self.callers_of()
+        init_only = self.init_only_methods()
+        locked = {
+            m for m in self.methods
+            if m.startswith("_") and not m.startswith("__")
+            and callers.get(m)
+        }
+        changed = True
+        while changed:
+            changed = False
+            for m in list(locked):
+                for site in callers.get(m, []):
+                    ok = (
+                        site.locks
+                        or site.caller in locked
+                        or site.caller in init_only
+                    )
+                    if not ok:
+                        locked.discard(m)
+                        changed = True
+                        break
+        return locked
+
+    def reachable_from(self, roots: set[str]) -> set[str]:
+        """Methods reachable from ``roots`` through the self-call
+        graph (roots included)."""
+        edges: dict[str, set[str]] = {}
+        for c in self.calls:
+            edges.setdefault(c.caller, set()).add(c.callee)
+        seen = set()
+        stack = [r for r in roots if r in self.methods]
+        while stack:
+            m = stack.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            stack.extend(e for e in edges.get(m, ()) if e in self.methods)
+        return seen
+
+
+def _base_keys(mod: ModuleInfo, cls: ast.ClassDef) -> list[str]:
+    from tensorlink_tpu.analysis.core import dotted_name
+
+    out = []
+    for b in cls.bases:
+        name = dotted_name(b)
+        if name is None:
+            continue
+        head, _, rest = name.partition(".")
+        if head in mod.from_imports:
+            src, orig = mod.from_imports[head]
+            out.append(f"{src}.{orig}" + (f".{rest}" if rest else ""))
+        elif head in mod.imports:
+            out.append(f"{mod.imports[head]}" + (f".{rest}" if rest else ""))
+        elif not rest:
+            out.append(f"{mod.dotted}.{name}")
+    return out
+
+
+def _lambda_self_calls(node: ast.AST) -> set[str]:
+    """Self-method names called inside a lambda/def passed as a thread
+    target (``run_in_executor(None, lambda: self.submit(ids))``)."""
+    out = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = access_name(sub.func)
+            if name is not None and name.startswith(_SELF):
+                out.add(name[len(_SELF):])
+    return out
+
+
+class _MethodScanner:
+    """One walk of one method body: field accesses with lexical lock
+    context, self-call edges, thread-target registration."""
+
+    def __init__(self, unit: ClassUnit, mod: ModuleInfo, cls: str,
+                 mname: str, fn: ast.AST):
+        self.unit, self.mod, self.cls, self.mname = unit, mod, cls, mname
+        self.writes: set[int] = set()
+        self._collect_write_ids(fn)
+        for stmt in fn.body:
+            self._walk(stmt, frozenset())
+
+    def _collect_write_ids(self, fn: ast.AST) -> None:
+        for node in ast.walk(fn):
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Delete):
+                targets = list(node.targets)
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr in _MUTATOR_METHODS
+                    and access_name(f.value) is not None
+                ):
+                    self.writes.add(id(f.value))
+            for t in targets:
+                self._mark_target(t)
+
+    def _mark_target(self, t: ast.expr) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._mark_target(e)
+        elif isinstance(t, ast.Starred):
+            self._mark_target(t.value)
+        elif access_name(t) is not None:
+            self.writes.add(id(t))
+        elif isinstance(t, (ast.Subscript, ast.Attribute)):
+            # self.q[k] = v mutates self.q
+            base = t.value
+            while isinstance(base, (ast.Subscript, ast.Attribute)):
+                if access_name(base) is not None:
+                    break
+                base = base.value
+            if access_name(base) is not None:
+                self.writes.add(id(base))
+
+    def _lockish(self, expr: ast.AST) -> str | None:
+        name = access_name(expr)
+        if name is None or not name.startswith(_SELF):
+            return None
+        attr = name[len(_SELF):]
+        if attr in self.unit.lock_attrs or "lock" in attr.lower():
+            return attr
+        return None
+
+    def _walk(self, node: ast.AST, held: frozenset[str]) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            newly = set()
+            for item in node.items:
+                lk = self._lockish(item.context_expr)
+                if lk is not None:
+                    newly.add(lk)
+                else:
+                    self._walk(item.context_expr, held)
+                if item.optional_vars is not None:
+                    self._walk(item.optional_vars, held)
+            inner = held | frozenset(newly)
+            for stmt in node.body:
+                self._walk(stmt, inner)
+            return
+        if isinstance(node, ast.Call):
+            self._scan_call(node, held)
+        if isinstance(node, ast.Attribute):
+            name = access_name(node)
+            if name is not None:
+                attr = name[len(_SELF):]
+                if attr not in self.unit.lock_attrs:
+                    self.unit.accesses.append(FieldAccess(
+                        mod=self.mod, cls=self.cls, method=self.mname,
+                        attr=attr, node=node, line=node.lineno,
+                        write=(
+                            id(node) in self.writes
+                            or isinstance(node.ctx, (ast.Store, ast.Del))
+                        ),
+                        locks=held,
+                    ))
+                return  # don't descend into the bare `self` Name
+        for child in ast.iter_child_nodes(node):
+            self._walk(child, held)
+
+    def _scan_call(self, node: ast.Call, held: frozenset[str]) -> None:
+        fname = access_name(node.func)
+        if fname is not None and fname.startswith(_SELF):
+            self.unit.calls.append(CallSite(
+                caller=self.mname, callee=fname[len(_SELF):],
+                locks=held, line=node.lineno,
+            ))
+        target_expr: ast.AST | None = None
+        resolved = resolve_call(self.mod, node.func)
+        if resolved in _THREAD_CTORS:
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target_expr = kw.value
+        elif resolved in _TO_THREAD and node.args:
+            target_expr = node.args[0]
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "run_in_executor"
+            and len(node.args) >= 2
+        ):
+            target_expr = node.args[1]
+        if target_expr is not None:
+            tname = access_name(target_expr)
+            if tname is not None and tname.startswith(_SELF):
+                self.unit.thread_targets.add(tname[len(_SELF):])
+            elif isinstance(target_expr, (ast.Lambda, ast.Name)):
+                self.unit.thread_targets.update(
+                    _lambda_self_calls(target_expr)
+                )
+        if isinstance(node.func, ast.Name) and node.func.id == "setattr":
+            if node.args and isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id == "self":
+                self.unit.dynamic = True
+
+
+def class_jit_fields(unit: ClassUnit) -> dict[str, JitBinding]:
+    """``self.attr = jax.jit(...)`` bindings anywhere in the unit,
+    keyed 'self.attr' — how `self._decode(...)` call sites resolve to
+    their donation/static spec. The wrapped-fn resolver searches the
+    unit's methods (``jax.jit(self._step, ...)`` style)."""
+    out: dict[str, JitBinding] = {}
+
+    def resolver(name: str):
+        refs = unit.methods.get(name)
+        return refs[0][1] if refs else None
+
+    for attr, rhss in unit.field_rhs.items():
+        for rmod, rhs in rhss:
+            b = parse_jit_call(rmod, rhs, resolver)
+            if b is not None:
+                out[_SELF + attr] = b
+    return out
+
+
+def iter_class_jit_bindings(index: PackageIndex):
+    """Yield (defining module, 'self.attr', JitBinding) for every
+    class-field jit binding in the package — the donation range check
+    walks these (class bodies are skipped by the scope-local
+    collect_jit_bindings)."""
+    for unit in class_units(index):
+        def resolver(name: str, _u=unit):
+            refs = _u.methods.get(name)
+            return refs[0][1] if refs else None
+
+        for attr, rhss in unit.field_rhs.items():
+            for rmod, rhs in rhss:
+                b = parse_jit_call(rmod, rhs, resolver)
+                if b is not None:
+                    yield rmod, _SELF + attr, b
+
+
+def jit_fields_by_fn(index: PackageIndex) -> dict[int, dict[str, JitBinding]]:
+    """id(method ast node) -> that method's class-level 'self.attr'
+    jit-binding map. Memoized per index; donation and retrace share
+    one build."""
+    cached = getattr(index, "_jit_fields_cache", None)
+    if cached is not None:
+        return cached
+    out: dict[int, dict[str, JitBinding]] = {}
+    for unit in class_units(index):
+        fields: dict[str, JitBinding] | None = None
+        for refs in unit.methods.values():
+            for _umod, fn in refs:
+                if fields is None:
+                    fields = class_jit_fields(unit)
+                out[id(fn)] = fields
+    index._jit_fields_cache = out
+    return out
+
+
+def class_units(index: PackageIndex) -> list[ClassUnit]:
+    """Build the per-hierarchy field/lock/call indexes for every
+    top-level class in the package, merging classes connected through
+    package-resolvable bases into one unit. Memoized per index — the
+    three TL4xx/5xx/6xx families share one build."""
+    cached = getattr(index, "_class_units_cache", None)
+    if cached is not None:
+        return cached
+    units = _build_class_units(index)
+    index._class_units_cache = units
+    return units
+
+
+def _build_class_units(index: PackageIndex) -> list[ClassUnit]:
+    raw: dict[str, tuple[ModuleInfo, ast.ClassDef]] = {}
+    bases: dict[str, list[str]] = {}
+    for mod in index.modules:
+        for node in mod.tree.body:
+            if isinstance(node, ast.ClassDef):
+                key = f"{mod.dotted}.{node.name}"
+                raw[key] = (mod, node)
+                bases[key] = [b for b in _base_keys(mod, node)]
+
+    # union-find over in-package inheritance edges
+    parent = {k: k for k in raw}
+
+    def find(k: str) -> str:
+        while parent[k] != k:
+            parent[k] = parent[parent[k]]
+            k = parent[k]
+        return k
+
+    for k, bs in bases.items():
+        for b in bs:
+            if b in raw:
+                ra, rb = find(k), find(b)
+                if ra != rb:
+                    parent[ra] = rb
+
+    units: dict[str, ClassUnit] = {}
+    for key, (mod, cls) in raw.items():
+        root = find(key)
+        unit = units.setdefault(root, ClassUnit(key=root))
+        unit.class_names.append(cls.name)
+        # pass 1: method table, lock fields, field rhs, dynamic surface
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                unit.methods.setdefault(stmt.name, []).append((mod, stmt))
+                if isinstance(stmt, ast.AsyncFunctionDef):
+                    unit.async_methods.add(stmt.name)
+                if stmt.name in ("__getattr__", "__setattr__"):
+                    unit.dynamic = True
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                tgt_names = [access_name(t) for t in node.targets]
+                callee = resolve_call(mod, node.value.func)
+                for name in tgt_names:
+                    if name is None or not name.startswith(_SELF):
+                        continue
+                    attr = name[len(_SELF):]
+                    if callee in _LOCK_CTORS:
+                        unit.lock_attrs.add(attr)
+                    unit.field_rhs.setdefault(attr, []).append(
+                        (mod, node.value)
+                    )
+
+    # pass 2: accesses + call edges (lock_attrs must be complete first)
+    for key, (mod, cls) in raw.items():
+        unit = units[find(key)]
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _MethodScanner(unit, mod, cls.name, stmt.name, stmt)
+    return list(units.values())

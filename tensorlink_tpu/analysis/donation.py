@@ -1,0 +1,260 @@
+"""Donation safety (TL4xx): buffers handed to jit via donate_argnums.
+
+The serving engines donate the ENTIRE serving state to every decode /
+spec / prefill program (``jax.jit(chunk, donate_argnums=...)``): XLA
+reuses the input buffers for outputs, so the Python-side array object
+is invalidated the moment the call dispatches. Reading it afterwards
+returns garbage (or raises on newer jax) — and nothing in Python warns
+at the write site. These rules use the dataflow layer
+(:mod:`~tensorlink_tpu.analysis.dataflow`) to prove a donated value is
+dead after the donating call on every path.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tensorlink_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    PackageIndex,
+    checker,
+)
+from tensorlink_tpu.analysis.dataflow import (
+    FuncFlow,
+    JitBinding,
+    access_name,
+    binding_params,
+    collect_jit_bindings,
+    iter_class_jit_bindings,
+    iter_functions,
+    iter_own_nodes,
+    jit_fields_by_fn,
+    module_defs,
+    parse_jit_call,
+)
+
+_RULES = {
+    "TL401": (
+        "Value read after being donated to a jitted call.\n\n"
+        "An argument in a `donate_argnums`/`donate_argnames` position is\n"
+        "CONSUMED by the call: XLA reuses its buffer for the outputs, so\n"
+        "the Python-side array is invalidated the moment the program\n"
+        "dispatches. Reading, returning, or storing it afterwards (on any\n"
+        "path, including the next loop iteration) yields garbage or a\n"
+        "deleted-buffer error. Rebind the result instead:\n"
+        "`state = donated_fn(state)` — the rebound name is safe."
+    ),
+    "TL402": (
+        "donate_argnums/donate_argnames out of range for the wrapped\n"
+        "function.\n\n"
+        "A donate index past the wrapped function's positional parameters\n"
+        "(or a donate name it does not declare) either raises at trace\n"
+        "time or — on older jax — silently donates NOTHING, so the\n"
+        "program copies the state every call and the in-place-update\n"
+        "memory model the caller assumes is quietly gone."
+    ),
+    "TL403": (
+        "Alias of a donated value still live after the donating call.\n\n"
+        "`a = x; f_donated(x); use(a)` — `a` and `x` are the SAME buffer;\n"
+        "donating through either name invalidates both. The alias read\n"
+        "returns garbage exactly like reading the donated name itself.\n"
+        "Drop the alias before the call, or copy (`jnp.array(x)`) if a\n"
+        "live second reference is genuinely needed."
+    ),
+}
+
+
+def _local_defs(fn: ast.AST) -> dict[str, ast.AST]:
+    return {
+        n.name: n for n in fn.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _donated_args(
+    call: ast.Call, binding: JitBinding
+) -> list[tuple[str, ast.expr]]:
+    """(description, expr) for each resolvable donated argument of this
+    call site. Positions hidden behind *args unpacking are skipped —
+    the donated expr is not visible at the call site."""
+    out: list[tuple[str, ast.expr]] = []
+    starred_at = next(
+        (i for i, a in enumerate(call.args) if isinstance(a, ast.Starred)),
+        None,
+    )
+    positions = set(binding.donate_nums)
+    params = binding_params(binding)
+    if params:
+        for nm in binding.donate_names:
+            if nm in params:
+                positions.add(params.index(nm))
+    for i in sorted(positions):
+        if starred_at is not None and i >= starred_at:
+            continue
+        if i < len(call.args):
+            out.append((f"argument {i}", call.args[i]))
+    donate_names = set(binding.donate_names)
+    for kw in call.keywords:
+        if kw.arg in donate_names:
+            out.append((f"argument `{kw.arg}`", kw.value))
+    return out
+
+
+def _aliases_before(fn: ast.AST, name: str, line: int) -> set[str]:
+    """Names copy-assigned to/from ``name`` before ``line`` (simple
+    `a = x` / `x = a` pairs only — no container alias analysis). A
+    reassignment of EITHER side between the copy and the call breaks
+    the alias (one of them no longer references the donated buffer)."""
+    assigns: list[tuple[int, str]] = []
+    copies: list[tuple[int, str]] = []  # (copy line, alias name)
+    for node in iter_own_nodes(fn):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for t in targets:
+            tname = access_name(t)
+            if tname is not None:
+                assigns.append((node.lineno, tname))
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = access_name(node.targets[0])
+            src = access_name(node.value)
+            if tgt is None or src is None or node.lineno >= line:
+                continue
+            if src == name and tgt != name:
+                copies.append((node.lineno, tgt))
+            elif tgt == name and src != name:
+                copies.append((node.lineno, src))
+    out: set[str] = set()
+    for copy_line, alias in copies:
+        broken = any(
+            copy_line < ln < line and tname in (alias, name)
+            for ln, tname in assigns
+        )
+        if not broken:
+            out.add(alias)
+    return out
+
+
+def _check_binding_ranges(
+    mod: ModuleInfo, bindings: dict[str, JitBinding], out: list,
+    seen: set,
+) -> None:
+    for key, b in bindings.items():
+        if b.fn_node is None or not b.donates:
+            continue
+        params = binding_params(b)
+        if params is None:
+            continue  # *args: any index is reachable
+        sig = (mod.path, b.line)
+        if sig in seen:
+            continue
+        seen.add(sig)
+        for i in b.donate_nums:
+            if i >= len(params) or i < -len(params):
+                out.append(Finding(
+                    "TL402", mod.path, b.line,
+                    f"donate_argnums index {i} is out of range for the "
+                    f"wrapped function ({len(params)} positional "
+                    "parameters) — nothing is donated",
+                    symbol=f"{key}.donate{i}",
+                ))
+        for nm in b.donate_names:
+            if nm not in params and b.fn_node.args.kwarg is None:
+                out.append(Finding(
+                    "TL402", mod.path, b.line,
+                    f"donate_argnames {nm!r} is not a parameter of the "
+                    "wrapped function — nothing is donated",
+                    symbol=f"{key}.donate.{nm}",
+                ))
+
+
+def _check_function(
+    mod: ModuleInfo,
+    fn: ast.AST,
+    bindings: dict[str, JitBinding],
+    out: list,
+    range_seen: set,
+) -> None:
+    local = collect_jit_bindings(
+        mod, fn.body,
+        resolver=lambda n, _l=_local_defs(fn), _m=module_defs(mod): (
+            _l.get(n) or _m.get(n)
+        ),
+    )
+    _check_binding_ranges(mod, local, out, range_seen)
+    scope = {**bindings, **local}
+    flow: FuncFlow | None = None
+    fname = getattr(fn, "name", "<lambda>")
+
+    for node in iter_own_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        key = access_name(node.func)
+        binding = scope.get(key) if key is not None else None
+        if binding is None and isinstance(node.func, ast.Call):
+            # immediate application: jax.jit(f, donate_argnums=(0,))(x)
+            binding = parse_jit_call(
+                mod, node.func,
+                resolver=lambda n, _m=module_defs(mod): _m.get(n),
+            )
+            key = "<jit>"
+        if binding is None or not binding.donates:
+            continue
+        donated = _donated_args(node, binding)
+        if not donated:
+            continue
+        if flow is None:
+            flow = FuncFlow(fn)
+        anchor = flow.stmt_index(node)
+        if anchor is None:
+            continue
+        for desc, expr in donated:
+            name = access_name(expr)
+            if name is None:
+                continue
+            hits = flow.reads_in_stmt_outside(anchor, node, {name})
+            hits.update(flow.first_reads_after(anchor, {name}))
+            for nm, rd in hits.items():
+                out.append(Finding(
+                    "TL401", mod.path, rd.lineno,
+                    f"`{nm}` is read after being donated to `{key}` "
+                    f"(line {node.lineno} {desc}) — the buffer is "
+                    "invalidated by the call; rebind the result instead",
+                    symbol=f"{fname}.{nm}@{key}",
+                ))
+            # aliases of a donated plain name stay live-but-invalid
+            aliases = _aliases_before(fn, name, node.lineno)
+            if aliases:
+                ahits = flow.reads_in_stmt_outside(anchor, node, aliases)
+                ahits.update(flow.first_reads_after(anchor, aliases))
+                for nm, rd in ahits.items():
+                    out.append(Finding(
+                        "TL403", mod.path, rd.lineno,
+                        f"`{nm}` aliases `{name}`, which was donated to "
+                        f"`{key}` (line {node.lineno}) — both names "
+                        "reference the invalidated buffer",
+                        symbol=f"{fname}.{nm}~{name}@{key}",
+                    ))
+
+
+@checker("donation", _RULES)
+def check(index: PackageIndex) -> list[Finding]:
+    out: list[Finding] = []
+    range_seen: set = set()
+    class_of_fn = jit_fields_by_fn(index)
+    for rmod, key, b in iter_class_jit_bindings(index):
+        _check_binding_ranges(rmod, {key: b}, out, range_seen)
+    for mod in index.modules:
+        module_bindings = collect_jit_bindings(
+            mod, mod.tree.body,
+            resolver=lambda n, _m=module_defs(mod): _m.get(n),
+        )
+        _check_binding_ranges(mod, module_bindings, out, range_seen)
+        for fn in iter_functions(mod):
+            scope = dict(module_bindings)
+            scope.update(class_of_fn.get(id(fn), {}))
+            _check_function(mod, fn, scope, out, range_seen)
+    return out

@@ -1,0 +1,147 @@
+"""`tlint --fix`: autofixes for the mechanical rules.
+
+Two fix classes, both chosen because the rewrite is provably
+behavior-preserving at the AST level (no judgment calls — those stay
+human):
+
+- **TL103**: ``<mod>.get_event_loop()`` -> ``<mod>.get_running_loop()``
+  when the call resolves to ``asyncio.get_event_loop`` through the
+  module's imports. Only the attribute form is rewritten — fixing the
+  ``from asyncio import get_event_loop`` name form would also have to
+  rewrite the import, which is not a single-token edit.
+- **Stale suppressions**: a ``# tlint: disable=...`` comment on a line
+  where none of the named rules (or, for a blanket disable, NO rule at
+  all) currently fires suppresses nothing — it is dead weight that
+  hides future regressions on that line. The comment is removed; text
+  before it on the line survives.
+
+Fixes are idempotent: a second ``--fix`` pass finds nothing to edit
+(pinned by test).
+"""
+
+from __future__ import annotations
+
+import ast
+import tokenize
+from dataclasses import dataclass
+from io import StringIO
+
+from tensorlink_tpu.analysis.core import (
+    ModuleInfo,
+    PackageIndex,
+    resolve_call,
+    run_analysis,
+)
+
+
+@dataclass
+class Edit:
+    line: int  # 1-based
+    col: int  # 0-based start
+    end_col: int
+    replacement: str
+    note: str
+
+
+def _tl103_edits(mod: ModuleInfo) -> list[Edit]:
+    out: list[Edit] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not isinstance(fn, ast.Attribute) or fn.attr != "get_event_loop":
+            continue
+        if resolve_call(mod, fn) != "asyncio.get_event_loop":
+            continue
+        if mod.suppressed("TL103", node.lineno):
+            continue  # an explicit disable opts the line out of fixing
+        if fn.end_lineno != fn.lineno:
+            continue  # attribute split across lines: leave it to a human
+        # the attr token is the tail of the func span
+        start = fn.end_col_offset - len("get_event_loop")
+        out.append(Edit(
+            line=fn.lineno, col=start, end_col=fn.end_col_offset,
+            replacement="get_running_loop",
+            note="TL103 get_event_loop -> get_running_loop",
+        ))
+    return out
+
+
+def _stale_disable_edits(
+    mod: ModuleInfo, raw_lines: dict[int, set[str]]
+) -> list[Edit]:
+    """Remove disable comments whose line has no matching raw finding.
+
+    ``raw_lines``: line -> rule ids that fire there BEFORE suppression.
+    """
+    out: list[Edit] = []
+    comment_spans: dict[int, tuple[int, int]] = {}
+    try:
+        for tok in tokenize.generate_tokens(StringIO(mod.source).readline):
+            if tok.type == tokenize.COMMENT and "tlint:" in tok.string:
+                comment_spans[tok.start[0]] = (tok.start[1], tok.end[1])
+    except tokenize.TokenizeError:  # pragma: no cover - parse already passed
+        return out
+    for line, rules in mod.disabled.items():
+        span = comment_spans.get(line)
+        if span is None:
+            continue
+        firing = raw_lines.get(line, set())
+        live = (rules & firing) if rules else firing
+        if live:
+            continue
+        out.append(Edit(
+            line=line, col=span[0], end_col=span[1], replacement="",
+            note=(
+                "stale disable ("
+                + (",".join(sorted(rules)) if rules else "blanket")
+                + ") suppresses nothing"
+            ),
+        ))
+    return out
+
+
+def _apply(source: str, edits: list[Edit]) -> str:
+    lines = source.splitlines(keepends=True)
+    for e in sorted(edits, key=lambda e: (e.line, e.col), reverse=True):
+        ln = lines[e.line - 1]
+        new = ln[: e.col] + e.replacement + ln[e.end_col:]
+        if e.replacement == "":
+            # removing a trailing comment: strip the gap it leaves
+            body = new.rstrip()
+            tail = ln[len(ln.rstrip("\r\n")):]  # original newline
+            new = (body + tail) if body.strip() else tail
+        lines[e.line - 1] = new
+    return "".join(lines)
+
+
+def apply_fixes(index: PackageIndex) -> dict[str, list[str]]:
+    """Compute and write every available autofix; returns
+    {filesystem path: [human-readable notes]} for the files edited.
+    Only files with a known filesystem path (from_paths indexes) are
+    touched. Staleness is judged against EVERY family's raw findings
+    regardless of any --family selection — a disable comment for a
+    family that merely didn't run this invocation is load-bearing,
+    not stale."""
+    raw = run_analysis(index, apply_disables=False)
+    raw_by_mod: dict[str, dict[int, set[str]]] = {}
+    for f in raw:
+        raw_by_mod.setdefault(f.path, {}).setdefault(f.line, set()).add(f.rule)
+    edited: dict[str, list[str]] = {}
+    for mod in index.modules:
+        fs = index.fs_paths.get(mod.path)
+        if fs is None:
+            continue
+        edits = _tl103_edits(mod)
+        edits += _stale_disable_edits(mod, raw_by_mod.get(mod.path, {}))
+        if not edits:
+            continue
+        new_src = _apply(mod.source, edits)
+        if new_src == mod.source:
+            continue
+        # never write anything that stopped parsing
+        ast.parse(new_src)
+        with open(fs, "w", encoding="utf-8") as fh:
+            fh.write(new_src)
+        edited[fs] = [f"{mod.path}:{e.line}: {e.note}" for e in edits]
+    return edited

@@ -1,0 +1,157 @@
+"""Thread/lock discipline (TL6xx): lock-skew and unprotected sharing.
+
+The schedulers are exactly the shape these rules target: a
+``threading.Lock``-owning class whose ``step()``/``submit()`` mutate
+slot tables under the lock, with worker threads (``run_in_executor``
+pumps), asyncio handlers, and stats endpoints all touching the same
+fields. PR 5 fixed one of these by hand (the ``_finish`` /
+``_admit_or_queue`` scheduler race); these rules make the class
+structural: every field written under a class's lock must be read
+under it too, and thread-entry bodies must not share unlocked state
+with async handlers.
+
+Built on the dataflow layer's per-hierarchy index: lexical ``with
+self._lock:`` tracking, plus the self-call graph so a private helper
+called ONLY from under-lock contexts counts as protected
+(``_finish`` called from ``step()`` inside the lock needs no lock of
+its own), and ``__init__``-only helpers count as pre-publication.
+"""
+
+from __future__ import annotations
+
+from tensorlink_tpu.analysis.core import Finding, PackageIndex, checker
+from tensorlink_tpu.analysis.dataflow import (
+    INIT_METHODS,
+    ClassUnit,
+    class_units,
+)
+
+_RULES = {
+    "TL601": (
+        "Field written under the class lock in one method, accessed\n"
+        "without it in another.\n\n"
+        "A field the class protects with `with self._lock:` somewhere is\n"
+        "part of the lock's invariant EVERYWHERE: an unlocked read sees\n"
+        "torn multi-field state (a slot freed but its request still\n"
+        "mapped), and an unlocked write races the locked ones. Either\n"
+        "take the lock at the flagged site, or — if the access is\n"
+        "genuinely safe (pre-publication, single-threaded phase, atomic\n"
+        "snapshot-by-GIL) — baseline it with a justification.\n\n"
+        "Call-graph aware: a private method whose every call site holds\n"
+        "the lock inherits protection; methods reachable only from\n"
+        "__init__ are pre-publication and exempt."
+    ),
+    "TL602": (
+        "State shared between a thread body and async handlers with no\n"
+        "lock at all.\n\n"
+        "A `threading.Thread(target=self._loop)` body (or a method pushed\n"
+        "through `asyncio.to_thread`/`run_in_executor`) runs concurrently\n"
+        "with the event loop's handlers; a field both sides touch with no\n"
+        "lock anywhere is the PR-5 scheduler-race class: lost updates,\n"
+        "double admission, torn slot state. Give the class a\n"
+        "`threading.Lock` and hold it on both sides (asyncio handlers may\n"
+        "hold it briefly), or confine the field to one side and pass\n"
+        "messages."
+    ),
+}
+
+
+def _check_lock_skew(unit: ClassUnit, out: list) -> None:
+    # NOTE: a dynamic surface (setattr/__getattr__) does NOT gate these
+    # rules — unlike api-existence, every OBSERVED access is real; the
+    # dynamic fields are simply invisible (under-approximation).
+    if not unit.lock_attrs:
+        return
+    init_only = unit.init_only_methods()
+    always_locked = unit.always_locked_methods()
+    exempt = init_only | INIT_METHODS | {"__del__", "__repr__"}
+    by_attr: dict[str, list] = {}
+    for a in unit.accesses:
+        if a.attr in unit.methods or a.attr.startswith("__"):
+            continue
+        by_attr.setdefault(a.attr, []).append(a)
+    for attr, accs in sorted(by_attr.items()):
+        locked_writes = [
+            a for a in accs
+            if a.write and a.method not in exempt
+            and (a.locks or a.method in always_locked)
+        ]
+        if not locked_writes:
+            continue
+        unprotected = [
+            a for a in accs
+            if not a.locks
+            and a.method not in always_locked
+            and a.method not in exempt
+        ]
+        if not unprotected:
+            continue
+        lock = next(
+            (next(iter(a.locks)) for a in locked_writes if a.locks),
+            next(iter(unit.lock_attrs)),
+        )
+        seen_methods: set[str] = set()
+        for a in unprotected:
+            if a.method in seen_methods:
+                continue
+            seen_methods.add(a.method)
+            w = locked_writes[0]
+            out.append(Finding(
+                "TL601", a.mod.path, a.line,
+                f"`self.{attr}` is {'written' if a.write else 'read'} "
+                f"without `self.{lock}` in `{a.cls}.{a.method}` but "
+                f"written under it in `{w.cls}.{w.method}` — torn "
+                "state/lost updates; take the lock or baseline with "
+                "justification",
+                symbol=f"{a.cls}.{attr}@{a.method}",
+            ))
+
+
+def _check_thread_async_share(unit: ClassUnit, out: list) -> None:
+    if not unit.thread_targets or not unit.async_methods:
+        return
+    init_only = unit.init_only_methods()
+    always_locked = unit.always_locked_methods()
+    exempt = init_only | INIT_METHODS
+    thread_side = unit.reachable_from(unit.thread_targets)
+    async_side = unit.reachable_from(unit.async_methods)
+    by_attr: dict[str, list] = {}
+    for a in unit.accesses:
+        if a.attr in unit.methods or a.attr.startswith("__"):
+            continue
+        if a.method in exempt:
+            continue
+        by_attr.setdefault(a.attr, []).append(a)
+    for attr, accs in sorted(by_attr.items()):
+        t_acc = [a for a in accs if a.method in thread_side]
+        a_acc = [a for a in accs if a.method in async_side]
+        if not t_acc or not a_acc:
+            continue
+        if not any(x.write for x in t_acc + a_acc):
+            continue
+        # "no lock at all": one protected access anywhere means the
+        # class has a locking story for this field — TL601's business
+        if any(
+            x.locks or x.method in always_locked
+            for x in accs
+        ):
+            continue
+        w = next((x for x in t_acc if x.write), t_acc[0])
+        a0 = a_acc[0]
+        out.append(Finding(
+            "TL602", w.mod.path, w.line,
+            f"`self.{attr}` is shared between thread-entry "
+            f"`{w.cls}.{w.method}` and async `{a0.cls}.{a0.method}` "
+            "with no lock anywhere — lost-update race; add a "
+            "threading.Lock held on both sides",
+            symbol=f"{w.cls}.{attr}.thread_async",
+        ))
+
+
+@checker("lock_discipline", _RULES)
+def check(index: PackageIndex) -> list[Finding]:
+    out: list[Finding] = []
+    for unit in class_units(index):
+        _check_lock_skew(unit, out)
+        _check_thread_async_share(unit, out)
+    return out

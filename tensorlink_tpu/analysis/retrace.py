@@ -1,0 +1,281 @@
+"""Retrace hazards (TL5xx): per-call shapes, dynamic statics, cache resets.
+
+A jitted program retraces for every new (shape, dtype, static-value)
+signature. The serving stack keeps its program count FIXED by bucketing
+prompt lengths (``_bucket``-style round-up helpers) and AOT-compiling
+the bucket set; one call site that shapes an argument from a raw
+per-request value (``len(prompt)``, an unbucketed slice) silently turns
+cold-start compile cost into a per-request tax — the exact failure the
+persistent compile cache (ROADMAP item 5) exists to kill. These rules
+use the def-use layer to follow per-call Python values into jitted
+call sites.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tensorlink_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    PackageIndex,
+    checker,
+    dotted_name,
+    resolve_call,
+)
+from tensorlink_tpu.analysis.dataflow import (
+    JitBinding,
+    access_name,
+    binding_params,
+    collect_jit_bindings,
+    iter_functions,
+    iter_own_nodes,
+    jit_fields_by_fn,
+    module_defs,
+)
+
+_RULES = {
+    "TL501": (
+        "Jitted-call argument shape derived from a per-call Python value.\n\n"
+        "An argument sliced or allocated by a raw per-call value\n"
+        "(`len(prompt)`, `.size`, an unbucketed bound) gives the jitted\n"
+        "callee a FRESH shape signature per distinct value — every new\n"
+        "prompt length recompiles the program (seconds of TTFT, unbounded\n"
+        "compile-cache growth). Round the value through a bucket helper\n"
+        "(`_bucket`, a power-of-two round-up) so the program count stays\n"
+        "bounded by the bucket set."
+    ),
+    "TL502": (
+        "Per-call value flowing into a static_argnums/static_argnames\n"
+        "position.\n\n"
+        "Static arguments key the compile cache BY VALUE: a `len(...)`-\n"
+        "derived scalar or formatted string in a static position compiles\n"
+        "one program per distinct value. Pass data as a traced argument,\n"
+        "or bucket the value first if it genuinely must be static."
+    ),
+    "TL503": (
+        "jax.clear_caches() outside the sanctioned tuning sites.\n\n"
+        "Clearing the compile cache throws away EVERY compiled program in\n"
+        "the process — serving engines re-pay full compile latency on the\n"
+        "next dispatch of every bucket, decode chunk, and spec program.\n"
+        "The only sanctioned sites are the flash-block tuning overrides\n"
+        "(ops/flash.py), which must retrace to bake new block sizes in and\n"
+        "carry an inline `# tlint: disable=TL503` with justification. Add\n"
+        "new sites only with the same explicit justification."
+    ),
+}
+
+# a per-call value laundered through one of these is considered
+# bucketed (bounded cardinality), not a retrace source
+_LAUNDER_TOKENS = ("bucket", "round", "pad_to", "align", "pow2", "next_power")
+_ARRAY_CTORS = {"zeros", "ones", "full", "empty", "arange"}
+_DYNAMIC_ATTRS = {"size", "shape", "nbytes"}
+_CACHE_CLEARERS = {"jax.clear_caches", "jax.clear_backends"}
+
+
+def _is_laundering_call(node: ast.Call) -> bool:
+    name = dotted_name(node.func) or ""
+    tail = name.split(".")[-1].lower()
+    return any(tok in tail for tok in _LAUNDER_TOKENS)
+
+
+def _dynamic_source(node: ast.AST, dynamic: set[str]) -> str | None:
+    """Does this expression subtree carry a raw per-call value? Returns
+    a short description of the source, or None. A laundering
+    (bucket/round-up) call anywhere in the subtree clears the taint —
+    the value's cardinality is bounded by the bucket set."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and _is_laundering_call(sub):
+            return None
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id == "len":
+            return "len(...)"
+        if isinstance(sub, ast.Attribute) and sub.attr in _DYNAMIC_ATTRS \
+                and isinstance(sub.ctx, ast.Load):
+            return f".{sub.attr}"
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) \
+                and sub.id in dynamic:
+            return f"`{sub.id}`"
+    return None
+
+
+def _dynamic_names(fn: ast.AST) -> set[str]:
+    """Names assigned from raw per-call length values (`n = len(p)`,
+    `t0 = ids.size`, arithmetic over either), in statement order with
+    one-level propagation. Laundering kills the taint at the def."""
+    dyn: set[str] = set()
+    stmts = sorted(
+        (
+            n for n in iter_own_nodes(fn)
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign))
+        ),
+        key=lambda n: n.lineno,
+    )
+    for node in stmts:
+        value = node.value
+        if value is None:
+            continue
+        src = _dynamic_source(value, dyn)
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        for t in targets:
+            name = access_name(t)
+            if name is None or "." in name:
+                continue
+            if src is not None:
+                dyn.add(name)
+            else:
+                dyn.discard(name)  # laundered/static rebind clears it
+    return dyn
+
+
+def _last_assign_before(fn: ast.AST, name: str, line: int) -> ast.expr | None:
+    best: ast.expr | None = None
+    best_line = -1
+    for node in iter_own_nodes(fn):
+        if isinstance(node, ast.Assign) and best_line < node.lineno < line:
+            for t in node.targets:
+                if access_name(t) == name:
+                    best, best_line = node.value, node.lineno
+    return best
+
+
+def _shape_taint(
+    fn: ast.AST, expr: ast.expr, dynamic: set[str], line: int
+) -> str | None:
+    """Is this call argument SHAPED by a per-call value — an unbucketed
+    slice bound or an array-constructor extent? (A dynamic value used
+    as array CONTENT is fine: it becomes a traced scalar.)"""
+    exprs = [expr]
+    name = access_name(expr)
+    if name is not None and "." not in name:
+        prev = _last_assign_before(fn, name, line)
+        if prev is not None:
+            exprs.append(prev)
+    for e in exprs:
+        for sub in ast.walk(e):
+            if isinstance(sub, ast.Subscript) and isinstance(sub.ctx, ast.Load):
+                src = _dynamic_source(sub.slice, dynamic)
+                if src is not None:
+                    return f"slice bound from {src}"
+            elif isinstance(sub, ast.Call):
+                tail = (dotted_name(sub.func) or "").split(".")[-1]
+                if tail in _ARRAY_CTORS and sub.args:
+                    src = _dynamic_source(sub.args[0], dynamic)
+                    if src is not None:
+                        return f"`{tail}` extent from {src}"
+    return None
+
+
+def _static_positions(binding: JitBinding) -> tuple[set[int], set[str]]:
+    nums = set(binding.static_nums)
+    names = set(binding.static_names)
+    params = binding_params(binding)
+    if params:
+        for nm in list(names):
+            if nm in params:
+                nums.add(params.index(nm))
+    return nums, names
+
+
+def _check_function(
+    mod: ModuleInfo,
+    fn: ast.AST,
+    bindings: dict[str, JitBinding],
+    out: list,
+) -> None:
+    local = collect_jit_bindings(
+        mod, fn.body,
+        resolver=lambda n, _m=module_defs(mod): _m.get(n),
+    )
+    scope = {**bindings, **local}
+    dynamic: set[str] | None = None
+    fname = getattr(fn, "name", "<lambda>")
+    for node in iter_own_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        key = access_name(node.func)
+        binding = scope.get(key) if key is not None else None
+        if binding is None:
+            continue
+        if dynamic is None:
+            dynamic = _dynamic_names(fn)
+        # TL501: shape taint on any argument
+        for i, arg in enumerate(node.args):
+            if isinstance(arg, ast.Starred):
+                continue
+            taint = _shape_taint(fn, arg, dynamic, node.lineno)
+            if taint is not None:
+                out.append(Finding(
+                    "TL501", mod.path, node.lineno,
+                    f"argument {i} of jitted `{key}` is shaped by a "
+                    f"per-call value ({taint}) — every distinct value "
+                    "retraces; round it through a bucket helper",
+                    symbol=f"{fname}.{key}.arg{i}",
+                ))
+        # TL502: dynamic value in a static position
+        snums, snames = _static_positions(binding)
+        static_args = [
+            (f"static arg {i}", node.args[i])
+            for i in snums
+            if i < len(node.args)
+            and not isinstance(node.args[i], ast.Starred)
+        ]
+        static_args += [
+            (f"static arg `{kw.arg}`", kw.value)
+            for kw in node.keywords if kw.arg in snames
+        ]
+        for desc, expr in static_args:
+            if isinstance(expr, ast.JoinedStr):
+                src = "an f-string"
+            else:
+                src = _dynamic_source(expr, dynamic)
+            if src is not None:
+                out.append(Finding(
+                    "TL502", mod.path, expr.lineno,
+                    f"{desc} of jitted `{key}` comes from a per-call "
+                    f"value ({src}) — static args key the compile cache "
+                    "by value, so every distinct value compiles a new "
+                    "program",
+                    symbol=f"{fname}.{key}.{desc}",
+                ))
+
+
+def _check_cache_clears(mod: ModuleInfo, out: list) -> None:
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = resolve_call(mod, node.func) or ""
+        name = dotted_name(node.func) or ""
+        if (
+            resolved in _CACHE_CLEARERS
+            or name.endswith(".clear_caches")
+            or resolved.endswith("compilation_cache.reset_cache")
+        ):
+            out.append(Finding(
+                "TL503", mod.path, node.lineno,
+                f"`{name}()` drops every compiled program in the "
+                "process — serving re-pays all compile latency; only "
+                "sanctioned tuning sites may do this (inline-disable "
+                "with justification)",
+                symbol=f"clear_caches.{name}",
+            ))
+
+
+@checker("retrace", _RULES)
+def check(index: PackageIndex) -> list[Finding]:
+    out: list[Finding] = []
+    class_of_fn = jit_fields_by_fn(index)
+    for mod in index.modules:
+        module_bindings = collect_jit_bindings(
+            mod, mod.tree.body,
+            resolver=lambda n, _m=module_defs(mod): _m.get(n),
+        )
+        for fn in iter_functions(mod):
+            scope = dict(module_bindings)
+            scope.update(class_of_fn.get(id(fn), {}))
+            _check_function(mod, fn, scope, out)
+        _check_cache_clears(mod, out)
+    return out

@@ -235,7 +235,11 @@ class StreamAssembler:
 
     @property
     def done(self) -> bool:
-        return self.completed == len(self.manifest["tensors"])
+        # feed() bumps `completed` under the lock from concurrent chunk
+        # handlers; an unlocked read here could see the bump before the
+        # sink effects it gates are visible on this thread (tlint TL601)
+        with self._lock:
+            return self.completed == len(self.manifest["tensors"])
 
     def feed(self, name: str, off: int, data: bytes) -> None:
         meta = self.manifest["tensors"].get(name)

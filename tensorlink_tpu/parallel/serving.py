@@ -1000,7 +1000,10 @@ class ContinuousBatchingEngine:
     def result(self, rid: int, *, timeout_s: float | None = None) -> np.ndarray:
         """Drive the serving loop until request ``rid`` finishes; return
         its generated tokens (length <= its max_new; ends at EOS)."""
-        req = self._requests.get(rid)
+        # under the lock: a pump thread's _finish may be evicting old
+        # entries from this dict concurrently (tlint TL601)
+        with self._lock:
+            req = self._requests.get(rid)
         if req is None:
             raise KeyError(
                 f"unknown request id {rid} (never submitted, or its "
@@ -1805,24 +1808,35 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             )
 
     # --------------------------------------------------------------- stats
-    def prefix_hit_rate(self) -> float:
-        """Fraction of submitted prompt tokens served from resident
-        prefix blocks (never re-prefilled)."""
+    def _prefix_hit_rate_locked(self) -> float:
         if not self.prompt_tokens_total:
             return 0.0
         return self.prefix_matched_tokens / self.prompt_tokens_total
 
+    def prefix_hit_rate(self) -> float:
+        """Fraction of submitted prompt tokens served from resident
+        prefix blocks (never re-prefilled)."""
+        with self._lock:
+            return self._prefix_hit_rate_locked()
+
     def stats(self) -> dict:
         out = super().stats()
-        out.update(
-            {
-                "pool": self.pool.stats(),
-                "prefilling": len(self._pending),
-                "peak_blocks_in_use": self.peak_blocks_in_use,
-                "prompt_tokens_total": self.prompt_tokens_total,
-                "prefix_matched_tokens": self.prefix_matched_tokens,
-                "prefilled_tokens": self.prefilled_tokens,
-                "prefix_cache_hit_rate": round(self.prefix_hit_rate(), 4),
-            }
-        )
+        # the admission counters are written under the scheduler lock
+        # (_try_admit); reading them unlocked can tear the snapshot —
+        # e.g. prompt_tokens_total from one admission and
+        # prefix_matched_tokens from the next (tlint TL601)
+        with self._lock:
+            out.update(
+                {
+                    "pool": self.pool.stats(),
+                    "prefilling": len(self._pending),
+                    "peak_blocks_in_use": self.peak_blocks_in_use,
+                    "prompt_tokens_total": self.prompt_tokens_total,
+                    "prefix_matched_tokens": self.prefix_matched_tokens,
+                    "prefilled_tokens": self.prefilled_tokens,
+                    "prefix_cache_hit_rate": round(
+                        self._prefix_hit_rate_locked(), 4
+                    ),
+                }
+            )
         return out

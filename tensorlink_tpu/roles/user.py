@@ -277,16 +277,24 @@ class DistributedJob:
 
         self._ckpt = CheckpointManager(directory, async_save=False)
 
-    def _persist_checkpoint(self) -> None:
-        state = {"stages": {str(i): p for i, p in self._stage_params.items()}}
+    def _persist_checkpoint(self, stages: dict, step: int) -> None:
+        """Blocking orbax write of an event-loop-consistent SNAPSHOT.
+
+        Runs in a worker thread (asyncio.to_thread) while the event
+        loop keeps driving train_step — so it must not touch
+        ``self._stage_params``/``self.step`` directly: a concurrent
+        step would tear the bundle (stage params from step N stamped
+        master_step N+k). The caller captures both on the loop and
+        passes them in (tlint TL602)."""
+        state = {"stages": {str(i): p for i, p in stages.items()}}
         if self.obfuscate_key is not None:
             state["obfuscate_key"] = jax.random.key_data(self.obfuscate_key)
         self._ckpt.save(
-            self.step,
+            step,
             jax.tree.map(np.asarray, state),
             metadata={
                 "job": self.job.to_wire(),
-                "master_step": self.step,
+                "master_step": step,
                 "obfuscated": self.plan is not None,
             },
             force=True,
@@ -946,7 +954,13 @@ class DistributedJob:
         for st, p in zip(chain0, parts):
             self._stage_params[st.index] = p
         if self._ckpt is not None:
-            await asyncio.to_thread(self._persist_checkpoint)
+            # snapshot ON the loop: the param trees are replaced
+            # wholesale on refresh (never mutated in place), so a
+            # shallow dict copy pins a consistent (stages, step) pair
+            # for the worker-thread save
+            await asyncio.to_thread(
+                self._persist_checkpoint, dict(self._stage_params), self.step
+            )
         return self._stage_params
 
     async def fetch_params(self, deobfuscate: bool = True) -> list[dict]:

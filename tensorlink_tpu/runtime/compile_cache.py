@@ -79,7 +79,10 @@ def enable_compile_cache(cache_dir: str | None = None, *,
         try:
             from jax._src.compilation_cache import reset_cache
 
-            reset_cache()
+            # sanctioned reset: flips the lazily-pinned backend onto
+            # the just-configured persistent dir (nothing is compiled
+            # yet at the only call site, worker/engine construction)
+            reset_cache()  # tlint: disable=TL503 cache-enable reset
         except Exception:  # noqa: BLE001 — private API; best effort
             pass
     except Exception as e:  # noqa: BLE001 — cache is an optimization only

@@ -133,3 +133,42 @@ async def test_dht_snapshot_loop_and_restore(tmp_path):
         assert v2.dht.get_local("job:abc") == {"author": "someone", "stages": 2}
     finally:
         await v2.stop()
+
+
+def test_persist_checkpoint_consumes_snapshot_not_live_state():
+    """Regression for the checkpoint-tear fix (tlint TL602):
+    _persist_checkpoint runs in a worker thread while the event loop
+    keeps training, so it must use ONLY the (stages, step) snapshot its
+    caller captured on the loop — touching the live _stage_params/step
+    mid-save could bundle stage params from step N under master_step
+    N+k. Poisons the live fields and checks the save never reads them."""
+    from types import SimpleNamespace
+
+    from tensorlink_tpu.roles.user import DistributedJob
+
+    class Poisoned(dict):
+        def _boom(self, *a, **k):
+            raise AssertionError(
+                "thread-side read of live _stage_params (checkpoint tear)"
+            )
+
+        items = keys = values = __iter__ = __getitem__ = _boom
+
+    job = DistributedJob.__new__(DistributedJob)
+    job._stage_params = Poisoned()
+    job.obfuscate_key = None
+    job.plan = None
+    job.job = SimpleNamespace(to_wire=lambda: {"id": "j"})
+    saved = {}
+
+    def fake_save(step, state, metadata=None, force=False):
+        saved.update(step=step, state=state, metadata=metadata)
+
+    job._ckpt = SimpleNamespace(save=fake_save)
+    snapshot = {0: {"w": np.ones((2,), np.float32)}}
+    job._persist_checkpoint(snapshot, 7)
+    assert saved["step"] == 7
+    assert saved["metadata"]["master_step"] == 7
+    np.testing.assert_array_equal(
+        saved["state"]["stages"]["0"]["w"], np.ones((2,), np.float32)
+    )

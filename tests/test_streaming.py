@@ -215,3 +215,40 @@ async def test_gigabyte_stage_ships_bounded_memory():
         stop.set()
         await sender.stop()
         await w.stop()
+
+
+def test_assembler_done_from_sink_and_threads():
+    """Regression for the StreamAssembler.done lock fix (tlint TL601):
+    `done` now reads `completed` under the assembler lock, so (a) a
+    sink callback may query `done` without deadlocking — feed releases
+    the lock before firing the sink — and (b) concurrent feeder
+    threads never let `done` flip true before the LAST sink effect is
+    visible."""
+    import threading
+
+    arrays = {
+        "a": np.arange(64, dtype=np.float32),
+        "b": np.arange(32, dtype=np.int32),
+    }
+    man = stream_manifest(arrays)
+    got = {}
+    mid_sink_done: list = []
+
+    def sink(name, arr):
+        got[name] = arr
+        mid_sink_done.append(asm.done)  # must not deadlock
+
+    asm = StreamAssembler(man, sink)
+    chunks = list(iter_array_chunks(arrays, chunk_bytes=48))
+    threads = [
+        threading.Thread(target=asm.feed, args=c) for c in chunks
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert asm.done
+    assert len(got) == len(arrays)
+    # completion is counted only AFTER each sink returns, so no sink
+    # ever observed done=True mid-flight
+    assert mid_sink_done == [False] * len(arrays)
