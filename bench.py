@@ -745,6 +745,27 @@ def main() -> None:
                 f"(P{Pcb} N{Ncb}) over {SLOTS} slots, decode_chunk 16, "
                 "vs the same prompts in one static batch"
             )
+            # ON-DEVICE donation evidence (tlhlo TLH101, the backend
+            # actually benched — the committed hlo.manifest.json pins
+            # the CPU lowering): every donated serving-state leaf must
+            # alias an output or the engine pays a full state copy per
+            # chunk, which would silently poison every number above
+            try:
+                from tensorlink_tpu.analysis.hlo import parse_alias_count
+
+                decode_prog = sch.audit_programs()[0]
+                aliased = parse_alias_count(
+                    decode_prog["lower"]().compile().as_text()
+                )
+                donated = decode_prog["donated"]
+                out["serving_decode_donated_leaves"] = donated
+                out["serving_decode_aliased_leaves"] = aliased
+                if aliased < donated:
+                    out["serving_decode_donation_dropped"] = True
+            except Exception as e:  # noqa: BLE001 — evidence, not gate
+                out["serving_decode_donation_note"] = (
+                    f"{type(e).__name__}: {e}"
+                )
 
             # -- paged KV cache (ISSUE 6 tentpole): the same traffic
             # volume but every request opens with one shared 64-token

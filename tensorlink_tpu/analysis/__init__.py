@@ -39,6 +39,13 @@ so CI fails only on regressions; line-level ``# tlint: disable=TLxxx
 [justification]`` comments suppress single sites. ``--fix`` applies the
 mechanical autofixes; repeated runs skip unchanged files through an
 mtime+size parse cache.
+
+A sibling auditor, **tlhlo** (``TLH1xx``, `hlo.py` — ``tlhlo`` console
+script), runs the same Finding/baseline discipline over the COMPILED
+programs instead of the source: donation honored, collective/memory
+budgets, dtype discipline, host round-trips, and program-count budgets,
+pinned by a committed ``hlo.manifest.json``. It imports jax and is
+therefore not part of this package's dependency-free core.
 """
 
 from tensorlink_tpu.analysis.core import (

@@ -23,6 +23,7 @@ from tensorlink_tpu.analysis.core import (
     PackageIndex,
     all_rules,
     find_default_baseline,
+    github_annotation,
     load_baseline,
     rule_explanation,
     run_analysis,
@@ -193,16 +194,8 @@ def main(argv: list[str] | None = None) -> int:
         ))
     elif args.format == "github":
         for f in fresh:
-            # https://docs.github.com/actions: workflow commands; the
-            # message must be single-line (escape % first)
-            msg = (
-                f.message.replace("%", "%25")
-                .replace("\r", "%0D").replace("\n", "%0A")
-            )
-            print(
-                f"::error file={f.path},line={f.line},"
-                f"title=tlint {f.rule}::{msg}"
-            )
+            # https://docs.github.com/actions: workflow commands
+            print(github_annotation(f, "tlint"))
         print(
             f"tlint: {len(fresh)} finding(s) in {len(index.modules)} "
             f"file(s) ({known} baselined)"

@@ -291,6 +291,28 @@ def checker(family: str, rules: dict[str, str]):
     return wrap
 
 
+def github_annotation(f: Finding, tool: str = "tlint") -> str:
+    """One GitHub workflow-command line (`::error file=...`) for a
+    finding — the grammar requires a single-line message with %, CR,
+    and LF escaped. Shared by the tlint and tlhlo CLIs so the escaping
+    rules cannot drift between the two CI gates."""
+    msg = (
+        f.message.replace("%", "%25")
+        .replace("\r", "%0D").replace("\n", "%0A")
+    )
+    return (
+        f"::error file={f.path},line={f.line},"
+        f"title={tool} {f.rule}::{msg}"
+    )
+
+
+def register_rules(rules: dict[str, str]) -> None:
+    """Register rule docs WITHOUT a PackageIndex checker — for analyses
+    that run over other inputs (tlhlo's compiled-program rules) but
+    share the Finding/explanation machinery."""
+    _RULE_DOCS.update(rules)
+
+
 def rule_explanation(rule: str, first_line: bool = False) -> str:
     doc = _RULE_DOCS.get(rule, "")
     return doc.strip().splitlines()[0] if (first_line and doc) else doc

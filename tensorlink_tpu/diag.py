@@ -10,6 +10,12 @@ pairs for step-time/throughput regressions:
     tldiag scrape 127.0.0.1:8080 worker-1:8080 -o bundle.json
     tldiag table bundle.json
     tldiag bench-diff BENCH_r04.json BENCH_r05.json --threshold 0.05
+    tldiag manifest-diff hlo.manifest.json /tmp/new-manifest.json
+
+``manifest-diff`` reviews a tlhlo (analysis/hlo.py) manifest
+regeneration: per-program direction verdicts — memory/collective bytes
+lower-better at a threshold, alias/donated pairs exact (a shrunk alias
+count is always a regression: a dropped donation).
 
 Dependency-free in itself (stdlib + asyncio sockets — the same
 dependency posture as the StatusServer it scrapes) and never touches an
@@ -391,6 +397,138 @@ def render_bench_diff(diff: dict) -> str:
     return "\n".join(lines)
 
 
+# ---------------------------------------------------- manifest diffing
+# tlhlo's hlo.manifest.json (analysis/hlo.py) pins per-program compiled
+# facts; this diff says which way each one MOVED between two manifests —
+# the review tool for a --write-manifest regeneration ("what did my
+# change do to the compiled programs?"). Memory and collective bytes
+# are measurements (lower is better, judged at a threshold); alias /
+# donated / program-set facts are EXACT — any change is a verdict, and
+# a SHRUNK alias count is always a regression (a dropped donation).
+_MANIFEST_LOWER_BETTER = (
+    "temp_bytes", "argument_bytes", "output_bytes",
+    "f32_dot", "f32_convert", "host_calls",
+)
+
+
+def _manifest_key_direction(key: str) -> str | None:
+    leaf = key.rsplit(".", 1)[-1]
+    if leaf in _MANIFEST_LOWER_BETTER or key.startswith("collectives."):
+        return "lower"
+    if leaf in ("alias", "donated"):
+        return "exact"
+    return None
+
+
+def manifest_diff(
+    old: dict, new: dict, threshold: float = 0.05
+) -> dict[str, Any]:
+    """Per-program, per-key direction verdicts between two tlhlo
+    manifests. Byte measurements regress when they GROW by more than
+    ``threshold``; exact keys regress on any unfavorable change
+    (alias/donated shrinking); added/removed programs and collective
+    kinds are always reported."""
+    a = old.get("programs", {})
+    b = new.get("programs", {})
+    programs: dict[str, Any] = {}
+    regressions: list[str] = []
+    improvements: list[str] = []
+    for name in sorted(set(a) & set(b)):
+        fa = _flatten_numeric(a[name])
+        fb = _flatten_numeric(b[name])
+        keys: dict[str, Any] = {}
+        # identity facts are STRINGS (invisible to the numeric flatten):
+        # a dtype flip bfloat16->float32 silently switches TLH103 off
+        # for that program, so any change here is always a verdict
+        for sk in ("dtype", "group"):
+            sa, sb = a[name].get(sk), b[name].get(sk)
+            if isinstance(sa, str) and isinstance(sb, str) and sa != sb:
+                keys[sk] = {
+                    "old": sa, "new": sb, "direction": "exact",
+                    "regression": True,
+                }
+                regressions.append(f"{name}.{sk}")
+        for k in sorted(set(fa) | set(fb)):
+            va, vb = fa.get(k), fb.get(k)
+            direction = _manifest_key_direction(k)
+            full = f"{name}.{k}"
+
+            def _i(v):  # manifest values are counts/bytes: keep ints
+                return int(v) if v is not None and v == int(v) else v
+
+            rec: dict[str, Any] = {
+                "old": _i(va), "new": _i(vb), "direction": direction,
+            }
+            if va is None or vb is None:
+                # a collective kind appearing/disappearing IS the event
+                rec["regression"] = worse = va is None
+                (regressions if worse else improvements).append(full)
+            elif direction == "exact":
+                if va != vb:
+                    rec["regression"] = worse = vb < va
+                    (regressions if worse else improvements).append(full)
+            elif direction == "lower":
+                if va:
+                    delta = (vb - va) / abs(va)
+                    rec["delta_frac"] = round(delta, 4)
+                    if abs(delta) > threshold:
+                        rec["regression"] = worse = delta > 0
+                        (regressions if worse else improvements).append(full)
+                elif vb:
+                    # growth from a ZERO pin (first f32 dot, first host
+                    # call, first temp byte) is the highest-signal move
+                    # these keys make — a relative threshold cannot see
+                    # it, so it is always a verdict
+                    rec["regression"] = True
+                    regressions.append(full)
+            keys[k] = rec
+        programs[name] = keys
+    return {
+        "threshold": threshold,
+        "programs": programs,
+        "regressions": regressions,
+        "improvements": improvements,
+        "added": sorted(set(b) - set(a)),
+        "removed": sorted(set(a) - set(b)),
+    }
+
+
+def render_manifest_diff(diff: dict) -> str:
+    lines = [
+        f"manifest diff (threshold {diff['threshold']:.0%}): "
+        f"{len(diff['regressions'])} regression(s), "
+        f"{len(diff['improvements'])} improvement(s), "
+        f"{len(diff['added'])} added, {len(diff['removed'])} removed "
+        f"program(s)"
+    ]
+
+    def _fmt(full: str, tag: str) -> str:
+        name, _, key = full.partition(".")
+        # program names and collectives.* keys both contain dots —
+        # resplit against the program table, LONGEST prefix first
+        for prog in sorted(diff["programs"], key=len, reverse=True):
+            if full.startswith(prog + "."):
+                name, key = prog, full[len(prog) + 1:]
+                break
+        r = diff["programs"][name][key]
+        delta = (
+            f" ({r['delta_frac']:+.1%})" if "delta_frac" in r else ""
+        )
+        return (
+            f"  {tag} {name} {key}: {r['old']} -> {r['new']}{delta}"
+        )
+
+    for full in diff["regressions"]:
+        lines.append(_fmt(full, "REGRESSION"))
+    for full in diff["improvements"]:
+        lines.append(_fmt(full, "improved  "))
+    for name in diff["added"]:
+        lines.append(f"  added      {name}")
+    for name in diff["removed"]:
+        lines.append(f"  removed    {name}")
+    return "\n".join(lines)
+
+
 def latest_bench_record(root: str) -> tuple[str, dict] | None:
     """Newest USABLE committed BENCH_r*.json under ``root`` (descending
     round order; a round whose payload has no headline value or recorded
@@ -451,6 +589,18 @@ def main(argv: list[str] | None = None) -> int:
                          "counts as moved (default 5%%)")
     bd.add_argument("--json", action="store_true", dest="as_json",
                     help="print the full diff as JSON")
+    md = sub.add_parser(
+        "manifest-diff",
+        help="direction verdicts between two tlhlo hlo.manifest.json "
+             "(memory/collective bytes lower-better, alias pairs exact)",
+    )
+    md.add_argument("old")
+    md.add_argument("new")
+    md.add_argument("--threshold", type=float, default=0.05,
+                    help="relative growth beyond which a byte "
+                         "measurement regresses (default 5%%)")
+    md.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the full diff as JSON")
     args = ap.parse_args(argv)
 
     if args.cmd == "scrape":
@@ -478,6 +628,17 @@ def main(argv: list[str] | None = None) -> int:
             new = json.load(f)
         diff = bench_diff(old, new, args.threshold)
         print(json.dumps(diff) if args.as_json else render_bench_diff(diff))
+        return 0
+    if args.cmd == "manifest-diff":
+        with open(args.old) as f:
+            old = json.load(f)
+        with open(args.new) as f:
+            new = json.load(f)
+        diff = manifest_diff(old, new, args.threshold)
+        print(
+            json.dumps(diff) if args.as_json
+            else render_manifest_diff(diff)
+        )
         return 0
     return 2  # pragma: no cover — argparse enforces the subcommands
 

@@ -584,6 +584,27 @@ class ShardedTrainer:
         with jax.set_mesh(self.mesh):
             return self._eval_fn(state.params, batch, None)
 
+    def audit_programs(self, state: TrainState, batch, rng=None) -> list[dict]:
+        """Compiled-program inventory for tlhlo (analysis/hlo.py): the
+        fully sharded train step, lowered under the trainer's ambient
+        mesh exactly as ``train_step`` traces it. A fresh jit on
+        purpose — the lazily-built ``_step_fn`` may belong to a live
+        training loop whose trace cache must not see audit avals."""
+        donated = len(jax.tree.leaves(state))
+        fn = jax.jit(self._step, donate_argnums=(0,))
+        sharded_batch = jax.device_put(batch, self._batch_sh)
+
+        def lower():
+            with jax.set_mesh(self.mesh):
+                return fn.lower(state, sharded_batch, rng)
+
+        return [{
+            "name": "step",
+            "dtype": str(self.cfg.dtype),
+            "donated": donated,
+            "lower": lower,
+        }]
+
     # -- reporting ------------------------------------------------------
     @property
     def bubble_fraction(self) -> float:

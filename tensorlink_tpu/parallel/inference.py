@@ -68,6 +68,20 @@ def _filter_logits(logits, temperature, top_k, top_p=1.0):
     return logits
 
 
+def declared_compute_dtype(tree) -> str:
+    """Declared hot-path compute dtype of a param tree: the dtype its
+    >=2-D floating leaves were cast to (this engine's dtype policy —
+    1-D biases/norm scales deliberately stay f32). The tlhlo audit
+    hooks (analysis/hlo.py) use this to decide whether TLH103's
+    low-precision discipline applies to a program."""
+    for leaf in jax.tree.leaves(tree):
+        if getattr(leaf, "ndim", 0) >= 2 and jnp.issubdtype(
+            leaf.dtype, jnp.floating
+        ):
+            return str(leaf.dtype)
+    return "float32"
+
+
 def sample_logits(logits, key, temperature, top_k, top_p=1.0):
     """One home for the sampling math ([..., V] logits -> token ids):
     the engine's in-scan decode and the continuous-batching scheduler
@@ -427,6 +441,29 @@ class InferenceEngine:
         )
 
     # ------------------------------------------------------------- public
+    def audit_decode_program(
+        self, B: int, T0: int, gen: "GenerationConfig",
+        name: str | None = None,
+    ) -> dict:
+        """One tlhlo (analysis/hlo.py) program entry for the fused
+        prefill+decode program at shape ``(B, T0)``. This is how the
+        kv-shard collective pin generalizes: lower this on a seq-sharded
+        mesh and the auditor's TLH102 budget watches every all-gather
+        the partitioner inserts. ``generate``'s jit does not donate (the
+        caller keeps ids), so the donated count is 0."""
+        fn = self._build(B, T0, gen)
+        i32 = jnp.int32
+        sds = jax.ShapeDtypeStruct
+        return {
+            "name": name or f"decode_b{B}_t{T0}",
+            "dtype": declared_compute_dtype(self.params),
+            "donated": 0,
+            "lower": lambda: fn.lower(
+                self.params, sds((B, T0), i32), sds((B, T0), i32),
+                jax.random.key(0),
+            ),
+        }
+
     def generate_async(
         self,
         ids: np.ndarray,

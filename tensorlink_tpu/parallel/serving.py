@@ -68,6 +68,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from tensorlink_tpu.parallel.inference import (
     GenerationConfig,
     InferenceEngine,
+    declared_compute_dtype,
     sample_logits,
     spec_verify,
 )
@@ -706,6 +707,51 @@ class ContinuousBatchingEngine:
         buckets = range(self.prefill_block, top + 1, self.prefill_block)
         for Tp in list(buckets)[: self.prefill_cache_max]:
             self._get_prefill(Tp)
+
+    # ---------------------------------------------------------------- audit
+    def _audit_dtype(self) -> str:
+        return declared_compute_dtype(self.engine.params)
+
+    def audit_programs(self) -> list[dict]:
+        """Compiled-program inventory for tlhlo (analysis/hlo.py): one
+        entry per load-bearing program with the donated-leaf count the
+        input/output aliasing must cover and a ``lower()`` thunk.
+        ``lower()`` needs only avals, so nothing here executes, copies,
+        or invalidates the (donated) live serving state — safe on a
+        serving engine mid-traffic. Fresh jits are built on purpose:
+        ``_warm()`` may have replaced the engine's own handles with
+        AOT-compiled executables, which cannot re-lower."""
+        dt = self._audit_dtype()
+        with self._lock:  # snapshot the state tree vs in-flight chunks
+            donated = len(jax.tree.leaves(self._state))
+            args = self._program_args()
+        progs = [{
+            "name": "spec_chunk" if self.spec is not None else "decode",
+            "dtype": dt,
+            "donated": donated,
+            "lower": lambda: self._build_decode().lower(*args),
+        }]
+        Tp = self._bucket(1)  # smallest prefill bucket
+        i32 = jnp.int32
+        sds = jax.ShapeDtypeStruct
+
+        def lower_prefill(Tp=Tp):
+            return self._build_prefill(Tp).lower(
+                *args, sds((1, Tp), i32), sds((1, Tp), i32),
+                sds((), i32), sds((), jnp.uint32), sds((), i32),
+            )
+
+        progs.append({
+            # a speculative engine's prefill is a DIFFERENT program
+            # (it grafts the draft cache / n-gram ids into the larger
+            # donated tree) — name it apart so both get audited
+            "name": f"prefill_b{Tp}"
+            + ("_spec" if self.spec is not None else ""),
+            "dtype": dt,
+            "donated": donated,
+            "lower": lower_prefill,
+        })
+        return progs
 
     # --------------------------------------------------------------- events
     def _event(self, kind: str, severity: str = "info", **data) -> None:
@@ -1460,6 +1506,44 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         window band fold internally), so the verify/draft passes need
         no caller mask at all."""
         return None
+
+    def audit_programs(self) -> list[dict]:
+        """Paged inventory: the (single) decode/spec chunk plus the ONE
+        shape-static prefill-chunk program that serves every prompt
+        (the per-bucket prefill family of the contiguous engine does
+        not exist here — that is the point of chunked prefill)."""
+        dt = self._audit_dtype()
+        with self._lock:  # snapshot the state tree vs in-flight chunks
+            donated = len(jax.tree.leaves(self._state))
+            args = self._program_args()
+        i32 = jnp.int32
+        sds = jax.ShapeDtypeStruct
+
+        def lower_chunk():
+            return self._build_prefill_chunk().lower(
+                *args, sds((1, self.prefill_chunk), i32),
+                sds((), i32), sds((), i32), sds((), i32),
+                sds((), jnp.uint32), sds((), i32), sds((), jnp.bool_),
+            )
+
+        return [
+            {
+                "name": (
+                    "spec_chunk" if self.spec is not None else "decode"
+                ),
+                "dtype": dt,
+                "donated": donated,
+                "lower": lambda: self._build_decode().lower(*args),
+            },
+            {
+                # distinct per spec mode, like the contiguous prefill
+                "name": "prefill_chunk"
+                + ("_spec" if self.spec is not None else ""),
+                "dtype": dt,
+                "donated": donated,
+                "lower": lower_chunk,
+            },
+        ]
 
     # ------------------------------------------------------------ admission
     def _check_fit(self, t0: int, max_new: int) -> None:

@@ -230,6 +230,34 @@ class StageRunner:
 
         self._pol = jax.jit(pol_run)
 
+    def audit_programs(self, x) -> list[dict]:
+        """Compiled-program inventory for tlhlo (analysis/hlo.py): the
+        stage's forward and rematerializing-backward executables for one
+        activation aval ``x``. Stage programs never donate — activations
+        are retained for BACKWARD and params for the next step."""
+        from tensorlink_tpu.parallel.inference import (
+            declared_compute_dtype,
+        )
+
+        out = jax.eval_shape(
+            lambda p, xx: self.module.apply(p, xx), self.params, x
+        )
+        dt = declared_compute_dtype(self.params)
+        return [
+            {
+                "name": "stage_fwd",
+                "dtype": dt,
+                "donated": 0,
+                "lower": lambda: self._fwd.lower(self.params, x),
+            },
+            {
+                "name": "stage_bwd",
+                "dtype": dt,
+                "donated": 0,
+                "lower": lambda: self._bwd.lower(self.params, x, out),
+            },
+        ]
+
     def _aot(self, tag: str, jitted, *args):
         """Compile-once-per-shape AOT executable. Same compile count as
         the lazy jit path, but the Lowered->Compiled route exposes

@@ -116,6 +116,7 @@ class Trainer:
             moment_dtype=cfg.opt_moment_dtype,
         )
         self.compute_dtype = jnp.dtype(cfg.dtype)
+        self.donate = bool(donate)
         self._train_step = jax.jit(
             self._step, donate_argnums=(0,) if donate else ()
         )
@@ -217,6 +218,20 @@ class Trainer:
 
     def _eval(self, params, batch, rng):
         return self._loss_for_grad(params, batch, rng)
+
+    # -- audit -----------------------------------------------------------
+    def audit_programs(self, state: TrainState, batch, rng=None) -> list[dict]:
+        """Compiled-program inventory for tlhlo (analysis/hlo.py): the
+        jitted train step, with the donated-leaf count (params + moments
+        + step) the input/output aliasing must cover. ``lower()`` needs
+        only avals — nothing executes."""
+        donated = len(jax.tree.leaves(state)) if self.donate else 0
+        return [{
+            "name": "step",
+            "dtype": str(self.compute_dtype),
+            "donated": donated,
+            "lower": lambda: self._train_step.lower(state, batch, rng),
+        }]
 
     # -- observability ---------------------------------------------------
     def data_span(self):

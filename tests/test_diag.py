@@ -230,6 +230,136 @@ def test_cli_bench_diff_and_table(tmp_path, capsys):
     assert "DEAD" in out and "10.0.0.1:8080" in out
 
 
+def _manifest(programs):
+    return {"programs": programs, "suppress": []}
+
+
+def test_manifest_diff_directions():
+    """tlhlo manifest keys: memory/collective bytes are lower-better at
+    the threshold; alias/donated are EXACT with shrinkage = regression
+    (a dropped donation); added/removed programs always reported."""
+    from tensorlink_tpu.diag import manifest_diff, render_manifest_diff
+
+    old = _manifest({
+        "continuous.decode": {
+            "group": "continuous", "dtype": "bfloat16", "donated": 12,
+            "alias": 12, "collectives": {}, "f32_dot": 0,
+            "f32_convert": 24, "host_calls": 0, "temp_bytes": 300_000,
+            "argument_bytes": 120_000, "output_bytes": 66_000,
+        },
+        "infer.kv_shard_decode": {
+            "group": "infer", "dtype": "bfloat16", "donated": 0,
+            "alias": 0, "collectives": {"all-gather": 4096},
+            "f32_dot": 0, "f32_convert": 48, "host_calls": 0,
+            "temp_bytes": 1_000_000, "argument_bytes": 500_000,
+            "output_bytes": 1_000,
+        },
+        "trainer.step": {"alias": 109, "donated": 109,
+                         "temp_bytes": 50_000},
+    })
+    new = _manifest({
+        "continuous.decode": {
+            **old["programs"]["continuous.decode"],
+            "alias": 10,              # two donations dropped: regression
+            "temp_bytes": 400_000,    # scratch grew >5%: regression
+        },
+        "infer.kv_shard_decode": {
+            **old["programs"]["infer.kv_shard_decode"],
+            "collectives": {"all-gather": 2048},  # halved: improvement
+            "f32_convert": 40,                    # fewer upcasts: improvement
+        },
+        "paged.decode": {"alias": 14, "donated": 14, "temp_bytes": 1},
+    })
+    d = manifest_diff(old, new, threshold=0.05)
+    assert "continuous.decode.alias" in d["regressions"]
+    assert "continuous.decode.temp_bytes" in d["regressions"]
+    assert "infer.kv_shard_decode.collectives.all-gather" in d["improvements"]
+    assert "infer.kv_shard_decode.f32_convert" in d["improvements"]
+    assert d["added"] == ["paged.decode"]
+    assert d["removed"] == ["trainer.step"]
+    # exact keys carry no delta_frac; byte keys do
+    rec = d["programs"]["continuous.decode"]["alias"]
+    assert rec["regression"] is True and "delta_frac" not in rec
+    assert d["programs"]["continuous.decode"]["temp_bytes"][
+        "delta_frac"
+    ] == pytest.approx(1 / 3, abs=1e-3)
+    text = render_manifest_diff(d)
+    assert "REGRESSION continuous.decode alias: 12 -> 10" in text
+    assert "improved   infer.kv_shard_decode collectives.all-gather" in text
+    assert "added      paged.decode" in text
+    assert "removed    trainer.step" in text
+
+
+def test_manifest_diff_new_collective_kind_regresses():
+    from tensorlink_tpu.diag import manifest_diff
+
+    old = _manifest({"p": {"collectives": {}, "temp_bytes": 10}})
+    new = _manifest({
+        "p": {"collectives": {"all-reduce": 64}, "temp_bytes": 10},
+    })
+    d = manifest_diff(old, new)
+    assert d["regressions"] == ["p.collectives.all-reduce"]
+    # and the kind DISAPPEARING is an improvement, not a crash
+    d = manifest_diff(new, old)
+    assert d["improvements"] == ["p.collectives.all-reduce"]
+
+
+def test_manifest_diff_growth_from_zero_pin_regresses():
+    """f32_dot/host_calls/temp_bytes going 0 -> N is the highest-signal
+    move those keys make — a relative threshold can't see it, so it
+    must be an unconditional regression verdict."""
+    from tensorlink_tpu.diag import manifest_diff
+
+    old = _manifest({"p": {"f32_dot": 0, "host_calls": 0,
+                           "temp_bytes": 0}})
+    new = _manifest({"p": {"f32_dot": 5, "host_calls": 1,
+                           "temp_bytes": 4096}})
+    d = manifest_diff(old, new)
+    assert sorted(d["regressions"]) == [
+        "p.f32_dot", "p.host_calls", "p.temp_bytes",
+    ]
+    # and back to zero is the mirror improvement, never a regression
+    back = manifest_diff(new, old)
+    assert back["regressions"] == []
+    assert sorted(back["improvements"]) == [
+        "p.f32_dot", "p.host_calls", "p.temp_bytes",
+    ]
+
+
+def test_manifest_diff_dtype_flip_is_a_verdict():
+    """dtype is a string (invisible to the numeric flatten) but a
+    bfloat16->float32 flip switches TLH103 off for that program — the
+    diff must never render it as zero change."""
+    from tensorlink_tpu.diag import manifest_diff, render_manifest_diff
+
+    old = _manifest({"p": {"dtype": "bfloat16", "temp_bytes": 10}})
+    new = _manifest({"p": {"dtype": "float32", "temp_bytes": 10}})
+    d = manifest_diff(old, new)
+    assert d["regressions"] == ["p.dtype"]
+    assert "REGRESSION p dtype: bfloat16 -> float32" in (
+        render_manifest_diff(d)
+    )
+
+
+def test_cli_manifest_diff(tmp_path, capsys):
+    a = tmp_path / "old.json"
+    b = tmp_path / "new.json"
+    a.write_text(json.dumps(_manifest(
+        {"continuous.decode": {"alias": 12, "donated": 12,
+                               "temp_bytes": 100}}
+    )))
+    b.write_text(json.dumps(_manifest(
+        {"continuous.decode": {"alias": 12, "donated": 12,
+                               "temp_bytes": 90}}
+    )))
+    assert main(["manifest-diff", str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert "improved   continuous.decode temp_bytes" in out
+    assert main(["manifest-diff", str(a), str(b), "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["improvements"] == ["continuous.decode.temp_bytes"]
+
+
 def test_node_row_flags_synthetic():
     dead = node_row({"target": "x:1", "error": "refused"})
     assert dead["flags"] == ["DEAD"] and dead["healthy"] is None

@@ -467,14 +467,16 @@ def test_kv_seq_shard_requires_seq_axis(tiny_llama):
 
 def test_kv_seq_shard_hlo_pin_no_cache_gather(devices):
     """Pin kv_seq_shard's LOWERING, not just its outputs (VERDICT #5):
-    compile the sharded decode program and assert from the HLO text that
-    the KV cache stays sharded end to end — every cache k/v write
+    compile the sharded decode program and assert through the tlhlo IR
+    (analysis/hlo.py — the same parse and TLH102 budget rule the
+    `tlhlo` auditor runs, so this pin and the CLI cannot drift apart)
+    that the KV cache stays sharded end to end — every cache k/v write
     operates on the 1/S slot shard, the full-width cache shape appears
     NOWHERE, and no all-gather materializes more than the admitted
     one-layer k/v transient. If the partitioner ever regresses to
     gathering the cache (the failure mode that turns sequence-sharded
     serving into replicated serving plus collectives), this fails."""
-    import re
+    from tensorlink_tpu.analysis.hlo import check_collectives, parse_hlo
 
     B, T0, N = 2, 64, 1200
     S = 4  # seq-axis shards
@@ -495,48 +497,43 @@ def test_kv_seq_shard_hlo_pin_no_cache_gather(devices):
     L = -(-(T0 + N) // DECODE_BLOCK) * DECODE_BLOCK
     assert L % S == 0
     Hkv, Dh = cfg.num_kv_heads, cfg.dim // cfg.num_heads
-    fn = eng._build(B, T0, gen)
-    compiled = fn.lower(
-        eng.params, jnp.zeros((B, T0), jnp.int32),
-        jnp.ones((B, T0), jnp.int32), jax.random.key(0),
-    ).compile()
-    txt = compiled.as_text()
+    compiled = eng.audit_decode_program(B, T0, gen)["lower"]().compile()
+    ir = parse_hlo(compiled.as_text())
 
     # 1. cache writes land on the shard: k and v of every layer, in both
-    # prefill and the decode scan body
-    shard_dus = re.findall(
-        rf"dynamic-update-slice\(f32\[{B},{L // S},{Hkv},{Dh}\]", txt
+    # prefill and the decode scan body (a dynamic-update-slice RESULT is
+    # the updated — i.e. shard-sized — cache tensor)
+    shard_dus = ir.count(
+        "dynamic-update-slice", dtype="f32", shape=(B, L // S, Hkv, Dh)
     )
-    assert len(shard_dus) >= 2 * cfg.num_layers, (
-        f"expected sharded cache updates, found {len(shard_dus)}"
+    assert shard_dus >= 2 * cfg.num_layers, (
+        f"expected sharded cache updates, found {shard_dus}"
     )
     # 2. the full-width cache tensor must not exist anywhere in the
     # program — not as a write target, not as a collective result
-    assert f"f32[{B},{L},{Hkv},{Dh}]" not in txt, (
+    # (every tensor is some instruction's result, parameters included)
+    assert not ir.has_result("f32", (B, L, Hkv, Dh)), (
         "full-width KV cache materialized: the partitioner gathered "
         "the cache"
     )
-    # 3. collective budget: an all-gather may transiently assemble AT
-    # MOST one layer's k/v; anything larger means the cache (or several
-    # layers of it) is being gathered per step
-    one_kv = B * L * Hkv * Dh  # elements of one full-width k (or v)
-    gathered = []
-    for line in txt.splitlines():
-        if " all-gather(" not in line:
-            continue
-        mshape = re.search(r"=\s+\S*?\[([\d,]*)\]", line)
-        if not mshape or not mshape.group(1):
-            continue
-        elems = 1
-        for d in mshape.group(1).split(","):
-            elems *= int(d)
-        gathered.append(elems)
-    offenders = [g for g in gathered if g >= 2 * one_kv]
-    assert not offenders, (
-        f"all-gather of {offenders} elements (> one layer's k+v "
-        f"{2 * one_kv}): KV cache sharding regressed"
+    # 3. collective budget (TLH102): an all-gather may transiently
+    # assemble AT MOST one layer's k/v; anything at/over 2x means the
+    # cache (or several layers of it) is being gathered per step
+    one_kv_bytes = B * L * Hkv * Dh * 4  # one full-width f32 k (or v)
+    gathers = [op for op in ir.collectives() if op.kind == "all-gather"]
+    budget = {"all-gather": 2 * one_kv_bytes - 1}
+    findings = check_collectives(
+        "infer.kv_shard_decode",
+        {"all-gather": max((g.bytes for g in gathers), default=0)},
+        budget,
     )
-    assert len([g for g in gathered if g >= one_kv]) <= 2, gathered
+    assert not findings, (
+        "KV cache sharding regressed:\n"
+        + "\n".join(f.message for f in findings)
+    )
+    assert len([g for g in gathers if g.bytes >= one_kv_bytes]) <= 2, (
+        [(g.dtype, g.shape) for g in gathers]
+    )
 
 
 def test_single_token_prompt_matches_naive(tiny_llama):

@@ -348,7 +348,15 @@ def _ep_compiled(moe, mesh, batch=8, ambient=False):
 
 
 def _count(txt, op):
-    return txt.count(op + "(") + txt.count(op + "-start")
+    """Collective instructions of ``op`` in compiled HLO, through the
+    tlhlo IR (analysis/hlo.py) — the same parse the `tlhlo` auditor's
+    TLH102 budgets run on, so these pins and the CLI cannot drift
+    apart. (-start forms fold into the base op; operand MENTIONS of a
+    collective's result no longer miscount, unlike the old substring
+    grep.)"""
+    from tensorlink_tpu.analysis.hlo import parse_hlo
+
+    return parse_hlo(txt).count(op)
 
 
 def test_ep_compiled_hlo_all_to_all(devices):
