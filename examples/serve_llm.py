@@ -70,12 +70,32 @@ def main() -> None:
                          "n-gram/prompt-lookup proposals from the "
                          "request's own context (the no-tiny-sibling "
                          "fallback), same verify program")
-    ap.add_argument("--spec-k", type=int, default=4,
-                    help="drafted tokens per verify pass")
+    ap.add_argument("--spec-k", default="4",
+                    help="drafted tokens per verify pass, or 'auto': "
+                         "per-request K self-tunes online from the "
+                         "measured acceptance (masked K inside the one "
+                         "spec program — no retrace), with draft "
+                         "early-exit and LOW-ACCEPT self-healing")
+    ap.add_argument("--draft", default=None,
+                    help="'auto': measure the model zoo's candidate "
+                         "drafts at engine start and keep the largest "
+                         "one whose accepted-tokens-per-second beats "
+                         "this engine's own non-spec baseline "
+                         "(falls back to n-gram, then non-spec)")
+    ap.add_argument("--autotune-dir", default=None,
+                    help="persistent tuning store (runtime/autotune.py)"
+                         ": flash blocks, prefill buckets, and the "
+                         "learned K prior reload here across restarts")
     args = ap.parse_args()
     if args.speculate and args.ngram:
         ap.error("--speculate and --ngram are exclusive")
-    if (args.speculate or args.ngram) and not (args.continuous or args.paged):
+    if args.draft is not None and args.draft != "auto":
+        ap.error("--draft only supports 'auto' (or use --speculate)")
+    spec_auto = args.spec_k == "auto"
+    spec_k = 4 if spec_auto else int(args.spec_k)
+    if (
+        args.speculate or args.ngram or args.draft or spec_auto
+    ) and not (args.continuous or args.paged):
         args.continuous = True  # speculation lives in the schedulers
 
     # tiny config so the example runs on a dev box; swap for
@@ -118,14 +138,37 @@ def main() -> None:
     # small model when the zoo has one for your target); --ngram drafts
     # from the request's own context, no second model at all.
     spec_kw = {}
-    if args.speculate or args.ngram:
+    if args.speculate or args.ngram or args.draft or spec_auto:
         from tensorlink_tpu.parallel.serving import SpecConfig
 
-        spec_kw["speculative"] = SpecConfig(k=args.spec_k)
-        if args.speculate:
+        scfg = (
+            SpecConfig.auto(k=spec_k) if spec_auto
+            else SpecConfig(k=spec_k)
+        )
+        spec_kw["speculative"] = scfg
+        if args.draft == "auto":
+            # measured pairing: a short calibration burst per candidate
+            # decides whether ANY draft (or n-gram, or nothing) pays on
+            # this chip for this model — no tokens-per-weight heuristics
+            from tensorlink_tpu.parallel.serving import autopair_draft
+
+            verdict = autopair_draft(eng, gen, cfg=scfg)
+            print(
+                f"draft auto-pairing: {verdict['name']} "
+                f"(mode={verdict['mode']}, measured tok/s "
+                f"{verdict['measured']}, baseline "
+                f"{verdict['baseline_tokens_per_sec']}, burst "
+                f"{verdict['calibration_s']}s)"
+            )
+            spec_kw["draft"] = verdict["draft"]
+            if verdict["mode"] == "nonspec":
+                spec_kw.pop("speculative")
+        elif args.speculate:
             spec_kw["draft"] = InferenceEngine(
                 mesh, model, params, max_len=256, quantize="int8",
             )
+    if args.autotune_dir:
+        spec_kw["autotune_dir"] = args.autotune_dir
 
     def print_spec(st) -> None:
         sp = st.get("spec")
@@ -137,6 +180,23 @@ def main() -> None:
                 f"{sp['emitted_tokens']} tokens over "
                 f"{sp['weight_passes']} passes, "
                 f"{sp['fallback_total']} n-gram misses)"
+            )
+            if sp.get("adaptive"):
+                print(
+                    f"adaptive K: mean dispatched K {sp['k_mean']} "
+                    f"of k_max {sp['k']}; learned prior "
+                    f"{sp['k_prior']}"
+                )
+        healed = st.get("spec_self_healed")
+        if healed:
+            print(
+                f"self-healed: {healed['from']} -> {healed['to']} at "
+                f"acceptance {healed['acceptance']}"
+            )
+        if st.get("autotune_warm_start_s") is not None:
+            print(
+                f"autotune warm start: {st['autotune_warm_start_s']}s "
+                "(flash blocks + K prior loaded, nothing re-measured)"
             )
     if args.paged:
         # shared-prefix traffic: every request opens with the same
@@ -164,8 +224,15 @@ def main() -> None:
             )
             for i, n in enumerate((5, 8, 3, 11, 6, 8))
         ]
+        ktraj = []
         for rid in rids:
             print(f"request {rid}:", sch.result(rid))
+            sp = sch.stats().get("spec") or {}
+            if sp.get("adaptive"):
+                ktraj.append(sp["k_prior"]["k"])
+        if ktraj:
+            print(f"K trajectory (learned prior per finished request): "
+                  f"{ktraj}")
         st = sch.stats()
         print(
             f"prefix hit rate {st['prefix_cache_hit_rate']:.2f} "
@@ -190,8 +257,15 @@ def main() -> None:
             sch.submit(rng.integers(0, cfg.vocab_size, (n,)), seed=i)
             for i, n in enumerate((5, 8, 3, 11, 6, 8))
         ]
+        ktraj = []
         for rid in rids:
             print(f"request {rid}:", sch.result(rid))
+            sp = sch.stats().get("spec") or {}
+            if sp.get("adaptive"):
+                ktraj.append(sp["k_prior"]["k"])
+        if ktraj:
+            print(f"K trajectory (learned prior per finished request): "
+                  f"{ktraj}")
         print("scheduler:", sch.stats())
         print_spec(sch.stats())
     else:

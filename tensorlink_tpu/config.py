@@ -215,6 +215,12 @@ class NodeConfig:
     # disk instead of re-paying XLA. None defers to the
     # TL_COMPILE_CACHE_DIR environment variable; both unset = off.
     compile_cache_dir: str | None = None
+    # persistent autotune store (runtime/autotune.py): measured
+    # flash-block overrides, prefill-bucket sets, and the adaptive-
+    # speculation K prior reload beside the compile cache, so a
+    # restart warm-starts the CONSTANTS as well as the kernels. None
+    # defers to TL_AUTOTUNE_DIR; both unset = off.
+    autotune_dir: str | None = None
 
     def __post_init__(self):
         # wire serialization (msgpack/json) round-trips tuples as lists;

@@ -197,6 +197,7 @@ def node_row(
                 f"{pool.get('num_blocks')})"
             )
     spec = serving.get("spec") or {}
+    healed = serving.get("spec_self_healed")
     if spec.get("proposed_total"):
         # speculative serving: pathological acceptance means the draft
         # (or n-gram lookup) is a bad match for this node's traffic —
@@ -204,10 +205,16 @@ def node_row(
         # the extra passes can cost more than the accepted tokens buy
         acc = float(spec.get("acceptance_rate") or 0.0)
         row["spec_accept_pct"] = round(acc * 100, 1)
-        if acc < 0.3:
+        if acc < 0.3 and not healed:
             row["flags"].append(
                 f"LOW-ACCEPT({spec.get('mode')},{acc:.2f})"
             )
+    if healed:
+        # the engine already acted on its own LOW-ACCEPT condition
+        # (dropped draft -> n-gram -> non-spec, serving.py
+        # _maybe_self_heal): the condition cleared without operator
+        # action — advisory flag replaced by the record of the fix
+        row["flags"].append(f"SELF-HEALED({healed.get('to')})")
     metrics = _route_body(scrape, "/metrics") or {}
     counters = metrics.get("counters") or {}
     row["anomalies"] = {
@@ -280,6 +287,10 @@ _HIGHER_BETTER = (
     # weight pass / higher acceptance = more tokens per weight read
     # (the decode-roofline lever); vs_nonspec is spec-over-baseline
     "tokens_per_weight_pass", "acceptance_rate", "vs_nonspec",
+    # adaptive speculation: the controller's wall-clock win over the
+    # best hand-tuned static K on the same mixed workload (> 1.0 =
+    # the measure->adapt loop pays)
+    "vs_best_static",
 )
 _LOWER_BETTER_RE = re.compile(
     r"(_s$|_s_per_call$|seconds|latency|bubble_fraction|drop_fraction"

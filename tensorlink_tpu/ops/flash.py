@@ -74,9 +74,16 @@ def set_flash_block_override(
         raise ValueError(
             f"flash block override {block} does not divide seq {seq}"
         )
-    _BLOCK_OVERRIDES[(int(seq), None if batch is None else int(batch))] = int(
-        block
-    )
+    key = (int(seq), None if batch is None else int(batch))
+    if _BLOCK_OVERRIDES.get(key) == int(block):
+        # already installed at this value: every compiled program
+        # traced the right block, so there is nothing to retrace — and
+        # skipping the clear keeps a warm autotune restart (which
+        # re-applies the same persisted overrides per engine,
+        # runtime/autotune.py) from wiping a live sibling engine's
+        # jitted programs
+        return
+    _BLOCK_OVERRIDES[key] = int(block)
     # sanctioned cache clear: overrides are read at trace time, so the
     # tuned block only takes effect if the shape retraces
     jax.clear_caches()  # tlint: disable=TL503 tuning must retrace
@@ -87,6 +94,18 @@ def clear_flash_block_overrides() -> None:
         _BLOCK_OVERRIDES.clear()
         # sanctioned: compiled programs baked the old blocks in
         jax.clear_caches()  # tlint: disable=TL503 tuning must retrace
+
+
+def flash_block_overrides() -> list[tuple[int, int | None, int]]:
+    """Snapshot of the installed overrides as ``(seq, batch|None,
+    block)`` rows — the persistable form the autotune store
+    (runtime/autotune.py) writes beside the compile cache, so a tuning
+    sweep's result survives the process that measured it."""
+    return sorted(
+        ((seq, batch, block)
+         for (seq, batch), block in _BLOCK_OVERRIDES.items()),
+        key=lambda t: (t[0], -1 if t[1] is None else t[1], t[2]),
+    )
 
 
 def flash_block_for(seq: int, batch: int | None = None) -> int:

@@ -95,7 +95,7 @@ def sample_logits(logits, key, temperature, top_k, top_p=1.0):
 
 
 def spec_verify(tgt_logits, proposals, key, temperature, top_k,
-                top_p=1.0, draft_logits=None):
+                top_p=1.0, draft_logits=None, k_live=None):
     """Speculative accept/reject for ONE row: K drafted tokens against
     the K+1 target positions of a single verify-K weight pass
     (vectorize over serving slots with ``jax.vmap``).
@@ -109,28 +109,39 @@ def spec_verify(tgt_logits, proposals, key, temperature, top_k,
     n-gram / prompt-lookup draft), i.e. a delta distribution at the
     proposal — the rejection test then degenerates to accepting with
     the target's own probability of the proposal.
+    ``k_live`` (traced scalar, 0..K, default K): how many leading
+    proposals were genuinely DRAWN for this row — the masked-K operand
+    of the adaptive controller (parallel/speculative.py). Positions at
+    or past ``k_live`` are treated as never proposed: acceptance stops
+    there and the token at position ``k_live`` is sampled from the
+    TARGET distribution directly, not the rejection residual — a
+    residual draw at a position with no real proposal would bias the
+    output, which is exactly the bug this operand exists to avoid.
 
     Returns ``(n_emit, emitted)`` with ``emitted`` [K+1]: the first
     ``n_emit`` entries extend the sequence (``emitted[i] ==
     proposals[i]`` for ``i < n_emit - 1``; the last entry is the
     correction at the first rejection, or the free bonus token when all
-    K were accepted). ``n_emit`` is always >= 1 — a verify pass never
-    yields fewer tokens than a plain decode step.
+    live proposals were accepted). ``n_emit`` is always >= 1 — a verify
+    pass never yields fewer tokens than a plain decode step.
 
     Greedy (``temperature == 0``): exact argmax match, so speculation
-    on/off is token-identical. ``temperature > 0``: standard
-    speculative rejection sampling (accept d_i with prob
-    min(1, p_tgt/p_draft); on rejection sample the clamped residual
-    max(p_tgt - p_draft, 0) renormalized) — the OUTPUT DISTRIBUTION is
-    provably the target's, whatever the draft proposes."""
+    on/off is token-identical AT ANY ``k_live`` — masking only shortens
+    the emitted prefix of the target's own greedy stream. ``temperature
+    > 0``: standard speculative rejection sampling (accept d_i with
+    prob min(1, p_tgt/p_draft); on rejection sample the clamped
+    residual max(p_tgt - p_draft, 0) renormalized) — the OUTPUT
+    DISTRIBUTION is provably the target's, whatever the draft proposes
+    and wherever the controller clamps."""
     K = proposals.shape[0]
     proposals = proposals.astype(jnp.int32)
+    kcap = jnp.asarray(K if k_live is None else k_live, jnp.int32)
     if temperature == 0.0:
         t = jnp.argmax(tgt_logits.astype(jnp.float32), -1).astype(jnp.int32)
         match = (proposals == t[:K]).astype(jnp.int32)
-        n_acc = jnp.cumprod(match).sum()
+        n_acc = jnp.minimum(jnp.cumprod(match).sum(), kcap)
         # for i < n_acc, t[i] == proposals[i]; t[n_acc] is the
-        # correction (or the bonus when n_acc == K)
+        # correction (or the bonus when n_acc == k_live)
         return n_acc + 1, t
     lt = jax.nn.log_softmax(
         _filter_logits(tgt_logits, temperature, top_k, top_p), axis=-1
@@ -152,7 +163,9 @@ def spec_verify(tgt_logits, proposals, key, temperature, top_k,
     # a proposal the filtered target excludes has lt_at = -inf -> accept
     # prob 0; min(., 0) keeps the ratio a probability
     accept = u < jnp.exp(jnp.minimum(lt_at - ld_at, 0.0))
-    n_acc = jnp.cumprod(accept.astype(jnp.int32)).sum()
+    n_acc = jnp.minimum(
+        jnp.cumprod(accept.astype(jnp.int32)).sum(), kcap
+    )
     p_t = jnp.exp(lt)  # [K+1, V]
     resid = jnp.maximum(p_t[:K] - q, 0.0)
     rs = jnp.sum(resid, axis=-1, keepdims=True)
@@ -161,6 +174,11 @@ def spec_verify(tgt_logits, proposals, key, temperature, top_k,
     # rejection branch then just resamples from p_tgt
     resid = jnp.where(rs > 0, resid / jnp.where(rs > 0, rs, 1.0), p_t[:K])
     cand = jnp.concatenate([resid, p_t[K:]], axis=0)  # [K+1, V]
+    # positions at/past k_live never held a real proposal: the emitted
+    # token there is a fresh draw from the target, not a residual
+    cand = jnp.where(
+        (jnp.arange(K + 1) < kcap)[:, None], cand, p_t
+    )
     corr = jax.random.categorical(
         kr, jnp.log(cand + 1e-38), axis=-1
     ).astype(jnp.int32)

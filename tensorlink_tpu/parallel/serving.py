@@ -78,9 +78,17 @@ from tensorlink_tpu.parallel.kvpool import (
     PrefixIndex,
 )
 from tensorlink_tpu.parallel.speculative import (
+    AdaptiveKController,
     SpecConfig,
     SpeculativeDecoder,
+    autopair_draft,
     ngram_propose,
+)
+from tensorlink_tpu.runtime.autotune import (
+    AutotuneStore,
+    apply_flash_overrides,
+    model_fingerprint,
+    store_key,
 )
 from tensorlink_tpu.runtime.compile_cache import (
     cache_entries,
@@ -95,7 +103,12 @@ __all__ = [
     "QueueFullError",
     "ServingError",
     "SpecConfig",
+    "autopair_draft",
 ]
+
+# speculation self-healing acts only after this many verified proposals
+# — a couple of unlucky first rounds must not kill a good draft
+HEAL_MIN_PROPOSED = 32
 
 # per-request acceptance-rate histogram bounds (a rate lives in [0, 1];
 # the latency-shaped default buckets would bin every value together)
@@ -183,6 +196,7 @@ class ContinuousBatchingEngine:
         draft: InferenceEngine | None = None,
         speculative: SpecConfig | bool | None = None,
         compile_cache_dir: str | None = None,
+        autotune_dir: str | None = None,
         metrics=None,
         recorder=None,
     ):
@@ -246,6 +260,14 @@ class ContinuousBatchingEngine:
         self.spec_accepted_total = 0
         self.spec_proposed_total = 0
         self.spec_fallback_total = 0
+        # LOW-ACCEPT self-healing (SpecConfig.self_heal_accept): recent
+        # acceptance EWMA + how the engine already downgraded, if it did
+        self._heal_acc: float | None = None
+        self._heal_proposed = 0
+        self.spec_self_healed: dict | None = None
+        # per-dispatch masked-K array staged by the paged step() so the
+        # block-growth bound and the dispatched operand can never skew
+        self._k_dispatch: list[int] | None = None
 
         # persistent XLA compilation cache (ROADMAP item 5): restarts
         # reuse kernels; compile events below report per-program hits
@@ -253,6 +275,32 @@ class ContinuousBatchingEngine:
             compile_cache_dir, recorder=recorder
         )
         self._cc_entries = cache_entries(self._cc_dir) if self._cc_dir else 0
+
+        # persistent autotune store (runtime/autotune.py), loaded BEFORE
+        # any program traces so persisted flash-block overrides shape
+        # the very kernels about to compile — the measured-constants
+        # side of the compile cache's warm restart
+        self.autotune_warm_start_s: float | None = None
+        self._autotune_key: str | None = None
+        self._autotune_record: dict | None = None
+        self._autotune = AutotuneStore.resolve(
+            autotune_dir, recorder=recorder
+        )
+        if self._autotune is not None:
+            self._autotune_load()
+
+        # adaptive masked-K controller: per-request effective K is a
+        # traced operand of the one spec-chunk program, chosen from the
+        # measured acceptance (and warm-started from the stored prior)
+        self._kctl: AdaptiveKController | None = None
+        if self.spec is not None and self.spec.cfg.adaptive:
+            self._kctl = AdaptiveKController(
+                self.spec.cfg,
+                # n-gram proposals are free; only the verify-width
+                # position cost should pull K down then
+                draft_cost=0.0 if self.spec.mode == "ngram" else None,
+                prior=(self._autotune_record or {}).get("k_prior"),
+            )
 
         self._state = self._init_state()
         self._decode = self._build_decode()
@@ -311,6 +359,76 @@ class ContinuousBatchingEngine:
 
     def _fill_token(self) -> int:
         return self.gen.eos_token_id if self.gen.eos_token_id is not None else 0
+
+    # ------------------------------------------------------------- autotune
+    def _autotune_buckets(self) -> tuple[int, ...]:
+        """The program-shape set this engine's tuning was measured
+        against — part of the store key, so a reconfigured engine never
+        trusts constants measured for different programs."""
+        top = min(self.L, self.engine.max_len)
+        buckets = range(self.prefill_block, top + 1, self.prefill_block)
+        return tuple(list(buckets)[: self.prefill_cache_max])
+
+    def _autotune_load(self) -> None:
+        """Load + apply the persisted tuning record for this (jax,
+        chip, model, buckets) key: flash-block overrides installed
+        (before any trace), K prior staged for the controller. A miss
+        — absent, corrupt, or stale-keyed — is a silent cold start."""
+        t0 = time.perf_counter()
+        self._autotune_key = store_key(
+            model_fingerprint(self.engine.params), self._autotune_buckets()
+        )
+        rec = self._autotune.load(self._autotune_key)
+        if rec is None:
+            return
+        applied = apply_flash_overrides(rec)
+        self._autotune_record = rec
+        self.autotune_warm_start_s = round(time.perf_counter() - t0, 4)
+        self._event(
+            "autotune.warm_start", key=self._autotune_key,
+            flash_overrides=applied,
+            has_k_prior=bool(rec.get("k_prior")),
+            warm_start_s=self.autotune_warm_start_s,
+        )
+
+    def save_autotune(self, **extra) -> str | None:
+        """Persist this process's measured knobs — the installed
+        flash-block overrides, this engine's bucket set, the adaptive
+        controller's K posterior, plus any caller extras (e.g. the
+        ``autopair_draft`` verdict's JSON-safe ``["persistable"]`` form
+        as ``draft_pair=``). Non-serializable extras are dropped with a
+        warn event, never allowed to crash the save — persisting tuning
+        is telemetry-grade, not load-bearing. Returns the written path,
+        or None when no store is configured. Explicit on purpose: a
+        loader must be able to trust that a warm start byte-identically
+        re-reads what the measuring process wrote."""
+        if self._autotune is None:
+            return None
+        import json
+
+        from tensorlink_tpu.ops.flash import flash_block_overrides
+
+        with self._lock:  # a self-heal may be swapping the controller
+            rec = {
+                "flash_blocks": [list(t) for t in flash_block_overrides()],
+                "prefill_buckets": list(self._autotune_buckets()),
+            }
+            if self._kctl is not None:
+                rec["k_prior"] = self._kctl.prior()
+        for k, v in extra.items():
+            try:
+                json.dumps(v)
+            except TypeError:
+                self._event(
+                    "autotune.extra_dropped", "warn", key=k,
+                    type=type(v).__name__,
+                )
+                continue
+            rec[k] = v
+        key = self._autotune_key or store_key(
+            model_fingerprint(self.engine.params), self._autotune_buckets()
+        )
+        return str(self._autotune.save(key, rec))
 
     # ------------------------------------------------------------- programs
     def _build_decode(self):
@@ -392,14 +510,6 @@ class ContinuousBatchingEngine:
         ar = jnp.arange(self.L)[None, :]
         return (state["valid"] | (ar >= f0[:, None]))[:, None, None, :]
 
-    @property
-    def _chunk_advance(self) -> int:
-        """Max tokens one dispatched chunk advances a live row by (the
-        paged engine grows block tables ahead of dispatch by this)."""
-        if self.spec is not None:
-            return self.spec.cfg.rounds * (self.spec.cfg.k + 1)
-        return self.decode_chunk
-
     def _build_spec_chunk(self):
         """ONE jitted program for speculative serving: ``rounds`` rounds
         of draft-K + verify-K-in-one-target-weight-pass, whole state
@@ -411,12 +521,23 @@ class ContinuousBatchingEngine:
         overwrites them before reading (nn/attention.py T>1 per-row
         path / the paged path's logical-coordinate causality).
 
+        MASKED K: the program is compiled at ``k_max = cfg.k`` proposal
+        width, and a per-row effective K rides in as the TRACED operand
+        ``k_eff [S]`` — the adaptive controller changes a request's K
+        between dispatches without a single retrace (tlint TL501 /
+        tlhlo TLH105: still ONE spec program per engine). Row ``s``
+        spends at most ``k_eff[s]`` proposals per round; the draft
+        scan's entropy early-exit can retire a row even earlier
+        (``k_live <= k_eff``), and ``spec_verify``'s own k_live clamp
+        keeps the output distribution exactly the target's at any mask.
+
         Outputs per dispatch: ``toks [R, K+1, S]``, ``n_emit [R, S]``
         (0 marks a row that was not live that round — the host's
         liveness signal), ``n_acc [R, S]`` (accepted proposals BEFORE
-        the EOS/budget clips — the draft-quality signal), and
-        ``fallback [R, S]`` (n-gram rows that found no match and
-        burned the pass)."""
+        the EOS/budget clips — the draft-quality signal), ``fallback
+        [R, S]`` (n-gram rows that found no match and burned the
+        pass), and ``n_prop [R, S]`` (proposals the row actually stood
+        behind — the acceptance-rate denominator under masking)."""
         eng, spec = self.engine, self.spec
         model, S, L = eng.model, self.slots, self.L
         K, R = spec.cfg.k, spec.cfg.rounds
@@ -428,7 +549,7 @@ class ContinuousBatchingEngine:
         draft_mode = spec.mode == "draft"
         draft_fn = spec.build_draft_fn(gen) if draft_mode else None
 
-        def round_fn(params, dparams, state):
+        def round_fn(params, dparams, state, k_eff):
             caches, valid = state["caches"], state["valid"]
             live, tok = state["live"], state["tok"]
             n_valid, remaining = state["n_valid"], state["remaining"]
@@ -436,8 +557,9 @@ class ContinuousBatchingEngine:
             f0 = _cache_index(caches)  # [S] target write frontier
             open_mask = self._spec_open_mask(state, f0)
             if draft_mode:
-                props, dlg, dcaches = draft_fn(
-                    dparams, state["draft"], tok, n_valid, seed, open_mask
+                props, dlg, dcaches, k_live = draft_fn(
+                    dparams, state["draft"], tok, n_valid, seed,
+                    open_mask, k_eff, live,
                 )
                 fb = jnp.zeros((S,), bool)
             else:
@@ -446,6 +568,7 @@ class ContinuousBatchingEngine:
                 )
                 dlg = None
                 fb = live & ~found
+                k_live = k_eff  # no draft distribution to early-exit on
             # ONE target weight pass verifies all K proposals (+ the
             # bonus position): feed [tok, d_1..d_K]
             toks_in = jnp.concatenate([tok[:, None], props], axis=1)
@@ -455,30 +578,36 @@ class ContinuousBatchingEngine:
                 mask=open_mask,
             )
             if dlg is None:
-                def vrow(lg, pr, s, n):
+                def vrow(lg, pr, s, n, kl):
                     return spec_verify(
                         lg, pr, spec.verify_key(s, n),
-                        temperature, top_k, top_p,
-                    )
-
-                n_raw, emitted = jax.vmap(vrow)(logits, props, seed, n_valid)
-            else:
-                def vrow(lg, pr, dl, s, n):
-                    return spec_verify(
-                        lg, pr, spec.verify_key(s, n),
-                        temperature, top_k, top_p, draft_logits=dl,
+                        temperature, top_k, top_p, k_live=kl,
                     )
 
                 n_raw, emitted = jax.vmap(vrow)(
-                    logits, props, dlg, seed, n_valid
+                    logits, props, seed, n_valid, k_live
+                )
+            else:
+                def vrow(lg, pr, dl, s, n, kl):
+                    return spec_verify(
+                        lg, pr, spec.verify_key(s, n),
+                        temperature, top_k, top_p, draft_logits=dl,
+                        k_live=kl,
+                    )
+
+                n_raw, emitted = jax.vmap(vrow)(
+                    logits, props, dlg, seed, n_valid, k_live
                 )
             idxk = jnp.arange(K + 1)
             # draft-quality truth BEFORE the EOS/budget clips below: a
             # clipped emission is the REQUEST ending, not the draft
             # being wrong — charging it as rejection would deflate
             # acceptance_rate (and trip tldiag LOW-ACCEPT) on
-            # short-generation traffic with a perfectly good draft
-            n_acc = jnp.where(live, jnp.minimum(n_raw - 1, K), 0)
+            # short-generation traffic with a perfectly good draft.
+            # (spec_verify already capped n_raw - 1 at k_live, so a
+            # masked position is neither accepted nor attempted.)
+            n_acc = jnp.where(live, n_raw - 1, 0)
+            n_prop = jnp.where(live, k_live, 0).astype(jnp.int32)
             if eos is not None:
                 hit = (emitted == eos) & (idxk[None, :] < n_raw[:, None])
                 eos_pos = jnp.min(
@@ -520,16 +649,59 @@ class ContinuousBatchingEngine:
                 new_state["ids"] = state["ids"].at[
                     rows, f0[:, None] + idxk[None, :]
                 ].set(toks_in, mode="drop")
-            return new_state, (emitted.T, n_emit, n_acc.astype(jnp.int32), fb)
+            return new_state, (
+                emitted.T, n_emit, n_acc.astype(jnp.int32), fb, n_prop,
+            )
 
-        def chunk(params, dparams, state):
+        def chunk(params, dparams, state, k_eff):
+            # guard garbage input: the device contract below (emission
+            # and block growth both bounded by k_eff + 1) only holds
+            # inside [1, K]
+            k_eff = jnp.clip(k_eff.astype(jnp.int32), 1, K)
             state, out = jax.lax.scan(
-                lambda st, _: round_fn(params, dparams, st),
+                lambda st, _: round_fn(params, dparams, st, k_eff),
                 state, None, length=R,
             )
             return (state, *out)
 
         return self._jit_program(chunk)
+
+    def _spec_k_array(self) -> list[int]:
+        """Per-slot effective K for the NEXT dispatched spec chunk:
+        the controller's per-request choice for occupied slots, k_max
+        for free/parked rows (their k is never consumed — the device
+        masks by liveness)."""
+        K = self.spec.cfg.k
+        if self._kctl is None:
+            return [K] * self.slots
+        return [
+            K if r is None else min(self._kctl.k_for(r.rid), K)
+            for r in self._slot_req
+        ]
+
+    def _decode_extra(self) -> tuple:
+        """Trailing traced operands of the decode/spec program — the
+        masked-K array under speculation, nothing otherwise. Consumes
+        the step()-staged array when one exists so the paged engine's
+        block-growth bound and the dispatched operand can never skew
+        (a drain between the two may move the controller)."""
+        if self.spec is None:
+            return ()
+        ks = self._k_dispatch
+        self._k_dispatch = None
+        if ks is None:
+            ks = self._spec_k_array()
+        if self._kctl is not None:
+            # count only rows live on THIS chunk: a slot mid-chunked-
+            # prefill occupies _slot_req but emits nothing, and would
+            # bias k_mean toward the prior whenever prefill overlaps
+            # decode (the common paged regime)
+            pending = self._pending_slots()
+            self._kctl.note_dispatch(
+                k for s, (r, k) in enumerate(zip(self._slot_req, ks))
+                if r is not None and s not in pending
+            )
+        return (jnp.asarray(np.asarray(ks, np.int32)),)
 
     def _jit_program(self, fn):
         """jit one serving program written as ``fn(params, dparams,
@@ -549,8 +721,8 @@ class ContinuousBatchingEngine:
     def _dispatch_decode(self) -> tuple:
         """Dispatch one decode/spec chunk; returns the device payload
         for the in-flight queue ((toks,) plain, (toks, n_emit, n_acc,
-        fallback) speculative)."""
-        out = self._decode(*self._program_args())
+        fallback, n_prop) speculative)."""
+        out = self._decode(*self._program_args(), *self._decode_extra())
         self._state = out[0]
         return out[1:]
 
@@ -698,19 +870,28 @@ class ContinuousBatchingEngine:
         aot = True
         try:
             self._decode = self._decode.lower(
-                *self._program_args()
+                *self._program_args(), *self._decode_extra()
             ).compile()
         except Exception:  # noqa: BLE001 — fall back to lazy jit
             aot = False
         self._record_compile("decode", t0, aot)
-        top = min(self.L, self.engine.max_len)
-        buckets = range(self.prefill_block, top + 1, self.prefill_block)
-        for Tp in list(buckets)[: self.prefill_cache_max]:
+        # the same bucket set the autotune store keys on — one
+        # computation on purpose, so persisted tuning can never key on
+        # a different set than the engine actually warms
+        for Tp in self._autotune_buckets():
             self._get_prefill(Tp)
 
     # ---------------------------------------------------------------- audit
     def _audit_dtype(self) -> str:
         return declared_compute_dtype(self.engine.params)
+
+    def _audit_decode_extra(self) -> tuple:
+        """Side-effect-free stand-in for ``_decode_extra`` (same avals):
+        auditing a live engine must not feed the controller's dispatch
+        accounting or steal a staged masked-K array."""
+        if self.spec is None:
+            return ()
+        return (jnp.full((self.slots,), self.spec.cfg.k, jnp.int32),)
 
     def audit_programs(self) -> list[dict]:
         """Compiled-program inventory for tlhlo (analysis/hlo.py): one
@@ -725,11 +906,13 @@ class ContinuousBatchingEngine:
         with self._lock:  # snapshot the state tree vs in-flight chunks
             donated = len(jax.tree.leaves(self._state))
             args = self._program_args()
+            extra = self._audit_decode_extra()
+            spec_on = self.spec is not None  # a self-heal may swap it
         progs = [{
-            "name": "spec_chunk" if self.spec is not None else "decode",
+            "name": "spec_chunk" if spec_on else "decode",
             "dtype": dt,
             "donated": donated,
-            "lower": lambda: self._build_decode().lower(*args),
+            "lower": lambda: self._build_decode().lower(*args, *extra),
         }]
         Tp = self._bucket(1)  # smallest prefill bucket
         i32 = jnp.int32
@@ -745,8 +928,7 @@ class ContinuousBatchingEngine:
             # a speculative engine's prefill is a DIFFERENT program
             # (it grafts the draft cache / n-gram ids into the larger
             # donated tree) — name it apart so both get audited
-            "name": f"prefill_b{Tp}"
-            + ("_spec" if self.spec is not None else ""),
+            "name": f"prefill_b{Tp}" + ("_spec" if spec_on else ""),
             "dtype": dt,
             "donated": donated,
             "lower": lower_prefill,
@@ -813,8 +995,14 @@ class ContinuousBatchingEngine:
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         t0 = int(ids.size)
-        self._check_fit(t0, max_new)
         with self._lock:
+            # a due mode downgrade applies BEFORE this prompt admits:
+            # the new request must not prefill into a program the
+            # engine has already measured as a loss
+            self._maybe_self_heal()
+            # under the lock: the paged fit check reads the block pool,
+            # which a concurrent self-heal rebuild swaps (tlint TL601)
+            self._check_fit(t0, max_new)
             # fill free slots first so max_queue bounds genuinely
             # WAITING work, not work a free slot could take right now
             self._admit_waiting()
@@ -912,6 +1100,10 @@ class ContinuousBatchingEngine:
         if slot is not None and self._slot_req[slot] is req:
             self._slot_req[slot] = None
             self._free.append(slot)
+        if self._kctl is not None:
+            # fold the finished request's acceptance into the prior the
+            # next request starts from (and the autotune store persists)
+            self._kctl.forget(req.rid)
         # bounded result retention: results stay readable (result() is
         # idempotent) until keep_results newer requests finished — a
         # steady-traffic scheduler must not grow host memory forever
@@ -969,14 +1161,18 @@ class ContinuousBatchingEngine:
         ``n_emit [R, S]`` (0 = the row was not live that round), with
         ``n_acc [R, S]`` the verifier's PRE-CLIP accepted-proposal
         count (EOS/budget truncation is the request ending, not a
-        rejection). Per live (row, round) pair tokens-per-weight-pass
-        is exactly ``n_emit``; acceptance rate comes from ``n_acc``."""
+        rejection) and ``n_prop [R, S]`` the proposals the row actually
+        stood behind (== k under static K; < k when the controller
+        masked or the draft early-exited). Per live (row, round) pair
+        tokens-per-weight-pass is exactly ``n_emit``; acceptance rate
+        is ``n_acc / n_prop`` — and the same ratio feeds the adaptive
+        controller, closing the measure→adapt loop per request."""
         toks = np.asarray(payload[0])  # THE host sync point
         ne = np.asarray(payload[1])
         na = np.asarray(payload[2])
         fb = np.asarray(payload[3])
-        K = self.spec.cfg.k
-        rounds = emitted = accepted = rejected = 0
+        nprop = np.asarray(payload[4])
+        rounds = emitted = accepted = rejected = proposed = 0
         for r in range(toks.shape[0]):
             for s, req in enumerate(snapshot):
                 cnt = int(ne[r, s])
@@ -985,11 +1181,15 @@ class ContinuousBatchingEngine:
                 rounds += 1
                 emitted += cnt
                 acc = int(na[r, s])
+                prop = int(nprop[r, s])
                 accepted += acc
-                rejected += K - acc
+                rejected += prop - acc
+                proposed += prop
+                if self._kctl is not None:
+                    self._kctl.observe(req.rid, prop, acc)
                 if not req.done:
                     req.spec_rounds += 1
-                    req.spec_proposed += K
+                    req.spec_proposed += prop
                     req.spec_accepted += acc
                 for k in range(cnt):
                     if req.done:
@@ -998,9 +1198,20 @@ class ContinuousBatchingEngine:
         self.spec_rounds_total += rounds
         self.spec_emitted_total += emitted
         self.spec_accepted_total += accepted
-        self.spec_proposed_total += rounds * K
+        self.spec_proposed_total += proposed
         nfb = int(fb.sum())
         self.spec_fallback_total += nfb
+        if proposed and self.spec.cfg.self_heal_accept is not None:
+            # recent-acceptance EWMA for the self-healing gate — the
+            # lifetime totals above would take forever to reflect a
+            # draft that went bad mid-flight (or was always bad)
+            lam = self.spec.cfg.ewma
+            a = accepted / proposed
+            self._heal_acc = (
+                a if self._heal_acc is None
+                else (1.0 - lam) * self._heal_acc + lam * a
+            )
+            self._heal_proposed += proposed
         if self.metrics is not None:
             if accepted:
                 self.metrics.incr("spec_accepted_total", accepted)
@@ -1008,6 +1219,74 @@ class ContinuousBatchingEngine:
                 self.metrics.incr("spec_rejected_total", rejected)
             if nfb:
                 self.metrics.incr("spec_fallback_total", nfb)
+
+    def _maybe_self_heal(self) -> None:
+        """The tldiag LOW-ACCEPT flag made self-healing (ROADMAP item
+        3): when the recent-acceptance EWMA sits below
+        ``SpecConfig.self_heal_accept`` after at least
+        ``HEAL_MIN_PROPOSED`` verified proposals, the engine downgrades
+        its own speculation mode — draft -> n-gram -> off — instead of
+        waiting for an operator to read the cluster table. Every
+        rejected proposal was a wasted draft step; below ~0.3 the extra
+        passes cost more than the accepted tokens buy.
+
+        Only fires DEVICE-IDLE (no live slots, no in-flight chunks, no
+        mid-prefill work): the mode swap rebuilds the donated state and
+        the one decode program, which must never yank buffers from
+        under a dispatched chunk. Queued requests are fine — they admit
+        under the new mode. Mode counters reset so the cleared
+        condition is measurable; the history lives in the
+        ``serving.spec_self_heal`` event and ``stats()
+        ["spec_self_healed"]``. Caller holds the scheduler lock."""
+        spec = self.spec
+        if spec is None or spec.cfg.self_heal_accept is None:
+            return
+        if self._heal_acc is None or self._heal_proposed < HEAL_MIN_PROPOSED:
+            return
+        if self._heal_acc >= spec.cfg.self_heal_accept:
+            return
+        if any(r is not None for r in self._slot_req) or self._inflight:
+            return
+        if self._pending_prefills():
+            return
+        frm, to = spec.mode, "ngram" if spec.mode == "draft" else "nonspec"
+        healed = {
+            "from": frm, "to": to,
+            "acceptance": round(self._heal_acc, 4),
+            "proposed": self._heal_proposed,
+        }
+        self._event("serving.spec_self_heal", "warn", **healed)
+        if self.metrics is not None:
+            self.metrics.incr("spec_self_heal_total")
+        self.spec_self_healed = healed
+        if to == "ngram":
+            self.spec = SpeculativeDecoder(self.engine, None, spec.cfg)
+            if self._kctl is not None:
+                # fresh controller: proposals are free now and the bad
+                # draft's acceptance prior says nothing about n-gram
+                self._kctl = AdaptiveKController(spec.cfg, draft_cost=0.0)
+        else:
+            self.spec = None
+            self._kctl = None
+        self.spec_rounds_total = 0
+        self.spec_emitted_total = 0
+        self.spec_accepted_total = 0
+        self.spec_proposed_total = 0
+        self.spec_fallback_total = 0
+        self._heal_acc = None
+        self._heal_proposed = 0
+        self._k_dispatch = None
+        # rebuild the (one) decode program and donated state for the
+        # new mode; the prefill closures capture the spec tree too
+        self._state = self._init_state()
+        self._decode = self._build_decode()
+        self._prefill_jit.clear()
+
+    def _pending_prefills(self) -> int:
+        return 0  # the paged engine overrides (chunked prefill queue)
+
+    def _pending_slots(self):
+        return ()  # paged: the slots still mid-chunked-prefill
 
     def _take_first(self, req: _Request) -> None:
         """Fold the prefill's first token into the stream (syncs a
@@ -1029,6 +1308,7 @@ class ContinuousBatchingEngine:
         ``pipeline_depth`` are in flight. Returns False when fully idle
         (nothing queued, running, or in flight)."""
         with self._lock:
+            self._maybe_self_heal()
             self._admit_waiting()
             busy = any(r is not None for r in self._slot_req)
             if busy:
@@ -1039,6 +1319,8 @@ class ContinuousBatchingEngine:
                     self._maybe_record_ttft(r)
             while len(self._inflight) > (self.pipeline_depth if busy else 0):
                 self._drain_one()
+            if not busy:
+                self._maybe_self_heal()  # just drained fully idle
             return bool(
                 busy or self._queue or self._inflight
             )
@@ -1104,7 +1386,7 @@ class ContinuousBatchingEngine:
         roofline win."""
         prop = self.spec_proposed_total
         wp = self.spec_rounds_total
-        return {
+        out = {
             "mode": self.spec.mode,
             "k": self.spec.cfg.k,
             "rounds": self.spec.cfg.rounds,
@@ -1128,6 +1410,13 @@ class ContinuousBatchingEngine:
                 if r is not None and r.spec_proposed
             },
         }
+        out["adaptive"] = self._kctl is not None
+        if self._kctl is not None:
+            # the controller's live picture: mean dispatched K and the
+            # persistable posterior (what save_autotune would write)
+            out["k_mean"] = round(self._kctl.k_mean(), 3)
+            out["k_prior"] = self._kctl.prior()
+        return out
 
     def stats(self) -> dict:
         """Host-side scheduler snapshot (queue depth, slot occupancy)."""
@@ -1143,6 +1432,12 @@ class ContinuousBatchingEngine:
             }
             if self.spec is not None:
                 out["spec"] = self._spec_stats()
+            if self.spec_self_healed is not None:
+                # survives even after spec drops to None — tldiag reads
+                # this to render SELF-HEALED(mode) instead of LOW-ACCEPT
+                out["spec_self_healed"] = self.spec_self_healed
+            if self.autotune_warm_start_s is not None:
+                out["autotune_warm_start_s"] = self.autotune_warm_start_s
             return out
 
 
@@ -1476,7 +1771,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         i32 = jnp.int32
         sds = jax.ShapeDtypeStruct
         plans = (
-            ("decode", "_decode", self._program_args()),
+            ("decode", "_decode",
+             (*self._program_args(), *self._audit_decode_extra())),
             (
                 "prefill_chunk", "_prefill_chunk_fn",
                 (
@@ -1507,6 +1803,17 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         no caller mask at all."""
         return None
 
+    def _pending_prefills(self) -> int:
+        return len(self._pending)
+
+    def _pending_slots(self):
+        return self._pending  # dict keyed by slot — membership is O(1)
+
+    def _autotune_buckets(self) -> tuple[int, ...]:
+        # ONE shape-static prefill-chunk program serves every prompt:
+        # the chunk width IS the bucket set
+        return (self.prefill_chunk,)
+
     def audit_programs(self) -> list[dict]:
         """Paged inventory: the (single) decode/spec chunk plus the ONE
         shape-static prefill-chunk program that serves every prompt
@@ -1516,6 +1823,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         with self._lock:  # snapshot the state tree vs in-flight chunks
             donated = len(jax.tree.leaves(self._state))
             args = self._program_args()
+            extra = self._audit_decode_extra()
+            spec_on = self.spec is not None  # a self-heal may swap it
         i32 = jnp.int32
         sds = jax.ShapeDtypeStruct
 
@@ -1528,17 +1837,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
         return [
             {
-                "name": (
-                    "spec_chunk" if self.spec is not None else "decode"
-                ),
+                "name": "spec_chunk" if spec_on else "decode",
                 "dtype": dt,
                 "donated": donated,
-                "lower": lambda: self._build_decode().lower(*args),
+                "lower": lambda: self._build_decode().lower(*args, *extra),
             },
             {
                 # distinct per spec mode, like the contiguous prefill
-                "name": "prefill_chunk"
-                + ("_spec" if self.spec is not None else ""),
+                "name": "prefill_chunk" + ("_spec" if spec_on else ""),
                 "dtype": dt,
                 "donated": donated,
                 "lower": lower_chunk,
@@ -1812,10 +2118,28 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     max(victims, key=lambda s: self._slot_req[s].rid)
                 )
 
+    def _advance_bound(self, slot: int) -> int:
+        """Max tokens the NEXT dispatched chunk can advance this slot
+        by. Under adaptive speculation this reads the step()-staged
+        masked-K array — the device clamps each round's emission at
+        ``k_eff + 1`` for exactly the ``k_eff`` that array will carry,
+        so the bound is simultaneously SAFE (never below what the
+        device can write) and TIGHT (a low-acceptance row the
+        controller shrank to k_min reserves ``rounds * (k_min + 1)``
+        positions, not ``rounds * (k_max + 1)`` — the `_slot_ub`
+        overshoot the static bound paid for tokens that never
+        arrived)."""
+        if self.spec is None:
+            return self.decode_chunk
+        k = self.spec.cfg.k
+        if self._k_dispatch is not None:
+            k = self._k_dispatch[slot]
+        return self.spec.cfg.rounds * (k + 1)
+
     def _grow_blocks(self, decoding: list[int]) -> list[int]:
         """Extend block tables ahead of the decode write frontier: the
-        next chunk advances each live row by up to ``_chunk_advance``
-        positions (``decode_chunk``, or ``rounds * (k+1)`` under
+        next chunk advances each live row by up to ``_advance_bound``
+        positions (``decode_chunk``, or ``rounds * (k_eff+1)`` under
         speculation) with NO host sync, so the blocks must exist before
         dispatch. Returns the decoding set minus any preempted slots.
 
@@ -1828,14 +2152,17 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         leave table entries at the sentinel and the device would DROP
         that token's k/v — silent output corruption, vs. bounded
         padding (the bound saturates at the request's own
-        prompt+budget limit, and preemption handles real pressure)."""
+        prompt+budget limit, and preemption handles real pressure).
+        The adaptive controller tightens the bound the SAFE way: it
+        shrinks what the device may emit, then reserves exactly
+        that."""
         bs = self.block_size
         for slot in decoding:
             req = self._slot_req[slot]
             if req is None or slot in self._pending:
                 continue  # preempted (or re-queued) by an earlier growth
             target = min(
-                self._slot_ub[slot] + self._chunk_advance,
+                self._slot_ub[slot] + self._advance_bound(slot),
                 self._slot_limit[slot],
             )
             need = -(-target // bs)
@@ -1856,12 +2183,20 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         """One scheduler iteration: admit, dispatch at most one prefill
         chunk, grow block tables, dispatch one decode chunk, drain."""
         with self._lock:
+            self._maybe_self_heal()
             self._admit_waiting()
             prefilling = self._dispatch_prefill_chunk()
             decoding = [
                 s for s, r in enumerate(self._slot_req)
                 if r is not None and s not in self._pending
             ]
+            if decoding and self.spec is not None:
+                # stage the masked-K array NOW: block growth below and
+                # the dispatch's k_eff operand must read the SAME
+                # values, or a controller update from a preemption
+                # drain could widen the device's bound past the blocks
+                # just grown
+                self._k_dispatch = self._spec_k_array()
             if decoding:
                 decoding = self._grow_blocks(decoding)
             if decoding:
@@ -1877,9 +2212,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             for r in self._slot_req:
                 if r is not None:
                     self._maybe_record_ttft(r)
+            # an undispatched staged array must not leak into a later
+            # step whose controller has moved on
+            self._k_dispatch = None
             busy = bool(decoding or prefilling)
             while len(self._inflight) > (self.pipeline_depth if busy else 0):
                 self._drain_one()
+            if not busy:
+                self._maybe_self_heal()  # just drained fully idle
             self.peak_blocks_in_use = max(
                 self.peak_blocks_in_use, self.pool.in_use
             )

@@ -37,14 +37,23 @@ exactly the target's.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tensorlink_tpu.parallel.inference import sample_logits
 
-__all__ = ["SpecConfig", "SpeculativeDecoder", "ngram_propose"]
+__all__ = [
+    "AdaptiveKController",
+    "SpecConfig",
+    "SpeculativeDecoder",
+    "autopair_draft",
+    "default_draft_candidates",
+    "ngram_propose",
+]
 
 # RNG stream salts: speculation draws (draft proposals, accept/reject
 # uniforms + residual resampling) must not collide with the engine's
@@ -52,18 +61,58 @@ __all__ = ["SpecConfig", "SpeculativeDecoder", "ngram_propose"]
 SALT_DRAFT = 0x5D
 SALT_VERIFY = 0x5E
 
+# fixed per-extra-verify-position cost the controller charges on top of
+# the draft steps: the verify pass is one weight read whatever K is,
+# but each drafted position still pays attention/logits compute and
+# _slot_ub block reservations — without this, a free proposer (n-gram)
+# would pin K at k_max even at zero acceptance
+POSITION_COST = 0.02
+
 
 @dataclass(frozen=True)
 class SpecConfig:
     """``k``: drafted tokens per verify pass (each pass emits 1..k+1
-    tokens). ``rounds``: (draft + verify) rounds per dispatched chunk —
-    the spec analogue of ``decode_chunk``; one dispatch advances a live
-    row by up to ``rounds * (k + 1)`` tokens. ``ngram``: match length
-    for prompt-lookup drafting (draft-model mode ignores it)."""
+    tokens); under the adaptive controller this is ``k_max``, the
+    compiled proposal width. ``rounds``: (draft + verify) rounds per
+    dispatched chunk — the spec analogue of ``decode_chunk``; one
+    dispatch advances a live row by up to ``rounds * (k + 1)`` tokens.
+    ``ngram``: match length for prompt-lookup drafting (draft-model
+    mode ignores it).
+
+    Adaptive knobs (all default OFF — a plain SpecConfig behaves
+    exactly like the static PR-7 one):
+
+    - ``adaptive``: per-request masked K — each row's effective K is a
+      TRACED operand of the one spec-chunk program, chosen online by
+      :class:`AdaptiveKController` from that request's measured
+      acceptance. No retrace, no second program.
+    - ``k_min``: controller floor (>= 1; a verify pass always emits at
+      least one token anyway).
+    - ``entropy_exit``: draft-model early exit — when the draft's own
+      token entropy (nats) spikes past this at some step, the row
+      stops proposing there and later positions are treated as never
+      proposed (the verifier would reject them; the draft stops paying
+      for them). None = off. n-gram mode ignores it (no draft
+      distribution to measure).
+    - ``self_heal_accept``: acceptance floor below which the ENGINE
+      downgrades its speculation mode (draft -> n-gram -> off) at the
+      next idle point — the tldiag LOW-ACCEPT flag made self-healing.
+      None = advisory only.
+    - ``ewma``: smoothing of the controller's acceptance estimate.
+    - ``draft_cost``: one draft step's cost relative to a target
+      weight pass (the controller's cost model; auto-pairing replaces
+      it with the measured value).
+    """
 
     k: int = 4
     rounds: int = 2
     ngram: int = 2
+    adaptive: bool = False
+    k_min: int = 1
+    entropy_exit: float | None = None
+    self_heal_accept: float | None = None
+    ewma: float = 0.25
+    draft_cost: float = 0.5
 
     def __post_init__(self):
         if self.k < 1:
@@ -75,6 +124,139 @@ class SpecConfig:
                 f"ngram must be >= 2 (1 would match every token), "
                 f"got {self.ngram}"
             )
+        if not 1 <= self.k_min <= self.k:
+            raise ValueError(
+                f"k_min must be in [1, k={self.k}], got {self.k_min}"
+            )
+        if self.entropy_exit is not None and self.entropy_exit <= 0:
+            raise ValueError(
+                f"entropy_exit must be > 0 nats, got {self.entropy_exit}"
+            )
+        if self.self_heal_accept is not None and not (
+            0.0 < self.self_heal_accept < 1.0
+        ):
+            raise ValueError(
+                f"self_heal_accept must be in (0, 1), "
+                f"got {self.self_heal_accept}"
+            )
+        if not 0.0 < self.ewma <= 1.0:
+            raise ValueError(f"ewma must be in (0, 1], got {self.ewma}")
+        if self.draft_cost < 0.0:
+            raise ValueError(
+                f"draft_cost must be >= 0, got {self.draft_cost}"
+            )
+
+    @classmethod
+    def auto(cls, k: int = 4, **kw) -> "SpecConfig":
+        """The self-tuning preset: adaptive K, draft early exit at 2.5
+        nats (well past a confident head, well under uniform for any
+        real vocab), and LOW-ACCEPT self-healing at tldiag's own 0.3
+        threshold."""
+        kw.setdefault("adaptive", True)
+        kw.setdefault("entropy_exit", 2.5)
+        kw.setdefault("self_heal_accept", 0.3)
+        return cls(k=k, **kw)
+
+
+class AdaptiveKController:
+    """Per-request masked-K controller: turns the measured acceptance
+    already flowing into ``stats()["spec"]`` back into the next
+    dispatch's per-row effective K.
+
+    Model: per-token acceptance ``a`` makes a K-proposal round emit
+    ``e(a, k) = (1 - a^(k+1)) / (1 - a)`` expected tokens for a cost of
+    one target pass plus ``k + 1`` draft steps at ``draft_cost`` each
+    (plus POSITION_COST per drafted position). The controller picks the
+    ``k`` in ``[k_min, k_max]`` maximizing expected tokens per cost,
+    per request, from an EWMA of that request's own acceptance (new
+    requests start from the cross-request prior, which the autotune
+    store can seed across restarts — runtime/autotune.py)."""
+
+    def __init__(self, cfg: SpecConfig, *, draft_cost: float | None = None,
+                 prior: dict | None = None):
+        self.cfg = cfg
+        self.draft_cost = (
+            float(draft_cost) if draft_cost is not None else cfg.draft_cost
+        )
+        self._acc: dict[int, float] = {}  # rid -> acceptance EWMA
+        # cross-request prior: what a fresh request starts from
+        self.prior_acceptance = 0.6
+        if prior:
+            a = prior.get("acceptance")
+            if isinstance(a, (int, float)) and 0.0 <= a <= 1.0:
+                self.prior_acceptance = float(a)
+            c = prior.get("draft_cost")
+            if draft_cost is None and isinstance(c, (int, float)) and c >= 0:
+                self.draft_cost = float(c)
+        self._k_cache: dict[int, int] = {}  # milli-acceptance -> k
+        self.k_dispatched = 0  # sum of k over dispatched (row, round)s
+        self.rounds_dispatched = 0
+
+    # ------------------------------------------------------------ law
+    def k_for_acceptance(self, a: float) -> int:
+        key = int(round(min(max(a, 0.0), 0.999) * 1000))
+        k = self._k_cache.get(key)
+        if k is None:
+            k = self._argmax_k(key / 1000.0)
+            self._k_cache[key] = k
+        return k
+
+    def _argmax_k(self, a: float) -> int:
+        best_k, best = self.cfg.k_min, -1.0
+        for k in range(self.cfg.k_min, self.cfg.k + 1):
+            if a >= 0.999:
+                e = float(k + 1)
+            else:
+                e = (1.0 - a ** (k + 1)) / (1.0 - a)
+            cost = 1.0 + self.draft_cost * (k + 1) + POSITION_COST * k
+            v = e / cost
+            if v > best + 1e-9:  # ties go to the smaller k
+                best_k, best = k, v
+        return best_k
+
+    # ------------------------------------------------------- feedback
+    def k_for(self, rid: int) -> int:
+        return self.k_for_acceptance(self._acc.get(rid, self.prior_acceptance))
+
+    def observe(self, rid: int, proposed: int, accepted: int) -> None:
+        """One drained verify round's truth for one request. ``proposed``
+        may be < k (early exit) or 0 (fully exited round — no signal)."""
+        if proposed <= 0:
+            return
+        lam = self.cfg.ewma
+        a = accepted / proposed
+        cur = self._acc.get(rid, self.prior_acceptance)
+        self._acc[rid] = (1.0 - lam) * cur + lam * a
+
+    def forget(self, rid: int) -> None:
+        """Fold a finished request's estimate into the prior and drop
+        its per-request state."""
+        a = self._acc.pop(rid, None)
+        if a is not None:
+            lam = self.cfg.ewma
+            self.prior_acceptance = (
+                (1.0 - lam) * self.prior_acceptance + lam * a
+            )
+
+    def note_dispatch(self, ks) -> None:
+        for k in ks:
+            self.k_dispatched += int(k)
+            self.rounds_dispatched += 1
+
+    # ---------------------------------------------------------- stats
+    def k_mean(self) -> float:
+        if not self.rounds_dispatched:
+            return float(self.k_for_acceptance(self.prior_acceptance))
+        return self.k_dispatched / self.rounds_dispatched
+
+    def prior(self) -> dict:
+        """The persistable posterior (runtime/autotune.py ``k_prior``):
+        what a restarted engine should start its controller from."""
+        return {
+            "k": self.k_for_acceptance(self.prior_acceptance),
+            "acceptance": round(self.prior_acceptance, 4),
+            "draft_cost": round(self.draft_cost, 4),
+        }
 
 
 def ngram_propose(ids, valid, index, tok, k: int, n: int):
@@ -175,26 +357,74 @@ class SpeculativeDecoder:
     def build_draft_fn(self, gen):
         """Traced K+1-step draft scan: feeds ``tok`` then its own
         proposals through the draft model's per-slot cache, returning
-        ``(proposals [S, K], draft_logits [S, K, V], new_caches)``.
+        ``(proposals [S, K], draft_logits [S, K, V], new_caches,
+        k_live [S])``.
 
         The scan runs K+1 steps (not K): the last step writes the k/v
         of proposal d_K into the draft cache and discards its own
         proposal, so when the verify pass accepts all K (+ bonus) the
-        draft cache has no hole at the new frontier."""
+        draft cache has no hole at the new frontier.
+
+        Adaptive masking: ``k_eff`` [S] caps how many proposals each
+        row may spend this round, and ``cfg.entropy_exit`` retires a
+        row at the first step whose draft distribution's entropy
+        spikes past the threshold — later proposals would mostly be
+        rejected anyway. ``k_live[s] <= k_eff[s]`` is the number of
+        proposals row ``s`` actually stands behind; emission and
+        acceptance accounting clamp there (``spec_verify`` k_live).
+        Each scan step runs under a ``lax.cond`` on "any row still
+        needs this step", so when every row has exited (or every
+        row's k_eff is satisfied) the remaining draft weight passes
+        are SKIPPED, not just ignored — the early-exit FLOP saving is
+        real, not cosmetic. Rows needing fewer steps than the batch
+        maximum keep writing harmless proposals past their own
+        frontier (overwritten before ever being attended, the same
+        rollback contract as rejection)."""
         model = self.draft.model
         K = self.cfg.k
+        thresh = self.cfg.entropy_exit
         temperature = float(gen.temperature)
         top_k, top_p = int(gen.top_k), float(gen.top_p)
+        # the cond-skip branch must emit logits of a statically known
+        # width; a model that doesn't declare its vocab just runs every
+        # step (masking still applies — only the FLOP skip is lost)
+        V = getattr(getattr(model, "cfg_obj", None), "vocab_size", None)
 
-        def run(dparams, dcaches, tok, n_valid, seed, mask):
-            def step(carry, t):
-                dcaches, tok = carry
+        def run(dparams, dcaches, tok, n_valid, seed, mask, k_eff, live):
+            def real_step(args):
+                dcaches, tok, t = args
                 positions = (n_valid + t)[:, None]
                 logits, dcaches = model.apply(
                     dparams, tok[:, None], caches=dcaches,
                     positions=positions, mask=mask,
                 )
-                lg = logits[:, -1]
+                # f32 so both cond branches agree on dtype (the cast is
+                # exact; every consumer upcasts before use anyway)
+                return logits[:, -1].astype(jnp.float32), dcaches
+
+            def skip_step(args):
+                dcaches, tok, _ = args
+                # every row is done for this round: emit a flat (and
+                # therefore max-entropy) distribution so nothing
+                # downstream can mistake it for a real proposal
+                return (
+                    jnp.zeros((tok.shape[0], V), jnp.float32), dcaches
+                )
+
+            def step(carry, t):
+                dcaches, tok, alive = carry
+                # row s still needs step t while t <= its proposal
+                # budget (step t writes the k/v of fed token t — the
+                # slot an accepted prefix of k_live proposals ends at)
+                # and its entropy has not yet spiked
+                need = live & alive & (t <= k_eff)
+                if V is None:
+                    lg, dcaches = real_step((dcaches, tok, t))
+                else:
+                    lg, dcaches = jax.lax.cond(
+                        jnp.any(need), real_step, skip_step,
+                        (dcaches, tok, t),
+                    )
                 if temperature == 0.0:
                     nxt = jnp.argmax(lg, -1).astype(jnp.int32)
                 else:
@@ -210,16 +440,36 @@ class SpeculativeDecoder:
                     nxt = jax.vmap(samp)(
                         seed, n_valid + t + 1, lg
                     ).astype(jnp.int32)
-                return (dcaches, nxt), (nxt, lg)
+                # a skipped/retired row keeps feeding its old token so
+                # the carry stays well-formed; its proposals are masked
+                # out of acceptance via k_live either way
+                nxt = jnp.where(need, nxt, tok)
+                if thresh is not None:
+                    p = jax.nn.softmax(lg.astype(jnp.float32), axis=-1)
+                    ent = -jnp.sum(
+                        p * jnp.log(jnp.maximum(p, 1e-20)), axis=-1
+                    )
+                    alive = alive & need & (ent <= thresh)
+                else:
+                    alive = alive & need
+                return (dcaches, nxt, alive), (nxt, lg, alive)
 
-            (dcaches, _), (props, dlg) = jax.lax.scan(
-                step, (dcaches, tok), jnp.arange(K + 1)
+            alive0 = jnp.ones_like(live)
+            (dcaches, _, _), (props, dlg, alive_t) = jax.lax.scan(
+                step, (dcaches, tok, alive0), jnp.arange(K + 1)
+            )
+            # proposal d_{t+1} (props[t]) is trusted iff the row was
+            # still alive AFTER step t: its entropy checks passed at
+            # every step up to and including the one that drew it
+            k_live = jnp.minimum(
+                alive_t[:K].astype(jnp.int32).sum(axis=0), k_eff
             )
             # props[t] = d_{t+1}; keep d_1..d_K and their distributions
             return (
                 props[:K].T,               # [S, K]
                 dlg[:K].transpose(1, 0, 2),  # [S, K, V]
                 dcaches,
+                k_live,
             )
 
         return run
@@ -232,3 +482,151 @@ class SpeculativeDecoder:
         return jax.random.fold_in(
             jax.random.fold_in(jax.random.key(seed), n_valid), SALT_VERIFY
         )
+
+
+# --------------------------------------------------------- draft pairing
+def _vocab_of(engine) -> int | None:
+    return getattr(getattr(engine.model, "cfg_obj", None), "vocab_size", None)
+
+
+def default_draft_candidates(engine) -> list[tuple[str, object]]:
+    """The model zoo's free draft pair for any target: its own int8
+    weight-only sibling — half the weight bytes per draft step on a
+    memory-bound decode, and int8 almost always preserves the argmax
+    (the bench's ``int8_quality`` KL measures exactly that), so greedy
+    acceptance is a real model property. Thunks, not engines: a
+    candidate that never gets measured never allocates."""
+    from tensorlink_tpu.parallel.inference import InferenceEngine
+
+    def int8_sibling():
+        return InferenceEngine(
+            engine.mesh, engine.model, engine.params,
+            max_len=engine.max_len, cache_dtype=engine.cache_dtype,
+            data_axis=engine.data_axis, model_axis=engine.model_axis,
+            quantize="int8",
+        )
+
+    return [("int8-sibling", int8_sibling)]
+
+
+def autopair_draft(
+    engine,
+    gen,
+    *,
+    candidates: list[tuple[str, object]] | None = None,
+    cfg: SpecConfig | None = None,
+    prompts=None,
+    max_new: int = 16,
+    slots: int = 2,
+    recorder=None,
+) -> dict:
+    """Measured draft pairing (ROADMAP item 3): a short calibration
+    burst at engine start decides HOW this engine should speculate —
+    not tokens-per-weight heuristics, wall-clock on this chip.
+
+    Runs the burst prompts through (a) a non-speculative scheduler —
+    the baseline any speculation must beat, (b) each vocab-compatible
+    candidate draft, LARGEST first (bigger sibling = higher acceptance;
+    first one whose measured accepted-tokens-per-second beats the
+    baseline wins), and (c) n-gram self-speculation as the free
+    fallback. Verdict order: best paying draft > paying n-gram >
+    non-spec.
+
+    Returns ``{"mode": "draft"|"ngram"|"nonspec", "name", "draft":
+    engine-or-None, "spec": SpecConfig-or-None, "measured": {name:
+    tokens_per_sec}, "baseline_tokens_per_sec", "calibration_s",
+    "persistable": {...}}`` — splat ``draft=`` / ``speculative=`` from
+    it into a serving-engine ctor. ``persistable`` is the JSON-safe
+    summary (no live engines) to hand ``save_autotune(draft_pair=...)``
+    so a restart skips the burst entirely.
+
+    Candidates are built LAZILY, one at a time, in the order given
+    (list them largest-first — bigger sibling = higher acceptance) and
+    each loser is released before the next builds, so a zoo of drafts
+    never holds more than one candidate's weights at once."""
+    from tensorlink_tpu.parallel.serving import ContinuousBatchingEngine
+
+    t_start = time.perf_counter()
+    cfg = cfg or SpecConfig()
+    if prompts is None:
+        vocab = _vocab_of(engine) or 256
+        r = np.random.default_rng(0)
+        prompts = [r.integers(0, vocab, (n,)) for n in (8, 13, 6, 10)]
+    if candidates is None:
+        candidates = default_draft_candidates(engine)
+
+    def burst(draft_eng, spec_cfg) -> float:
+        sch = ContinuousBatchingEngine(
+            engine, slots=slots, gen=gen, decode_chunk=max(cfg.k, 4),
+            prefill_block=16, draft=draft_eng, speculative=spec_cfg,
+            recorder=recorder,
+        )
+        sch.result(sch.submit(prompts[0], max_new=max_new))  # compile
+        t0 = time.perf_counter()
+        rids = [sch.submit(p, max_new=max_new) for p in prompts]
+        sch.run_until_idle()
+        dt = time.perf_counter() - t0
+        ntok = sum(len(sch.result(rid)) for rid in rids)
+        return ntok / dt if dt > 0 else 0.0
+
+    measured: dict[str, float] = {}
+    base_tps = burst(None, None)
+    measured["nonspec"] = round(base_tps, 1)
+    tvocab = _vocab_of(engine)
+
+    def _record(kind: str, **data) -> None:
+        if recorder is not None:
+            try:
+                recorder.record(kind, **data)
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
+
+    choice = {"mode": "nonspec", "name": "nonspec", "draft": None,
+              "spec": None}
+    for name, cand in candidates:
+        # build INSIDE the loop and release losers before the next
+        # candidate builds: a zoo next to a large target must never
+        # hold every draft's weights at once
+        d = cand() if callable(cand) else cand
+        dvocab = _vocab_of(d)
+        if tvocab is not None and dvocab is not None and dvocab != tvocab:
+            _record(
+                "spec.autopair_skip", name=name,
+                reason=f"vocab {dvocab} != target {tvocab}",
+            )
+            del d
+            continue
+        try:
+            tps = burst(d, cfg)
+        except (ValueError, NotImplementedError) as e:
+            _record("spec.autopair_skip", name=name, reason=str(e)[:200])
+            del d
+            continue
+        measured[name] = round(tps, 1)
+        if tps > base_tps:
+            choice = {"mode": "draft", "name": name, "draft": d,
+                      "spec": cfg}
+            break
+        del d
+    if choice["mode"] == "nonspec":
+        ng_tps = burst(None, cfg)
+        measured["ngram"] = round(ng_tps, 1)
+        if ng_tps > base_tps:
+            choice = {"mode": "ngram", "name": "ngram", "draft": None,
+                      "spec": cfg}
+    choice["measured"] = measured
+    choice["baseline_tokens_per_sec"] = round(base_tps, 1)
+    choice["calibration_s"] = round(time.perf_counter() - t_start, 3)
+    # the JSON-safe form for the autotune store: everything about the
+    # verdict EXCEPT the live engine and config objects
+    choice["persistable"] = {
+        "mode": choice["mode"], "name": choice["name"],
+        "measured": measured,
+        "baseline_tokens_per_sec": choice["baseline_tokens_per_sec"],
+        "calibration_s": choice["calibration_s"],
+    }
+    _record(
+        "spec.autopair", mode=choice["mode"], name=choice["name"],
+        measured=measured,
+    )
+    return choice

@@ -1086,6 +1086,7 @@ class UserNode(Node):
         kw.setdefault("metrics", self.metrics)
         kw.setdefault("recorder", self.flight)
         kw.setdefault("compile_cache_dir", self.cfg.compile_cache_dir)
+        kw.setdefault("autotune_dir", self.cfg.autotune_dir)
         cls = PagedContinuousBatchingEngine if paged else ContinuousBatchingEngine
         self.serving = cls(engine, **kw)
         return self.serving

@@ -515,6 +515,13 @@ class WorkerNode(Node):
         from tensorlink_tpu.runtime.compile_cache import enable_compile_cache
 
         enable_compile_cache(cfg.compile_cache_dir, recorder=self.flight)
+        # ... and the autotune store beside it: the worker has no model
+        # yet, so it loads the chip-GLOBAL record — persisted flash-
+        # block overrides install before any stage traces, extending
+        # the warm restart from kernels to the measured constants that
+        # pick them (runtime/autotune.py)
+        self.autotune_warm_start_s: float | None = None
+        self._load_autotune(cfg)
         self.registry = registry  # optional: verifies validator identity
         self.stages: dict[tuple[str, int], StageRunner] = {}
         # DP replica grad exchange: (job, stage, step, sender) -> (g, n)
@@ -527,6 +534,52 @@ class WorkerNode(Node):
         # leaked (review finding).
         self._reservations: dict[tuple[str, int], tuple[int, float, str]] = {}
         self.training = False
+
+    # ------------------------------------------------------------ autotune
+    def _autotune_key(self):
+        from tensorlink_tpu.runtime.autotune import GLOBAL_MODEL, store_key
+
+        return store_key(GLOBAL_MODEL, ())
+
+    def _load_autotune(self, cfg: NodeConfig) -> None:
+        from tensorlink_tpu.runtime.autotune import (
+            AutotuneStore,
+            apply_flash_overrides,
+        )
+
+        store = AutotuneStore.resolve(
+            cfg.autotune_dir, recorder=self.flight
+        )
+        if store is None:
+            return
+        t0 = time.perf_counter()
+        rec = store.load(self._autotune_key())
+        if rec is None:
+            return
+        applied = apply_flash_overrides(rec)
+        self.autotune_warm_start_s = round(time.perf_counter() - t0, 4)
+        self.flight.record(
+            "autotune.warm_start", flash_overrides=applied,
+            warm_start_s=self.autotune_warm_start_s,
+        )
+
+    def save_autotune(self) -> str | None:
+        """Persist this worker's installed flash-block overrides under
+        the chip-global key (a tuning sweep's result must outlive the
+        process that ran it). Returns the written path or None when no
+        store is configured."""
+        from tensorlink_tpu.ops.flash import flash_block_overrides
+        from tensorlink_tpu.runtime.autotune import AutotuneStore
+
+        store = AutotuneStore.resolve(
+            self.cfg.autotune_dir, recorder=self.flight
+        )
+        if store is None:
+            return None
+        return str(store.save(
+            self._autotune_key(),
+            {"flash_blocks": [list(t) for t in flash_block_overrides()]},
+        ))
 
     def on_peer_lost(self, peer: Peer) -> None:
         """A lost job OWNER strands this worker's loaded stages: until

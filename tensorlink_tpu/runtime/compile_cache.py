@@ -37,9 +37,22 @@ import jax
 
 from tensorlink_tpu.runtime.flight import default_recorder
 
-__all__ = ["cache_entries", "enable_compile_cache"]
+__all__ = ["cache_entries", "enable_compile_cache", "runtime_fingerprint"]
 
 ENV_VAR = "TL_COMPILE_CACHE_DIR"
+
+
+def runtime_fingerprint() -> dict:
+    """The (jax version, chip) half of every persisted-tuning key: the
+    same invariants XLA's own compile-cache key hashes. Shared by this
+    cache's events and the autotune store (runtime/autotune.py) so the
+    two warm-restart layers — compiled kernels and the measured
+    constants that pick them — can never key on different facts."""
+    try:
+        chip = jax.devices()[0].device_kind
+    except Exception:  # noqa: BLE001 — backendless probes still key
+        chip = "unknown"
+    return {"jax": jax.__version__, "chip": chip}
 
 _active_dir: str | None = None
 

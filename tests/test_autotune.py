@@ -1,0 +1,293 @@
+"""Persistent autotune store (runtime/autotune.py) + its serving/worker
+wiring: measured flash-block overrides, prefill-bucket sets, and the
+adaptive-speculation K prior survive restarts byte-identically, and a
+corrupt or stale-keyed store cold-starts cleanly instead of crashing —
+the measured-constants half of the compile cache's warm-restart story
+(ISSUE 12 / ROADMAP item 3)."""
+
+import hashlib
+import json
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.ops.flash import (
+    clear_flash_block_overrides,
+    flash_block_for,
+    flash_block_overrides,
+    set_flash_block_override,
+)
+from tensorlink_tpu.runtime.autotune import (
+    GLOBAL_MODEL,
+    AutotuneStore,
+    apply_flash_overrides,
+    model_fingerprint,
+    store_key,
+)
+from tensorlink_tpu.runtime.flight import FlightRecorder
+
+
+@pytest.fixture(autouse=True)
+def _clean_overrides():
+    clear_flash_block_overrides()
+    yield
+    clear_flash_block_overrides()
+
+
+# ------------------------------------------------------------- store unit
+def test_store_round_trip(tmp_path):
+    store = AutotuneStore.resolve(str(tmp_path / "at"))
+    key = store_key("modelfp", (32, 64))
+    assert store.load(key) is None  # empty = miss, not error
+    p = store.save(key, {"flash_blocks": [[512, None, 256]],
+                         "k_prior": {"k": 3, "acceptance": 0.7}})
+    rec = store.load(key)
+    assert rec["flash_blocks"] == [[512, None, 256]]
+    assert rec["k_prior"] == {"k": 3, "acceptance": 0.7}
+    # the loader can validate what the writer measured against
+    assert rec["key"] == key and rec["jax"] == jax.__version__
+    assert p.exists()
+
+
+def test_store_resolve_off_and_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("TL_AUTOTUNE_DIR", raising=False)
+    assert AutotuneStore.resolve(None) is None  # both unset = off
+    monkeypatch.setenv("TL_AUTOTUNE_DIR", str(tmp_path / "env"))
+    store = AutotuneStore.resolve(None)
+    assert store is not None and store.root == tmp_path / "env"
+
+
+def test_store_corrupt_and_stale_cold_start(tmp_path):
+    rec_events = FlightRecorder(max_events=16)
+    store = AutotuneStore.resolve(str(tmp_path), recorder=rec_events)
+    key = store_key("m", ())
+    # corrupt: not JSON at all
+    store.path(key).write_text("{truncated")
+    assert store.load(key) is None
+    # stale: schema from a future/past version
+    store.path(key).write_text(json.dumps({"schema": 99, "key": key}))
+    assert store.load(key) is None
+    # stale: record written under a DIFFERENT key (e.g. a renamed file
+    # or a jax upgrade changing what this process computes)
+    store.path(key).write_text(
+        json.dumps({"schema": 1, "key": "somethingelse"})
+    )
+    assert store.load(key) is None
+    kinds = [e["kind"] for e in rec_events.events()]
+    assert "autotune.corrupt" in kinds and "autotune.stale" in kinds
+
+
+def test_store_key_depends_on_all_parts():
+    keys = {
+        store_key("a", (32,)),
+        store_key("b", (32,)),
+        store_key("a", (64,)),
+        store_key("a", (32, 64)),
+    }
+    assert len(keys) == 4  # any ingredient change = a different record
+
+
+def test_model_fingerprint_is_structural():
+    p1 = {"w": np.zeros((4, 8), np.float32), "b": np.zeros((8,), np.float32)}
+    p2 = {"w": np.ones((4, 8), np.float32), "b": np.ones((8,), np.float32)}
+    p3 = {"w": np.zeros((4, 9), np.float32), "b": np.zeros((9,), np.float32)}
+    assert model_fingerprint(p1) == model_fingerprint(p2)  # values free
+    assert model_fingerprint(p1) != model_fingerprint(p3)  # shapes pin
+
+
+def test_flash_override_persist_and_apply():
+    set_flash_block_override(512, 256)
+    set_flash_block_override(1024, 128, batch=8)
+    snap = flash_block_overrides()
+    assert snap == [(512, None, 256), (1024, 8, 128)]
+    clear_flash_block_overrides()
+    assert flash_block_for(512) == 512  # back on the heuristic
+    # round-trip through the record form; a stale entry (block no
+    # longer dividing seq) is skipped, never fatal
+    applied = apply_flash_overrides(
+        {"flash_blocks": [list(t) for t in snap] + [[100, None, 33]]}
+    )
+    assert applied == 2
+    assert flash_block_for(512) == 256
+    assert flash_block_for(1024, 8) == 128
+
+
+# --------------------------------------------- engine wiring, two-process
+_PROC_SCRIPT = """
+import hashlib, json, sys
+import jax, jax.numpy as jnp, numpy as np
+from tensorlink_tpu.config import MeshConfig
+from tensorlink_tpu.models.llama import Llama, LlamaConfig
+from tensorlink_tpu.ops.flash import flash_block_for, flash_block_overrides
+from tensorlink_tpu.parallel.inference import GenerationConfig, InferenceEngine
+from tensorlink_tpu.parallel.serving import ContinuousBatchingEngine, SpecConfig
+from tensorlink_tpu.runtime.mesh import make_mesh
+
+mode, tune_dir = sys.argv[1], sys.argv[2]
+cfg = LlamaConfig.tiny()
+m = Llama(cfg)
+p = m.init(jax.random.key(0))
+eng = InferenceEngine(
+    make_mesh(MeshConfig()), m, p, max_len=32,
+    cache_dtype=jnp.float32, param_dtype=jnp.float32,
+)
+if mode == "measure":
+    # "measure": the tuning sweep this process pays for once
+    from tensorlink_tpu.ops.flash import set_flash_block_override
+    set_flash_block_override(512, 256)
+sch = ContinuousBatchingEngine(
+    eng, slots=2, gen=GenerationConfig(max_new_tokens=6),
+    decode_chunk=2, prefill_block=4,
+    speculative=SpecConfig(k=3, adaptive=True), autotune_dir=tune_dir,
+)
+r = np.random.default_rng(0)
+for i in range(3):
+    sch.result(sch.submit(r.integers(0, cfg.vocab_size, (4 + i,))))
+if mode == "measure":
+    path = sch.save_autotune(draft_pair={"name": "none", "mode": "ngram"})
+else:
+    path = str(sch._autotune.path(sch._autotune_key))
+blob = open(path, "rb").read()
+print(json.dumps({
+    "path": path,
+    "sha": hashlib.sha256(blob).hexdigest(),
+    "warm_start_s": sch.autotune_warm_start_s,
+    "flash_512": flash_block_for(512),
+    "overrides": [list(t) for t in flash_block_overrides()],
+    "record": json.loads(blob),
+    "prior": sch._kctl.prior() if sch._kctl else None,
+}))
+"""
+
+
+def _run_proc(mode: str, tune_dir: str) -> dict:
+    out = subprocess.run(
+        [sys.executable, "-c", _PROC_SCRIPT, mode, tune_dir],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_two_process_restart_round_trips_tuning(tmp_path):
+    """ISSUE-12 acceptance: process A measures (flash override + K
+    prior) and persists; process B loads them at engine start with
+    ZERO re-measurement — warm start reported, overrides installed
+    before any trace, store bytes untouched (byte-identical to what A
+    wrote)."""
+    d = str(tmp_path / "tune")
+    a = _run_proc("measure", d)
+    # A cold-started (nothing to load) and persisted its measurements
+    assert a["warm_start_s"] is None
+    assert a["record"]["flash_blocks"] == [[512, None, 256]]
+    assert a["record"]["k_prior"]["k"] >= 1
+    assert a["record"]["draft_pair"] == {"name": "none", "mode": "ngram"}
+    b = _run_proc("load", d)
+    # B warm-started: override live without any set_flash_block call,
+    # controller seeded from the stored prior, file bytes untouched
+    assert b["warm_start_s"] is not None
+    assert b["flash_512"] == 256
+    assert [512, None, 256] in b["overrides"]
+    assert b["sha"] == a["sha"]
+    assert b["record"]["k_prior"] == a["record"]["k_prior"]
+
+
+def test_engine_cold_starts_on_corrupt_store(tmp_path):
+    """A poisoned store file must read as a clean miss at engine
+    construction — no crash, no warm-start claim."""
+    from tensorlink_tpu.config import MeshConfig
+    from tensorlink_tpu.models.llama import Llama, LlamaConfig
+    from tensorlink_tpu.parallel.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from tensorlink_tpu.parallel.serving import ContinuousBatchingEngine
+    from tensorlink_tpu.runtime.mesh import make_mesh
+
+    cfg = LlamaConfig.tiny()
+    m = Llama(cfg)
+    eng = InferenceEngine(
+        make_mesh(MeshConfig()), m, m.init(jax.random.key(0)), max_len=32,
+        cache_dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    d = tmp_path / "tune"
+    d.mkdir()
+    # poison EVERY possible key file
+    probe = ContinuousBatchingEngine(
+        eng, slots=1, gen=GenerationConfig(max_new_tokens=2),
+        decode_chunk=2, prefill_block=4, autotune_dir=str(d),
+    )
+    store = probe._autotune
+    store.path(probe._autotune_key).write_bytes(b"\x00garbage\xff")
+    sch = ContinuousBatchingEngine(
+        eng, slots=1, gen=GenerationConfig(max_new_tokens=2),
+        decode_chunk=2, prefill_block=4, autotune_dir=str(d),
+    )
+    assert sch.autotune_warm_start_s is None
+    r = np.random.default_rng(1)
+    toks = sch.result(sch.submit(r.integers(0, cfg.vocab_size, (5,))))
+    assert len(toks) == 2
+
+
+def test_save_autotune_drops_unserializable_extras(tmp_path):
+    """The documented flow — handing save_autotune an autopair verdict
+    — must never crash the save: live-engine values drop with a warn
+    event; the verdict's ``persistable`` form round-trips whole."""
+    from tensorlink_tpu.config import MeshConfig
+    from tensorlink_tpu.models.llama import Llama, LlamaConfig
+    from tensorlink_tpu.parallel.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from tensorlink_tpu.parallel.serving import ContinuousBatchingEngine
+    from tensorlink_tpu.runtime.mesh import make_mesh
+
+    cfg = LlamaConfig.tiny()
+    m = Llama(cfg)
+    eng = InferenceEngine(
+        make_mesh(MeshConfig()), m, m.init(jax.random.key(0)), max_len=32,
+        cache_dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    rec_events = FlightRecorder(max_events=16)
+    sch = ContinuousBatchingEngine(
+        eng, slots=1, gen=GenerationConfig(max_new_tokens=2),
+        decode_chunk=2, prefill_block=4,
+        autotune_dir=str(tmp_path / "tune"), recorder=rec_events,
+    )
+    fake_verdict = {"mode": "draft", "name": "x", "draft": eng,
+                    "persistable": {"mode": "draft", "name": "x"}}
+    path = sch.save_autotune(
+        draft_pair=fake_verdict["persistable"], raw=fake_verdict,
+    )
+    assert path is not None
+    saved = json.loads(open(path).read())
+    assert saved["draft_pair"] == {"mode": "draft", "name": "x"}
+    assert "raw" not in saved  # live engine dropped, not crashed on
+    assert any(
+        e["kind"] == "autotune.extra_dropped" for e in rec_events.events()
+    )
+
+
+def test_worker_loads_chip_global_record(tmp_path):
+    """WorkerNode loads the chip-global record at construction —
+    persisted flash overrides install before any stage compiles."""
+    from tensorlink_tpu.config import NodeConfig
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    d = str(tmp_path / "tune")
+    store = AutotuneStore.resolve(d)
+    store.save(
+        store_key(GLOBAL_MODEL, ()),
+        {"flash_blocks": [[2048, None, 512]]},
+    )
+    w = WorkerNode(NodeConfig(role="worker", autotune_dir=d))
+    try:
+        assert w.autotune_warm_start_s is not None
+        assert flash_block_for(2048) == 512
+        assert w.save_autotune() is not None  # round-trips its own view
+    finally:
+        clear_flash_block_overrides()

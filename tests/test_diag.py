@@ -143,6 +143,72 @@ def test_bench_diff_speculation_key_directions():
     }
 
 
+def test_bench_diff_adaptive_speculation_key_directions():
+    """ISSUE-12 adaptive-speculation keys: adaptive tok/s and the
+    adaptive-over-best-static ratio are higher-better; the autotune
+    warm start is a latency (lower-better); the mean dispatched K is a
+    workload property, not a quality axis — it must carry NO direction
+    (a 'K went down' regression verdict would punish the controller
+    for correctly adapting to rejection-heavy traffic)."""
+    old = {
+        "spec_adaptive_tokens_per_sec": 9000.0,
+        "spec_adaptive_vs_best_static": 1.2,
+        "autotune_warm_start_s": 0.010,
+        "spec_k_mean": 3.2,
+    }
+    new = {
+        "spec_adaptive_tokens_per_sec": 8000.0,   # -11% -> regression
+        "spec_adaptive_vs_best_static": 0.9,      # -25% -> regression
+        "autotune_warm_start_s": 0.100,           # 10x   -> regression
+        "spec_k_mean": 1.1,                       # no direction
+    }
+    d = bench_diff(old, new, threshold=0.05)
+    assert set(d["regressions"]) == {
+        "spec_adaptive_tokens_per_sec", "spec_adaptive_vs_best_static",
+        "autotune_warm_start_s",
+    }
+    assert d["keys"]["spec_k_mean"]["direction"] is None
+
+
+def test_node_row_self_healed_replaces_low_accept():
+    """A node whose engine already downgraded its own speculation
+    (serving.py _maybe_self_heal) renders SELF-HEALED(mode), not
+    LOW-ACCEPT — the flag's condition cleared without an operator."""
+    def scrape(serving):
+        return {
+            "target": "s:1",
+            "routes": {
+                "/healthz": {"status": 200, "body": {"ok": True}},
+                "/node": {"status": 200, "body": {
+                    "role": "user", "node_id": "u" * 64, "peers": {},
+                    "serving": serving,
+                }},
+            },
+        }
+
+    low_spec = {
+        "mode": "draft", "proposed_total": 500, "acceptance_rate": 0.1,
+    }
+    advisory = node_row(scrape({"spec": low_spec}), 10.0, 2.0)
+    assert any(f.startswith("LOW-ACCEPT") for f in advisory["flags"])
+    healed = node_row(scrape({
+        "spec": dict(low_spec, mode="ngram"),
+        "spec_self_healed": {"from": "draft", "to": "ngram",
+                             "acceptance": 0.1},
+    }), 10.0, 2.0)
+    assert "SELF-HEALED(ngram)" in healed["flags"]
+    assert not any(f.startswith("LOW-ACCEPT") for f in healed["flags"])
+    # healed all the way out of speculation: no spec stats at all, the
+    # record alone still tells the operator what happened
+    off = node_row(scrape({
+        "spec_self_healed": {"from": "ngram", "to": "nonspec",
+                             "acceptance": 0.05},
+    }), 10.0, 2.0)
+    assert "SELF-HEALED(nonspec)" in off["flags"]
+    text = render_table([healed, off])
+    assert "SELF-HEALED" in text
+
+
 def test_node_row_flags_kv_pool_pressure():
     """A serving node whose /node reports a paged KV pool near capacity
     is flagged KV-PRESSURE (admissions about to backpressure); a calm

@@ -393,6 +393,61 @@ def test_json_format_carries_explanations():
     assert f["explanation"] == HLO_RULES["TLH101"].strip().splitlines()[0]
 
 
+def test_masked_k_change_does_not_grow_program_set():
+    """ISSUE-12 / TLH105 regression gate: per-request K is a TRACED
+    operand of the one spec-chunk program, so an adaptive engine under
+    K churn must present EXACTLY the program set the committed
+    manifest pins for its group — same names, same count, and zero
+    fresh jit traces after the churn. A masked-K implementation that
+    specialized per K (static argnum, shape, or a sibling program)
+    fails here before it fails in production retrace storms."""
+    import numpy as np
+
+    from tensorlink_tpu.config import MeshConfig
+    from tensorlink_tpu.models.llama import Llama, LlamaConfig
+    from tensorlink_tpu.parallel.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from tensorlink_tpu.parallel.serving import (
+        ContinuousBatchingEngine,
+        SpecConfig,
+    )
+    from tensorlink_tpu.runtime.mesh import make_mesh
+
+    cfg = LlamaConfig.tiny()
+    m = Llama(cfg)
+    eng = InferenceEngine(
+        make_mesh(MeshConfig()), m, m.init(jax.random.key(0)), max_len=32,
+        cache_dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+    )
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=GenerationConfig(max_new_tokens=6),
+        decode_chunk=2, prefill_block=16,
+        speculative=SpecConfig(k=2, rounds=1, adaptive=True),
+    )
+    path = find_default_manifest(os.path.dirname(__file__))
+    assert path is not None
+    man_names = {
+        n.split(".", 1)[1]
+        for n in load_manifest(path).get("programs", {})
+        if n.startswith("continuous.") and "spec" in n
+    }
+    assert {p["name"] for p in sch.audit_programs()} == man_names
+    # drive per-request K churn: rejection-heavy traffic (n-gram over
+    # random tiny-model output) walks K down per request while fresh
+    # requests start at the prior
+    r = np.random.default_rng(5)
+    for n in (6, 9, 4, 7):
+        sch.submit(r.integers(0, cfg.vocab_size, (n,)))
+    sch.run_until_idle()
+    ks = {sch._kctl.k_for_acceptance(a / 10) for a in range(10)}
+    assert len(ks) > 1  # the controller genuinely varies K
+    assert {p["name"] for p in sch.audit_programs()} == man_names
+    if hasattr(sch._decode, "_cache_size"):
+        assert sch._decode._cache_size() == 1  # ONE spec program, still
+
+
 # -------------------------------------------------- canonical enumeration
 @pytest.fixture(scope="module")
 def canonical_audit():

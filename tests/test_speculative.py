@@ -453,3 +453,299 @@ def test_node_config_carries_compile_cache_dir():
     assert NodeConfig().compile_cache_dir is None
     c = NodeConfig(compile_cache_dir="/tmp/x")
     assert c.compile_cache_dir == "/tmp/x"
+    assert NodeConfig(autotune_dir="/tmp/y").autotune_dir == "/tmp/y"
+
+
+# ---------------------------------------------------- adaptive: verify mask
+def test_spec_verify_k_live_greedy_masking():
+    """Masked K inside the verifier: k_live clamps the accepted prefix
+    but every emitted token is still the target's own greedy token —
+    the parity property adaptive K rides on."""
+    V, K = 7, 3
+    tgt = np.full((K + 1, V), -10.0, np.float32)
+    for i, a in enumerate([2, 5, 1, 6]):
+        tgt[i, a] = 0.0
+    props = jnp.asarray([2, 5, 1])  # all would match
+    for kl, want_n in ((3, 4), (2, 3), (1, 2), (0, 1)):
+        n, em = spec_verify(
+            jnp.asarray(tgt), props, KEY, 0.0, 0, k_live=jnp.int32(kl)
+        )
+        assert int(n) == want_n
+        assert list(np.asarray(em))[: int(n)] == [2, 5, 1, 6][: int(n)]
+
+
+def test_spec_verify_k_live_preserves_distribution():
+    """The subtle masked-K case at temperature > 0: a clamped position
+    never drew a proposal, so its token must come from the TARGET
+    distribution, not the rejection residual — sampling the residual
+    there would bias the output exactly when the controller masks."""
+    V, K, N = 5, 2, 4000
+    r = np.random.default_rng(3)
+    tgt = jnp.asarray(r.normal(0, 1.5, (K + 1, V)), jnp.float32)
+    drf = jnp.asarray(r.normal(0, 1.5, (K, V)), jnp.float32)
+    temp = 0.8
+    p_want = np.asarray(jax.nn.softmax(tgt[0] / temp))
+
+    def one(key):
+        kp, kv = jax.random.split(key)
+        props = jax.random.categorical(kp, drf / temp, axis=-1)
+        # k_live = 0: no proposals stand; the single emitted token is
+        # a plain decode step and must be EXACTLY target-distributed
+        n, em = spec_verify(
+            tgt, props, kv, temp, 0, 1.0, draft_logits=drf,
+            k_live=jnp.int32(0),
+        )
+        return em[0] + 0 * n
+
+    keys = jax.random.split(jax.random.key(11), N)
+    first = np.asarray(jax.vmap(one)(keys))
+    emp = np.bincount(first, minlength=V) / N
+    tol = 4 * np.sqrt(p_want * (1 - p_want) / N)
+    np.testing.assert_array_less(np.abs(emp - p_want), tol + 1e-9)
+
+
+# ------------------------------------------------- adaptive: controller law
+def test_adaptive_controller_law_and_feedback():
+    from tensorlink_tpu.parallel.speculative import AdaptiveKController
+
+    cfg = SpecConfig(k=8, adaptive=True, draft_cost=0.5)
+    ctl = AdaptiveKController(cfg)
+    # hopeless draft -> floor; perfect draft -> ceiling
+    assert ctl.k_for_acceptance(0.0) == cfg.k_min
+    assert ctl.k_for_acceptance(0.99) == cfg.k
+    # monotone in acceptance
+    ks = [ctl.k_for_acceptance(a / 10) for a in range(10)]
+    assert ks == sorted(ks)
+    # free proposer (n-gram): POSITION_COST alone must still pull K
+    # down at low acceptance (else the block-reservation overshoot
+    # never tightens)
+    free = AdaptiveKController(cfg, draft_cost=0.0)
+    assert free.k_for_acceptance(0.01) < cfg.k
+    # per-request feedback: rejections walk a request's K down
+    rid = 7
+    assert ctl.k_for(rid) == ctl.k_for_acceptance(ctl.prior_acceptance)
+    for _ in range(30):
+        ctl.observe(rid, proposed=8, accepted=0)
+    assert ctl.k_for(rid) == cfg.k_min
+    # finishing folds into the prior the next request starts from
+    before = ctl.prior_acceptance
+    ctl.forget(rid)
+    assert ctl.prior_acceptance < before
+    pr = ctl.prior()
+    assert set(pr) == {"k", "acceptance", "draft_cost"}
+    # fully-exited rounds (proposed == 0) carry no signal
+    ctl.observe(3, proposed=0, accepted=0)
+    assert 3 not in ctl._acc
+
+
+def test_spec_config_adaptive_validation():
+    with pytest.raises(ValueError, match="k_min"):
+        SpecConfig(k=2, k_min=3)
+    with pytest.raises(ValueError, match="entropy_exit"):
+        SpecConfig(entropy_exit=0.0)
+    with pytest.raises(ValueError, match="self_heal_accept"):
+        SpecConfig(self_heal_accept=1.5)
+    auto = SpecConfig.auto(k=6)
+    assert auto.adaptive and auto.entropy_exit and auto.self_heal_accept
+
+
+# ------------------------------------------- adaptive: parity + trace count
+def test_adaptive_greedy_parity_and_flat_trace_count(spec_engine):
+    """ISSUE-12 acceptance: greedy parity adaptive == static-K ==
+    non-spec on BOTH engines (the controller changes how many tokens a
+    weight pass yields, never which tokens), and per-request K changes
+    never grow the program count — K is a traced operand of the ONE
+    spec program (tlint TL501 / tlhlo TLH105)."""
+    cfg, eng, draft, gen, prompts, refs = spec_engine
+    acfg = SpecConfig(k=3, rounds=2, adaptive=True, entropy_exit=6.0)
+    for sch in (
+        ContinuousBatchingEngine(
+            eng, slots=2, gen=gen, decode_chunk=3, prefill_block=4,
+            draft=draft, speculative=acfg,
+        ),
+        PagedContinuousBatchingEngine(
+            eng, slots=2, gen=gen, block_size=8, num_blocks=16,
+            prefill_chunk=8, draft=draft, speculative=acfg,
+        ),
+    ):
+        rids = [sch.submit(pr) for pr in prompts]
+        for rid, ref in zip(rids, refs):
+            np.testing.assert_array_equal(sch.result(rid), ref)
+        st = sch.stats()["spec"]
+        assert st["adaptive"] and st["k_mean"] > 0
+        # the mismatched draft drives per-request K DOWN mid-flight —
+        # more traffic with churned K values must not retrace
+        if hasattr(sch._decode, "_cache_size"):
+            warm = sch._decode._cache_size()
+            r = np.random.default_rng(17)
+            for n in (2, 9, 4, 6):
+                sch.submit(
+                    r.integers(0, cfg.vocab_size, (n,)),
+                    max_new=int(1 + n % 4),
+                )
+            sch.run_until_idle()
+            assert sch._decode._cache_size() == warm == 1
+        # audit surface unchanged: still exactly the spec-chunk +
+        # prefill programs (no masked-K sibling program appeared)
+        names = {p["name"] for p in sch.audit_programs()}
+        assert len(names) == 2 and any("spec" in n for n in names)
+
+
+def test_adaptive_temperature_deterministic(spec_engine):
+    """Adaptive K at temperature > 0 keeps the (seed, position)
+    determinism contract: same request alone vs amid traffic."""
+    cfg, eng, draft, gen0, prompts, refs = spec_engine
+    gen = GenerationConfig(max_new_tokens=8, temperature=0.9, top_k=8)
+    acfg = SpecConfig(k=2, adaptive=True)
+    pr = np.random.default_rng(5).integers(0, cfg.vocab_size, (5,))
+    alone = ContinuousBatchingEngine(
+        eng, slots=4, gen=gen, decode_chunk=2, prefill_block=4,
+        speculative=acfg,
+    )
+    a = alone.result(alone.submit(pr, seed=42))
+    busy = ContinuousBatchingEngine(
+        eng, slots=4, gen=gen, decode_chunk=2, prefill_block=4,
+        speculative=acfg,
+    )
+    r6 = np.random.default_rng(6)
+    for i, n in enumerate((3, 6, 4)):
+        busy.submit(r6.integers(0, cfg.vocab_size, (n,)), seed=100 + i)
+    b = busy.result(busy.submit(pr, seed=42))
+    np.testing.assert_array_equal(a, b)
+
+
+# ------------------------------------------------- adaptive: draft early-exit
+def test_draft_early_exit_stops_charging_proposals(spec_engine):
+    """A paranoid entropy threshold retires every row at step 0: the
+    engine degenerates to (correct) non-spec pacing — outputs stay
+    token-identical, and the acceptance denominator records ~zero
+    attempted proposals instead of charging the draft for positions it
+    never stood behind."""
+    cfg, eng, draft, gen, prompts, refs = spec_engine
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=gen, decode_chunk=3, prefill_block=4,
+        draft=draft,
+        speculative=SpecConfig(k=3, rounds=2, entropy_exit=1e-4),
+    )
+    rids = [sch.submit(pr) for pr in prompts]
+    for rid, ref in zip(rids, refs):
+        np.testing.assert_array_equal(sch.result(rid), ref)
+    st = sch.stats()["spec"]
+    assert st["weight_passes"] > 0
+    # (random tiny-model logits are nowhere near 1e-4 nats of entropy)
+    assert st["proposed_total"] == 0
+    assert st["accepted_tokens_per_weight_pass"] >= 1.0
+
+
+# ---------------------------------------------------- self-heal (LOW-ACCEPT)
+def test_low_accept_self_heals_without_operator(spec_engine):
+    """ISSUE-12 acceptance: under a deliberately bad draft the engine
+    drops to n-gram/non-spec ON ITS OWN — on BOTH engines (the paged
+    heal must also rebuild its prefill-chunk program and block-table
+    ops for the new mode) — and the tldiag cluster row renders
+    SELF-HEALED(mode) instead of LOW-ACCEPT."""
+    from tensorlink_tpu.diag import node_row
+
+    cfg, eng, draft, gen, prompts, refs = spec_engine
+    heal_cfg = SpecConfig(k=3, rounds=2, self_heal_accept=0.3)
+    for sch in (
+        ContinuousBatchingEngine(
+            eng, slots=2, gen=gen, decode_chunk=3, prefill_block=4,
+            draft=draft, speculative=heal_cfg,
+        ),
+        PagedContinuousBatchingEngine(
+            eng, slots=2, gen=gen, block_size=8, num_blocks=16,
+            prefill_chunk=8, draft=draft, speculative=heal_cfg,
+        ),
+    ):
+        r = np.random.default_rng(23)
+        work = list(prompts) + [
+            r.integers(0, cfg.vocab_size, (n,)) for n in (6, 5, 7, 4)
+        ]
+        rids = [sch.submit(pr) for pr in work]
+        sch.run_until_idle()
+        for rid, ref in zip(rids[: len(refs)], refs):
+            np.testing.assert_array_equal(sch.result(rid), ref)
+        # the engine measured the draft as a loss and downgraded itself
+        healed = sch.stats().get("spec_self_healed")
+        assert healed is not None and healed["from"] == "draft"
+        assert healed["to"] in ("ngram", "nonspec")
+        assert healed["acceptance"] < 0.3
+        # post-heal traffic still token-identical (mode changes never
+        # change WHICH tokens) — this drives the rebuilt prefill path
+        pr2 = r.integers(0, cfg.vocab_size, (5,))
+        ref2 = np.asarray(eng.generate(pr2[None], gen))[0]
+        np.testing.assert_array_equal(sch.result(sch.submit(pr2)), ref2)
+    # sch is the healed paged engine from the loop's last iteration
+
+    def fake_scrape(serving):
+        return {
+            "target": "t", "routes": {
+                "/healthz": {"body": {"ok": True}},
+                "/node": {"body": {"serving": serving}},
+            },
+        }
+
+    st = sch.stats()
+    serving = {"spec_self_healed": st["spec_self_healed"]}
+    if "spec" in st:
+        serving["spec"] = st["spec"]
+    row = node_row(fake_scrape(serving), 10.0, 2.0)
+    assert any(f.startswith("SELF-HEALED(") for f in row["flags"])
+    assert not any(f.startswith("LOW-ACCEPT") for f in row["flags"])
+
+
+# ------------------------------------------------ paged: tightened slot_ub
+def test_adaptive_tightens_block_overshoot_under_rejection(spec_engine):
+    """Satellite pin: under constant rejection the static bound
+    reserves rounds*(k_max+1) positions ahead of every live frontier
+    at every step; the controller's live acceptance estimate shrinks
+    per-request K to the floor, so the same traffic holds measurably
+    fewer blocks over the run — with outputs still token-identical
+    (the bound is tightened by shrinking what the device may emit,
+    never by guessing low from drained counts)."""
+    from tensorlink_tpu.runtime.metrics import Metrics
+
+    cfg, eng, draft, gen, prompts, refs = spec_engine
+    long_gen = GenerationConfig(max_new_tokens=24)
+    long_refs = [
+        np.asarray(eng.generate(pr[None], long_gen))[0] for pr in prompts
+    ]
+
+    def run(spec_cfg):
+        m = Metrics()
+        sch = PagedContinuousBatchingEngine(
+            eng, slots=2, gen=long_gen, block_size=4, num_blocks=64,
+            prefill_chunk=4, draft=draft, speculative=spec_cfg,
+            metrics=m,
+        )
+        rids = [sch.submit(pr) for pr in prompts]
+        sch.run_until_idle()
+        for rid, ref in zip(rids, long_refs):
+            np.testing.assert_array_equal(sch.result(rid), ref)
+        assert sch.stats()["spec"]["acceptance_rate"] < 0.5  # truly bad
+        return sch, m.snapshot()["kv_blocks_in_use"]["mean"]
+
+    _, static_mean = run(SpecConfig(k=3, rounds=2))
+    sch, adaptive_mean = run(
+        SpecConfig(k=3, rounds=2, adaptive=True, ewma=0.8)
+    )
+    assert adaptive_mean < static_mean
+    # and the bound itself is pinned: with every live request walked
+    # down to the floor, the staged dispatch reserves rounds*(k_min+1)
+    # positions, not rounds*(k_max+1)
+    spec_cfg = sch.spec.cfg
+    rid = sch.submit(np.asarray([1, 2, 3], np.int64), max_new=4)
+    for _ in range(40):
+        sch._kctl.observe(rid, proposed=3, accepted=0)
+    slot = next(
+        s for s, r in enumerate(sch._slot_req)
+        if r is not None and r.rid == rid
+    )
+    with sch._lock:
+        sch._k_dispatch = sch._spec_k_array()
+        tight = sch._advance_bound(slot)
+        sch._k_dispatch = None
+    assert tight == spec_cfg.rounds * (spec_cfg.k_min + 1)
+    assert tight < spec_cfg.rounds * (spec_cfg.k + 1)
+    sch.result(rid)
