@@ -86,6 +86,12 @@ def main() -> None:
                     help="persistent tuning store (runtime/autotune.py)"
                          ": flash blocks, prefill buckets, and the "
                          "learned K prior reload here across restarts")
+    ap.add_argument("--profile-dir", default=None,
+                    help="capture the whole serving run under "
+                         "jax.profiler into this directory (open with "
+                         "tensorboard --logdir DIR / xprof); the "
+                         "always-on device-time attribution prints "
+                         "either way")
     args = ap.parse_args()
     if args.speculate and args.ngram:
         ap.error("--speculate and --ngram are exclusive")
@@ -198,6 +204,31 @@ def main() -> None:
                 f"autotune warm start: {st['autotune_warm_start_s']}s "
                 "(flash blocks + K prior loaded, nothing re-measured)"
             )
+        dt = st.get("device_time")
+        if dt:
+            print(
+                f"device time: {dt['device_busy_s']:.4f}s busy / "
+                f"{dt['host_gap_s']:.4f}s host gap "
+                f"(bubble {dt['host_gap_frac']:.1%})"
+            )
+            for name, p in dt["programs"].items():
+                extra = "".join(
+                    f" {k}={p[k]}" for k in ("mfu", "mbu") if k in p
+                )
+                print(
+                    f"  {name}: {p['count']} dispatches, "
+                    f"{p['busy_s']:.4f}s busy{extra}"
+                )
+        tdec = st.get("ttft_decomp")
+        if tdec:
+            print(f"ttft decomposition (EWMA): {tdec}")
+
+    prof_cm = None
+    if args.profile_dir:
+        from tensorlink_tpu.runtime.profiling import trace
+
+        prof_cm = trace(args.profile_dir)
+        prof_cm.__enter__()
     if args.paged:
         # shared-prefix traffic: every request opens with the same
         # "system prompt". The first prefill writes those tokens into
@@ -272,6 +303,12 @@ def main() -> None:
         prompts = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 8)))
         tokens = eng.generate(prompts, gen, rng=jax.random.key(0))
         print("generated:", np.asarray(tokens))
+    if prof_cm is not None:
+        prof_cm.__exit__(None, None, None)
+        print(
+            f"jax.profiler capture in {args.profile_dir} — open with: "
+            f"tensorboard --logdir {args.profile_dir}"
+        )
 
 
 if __name__ == "__main__":

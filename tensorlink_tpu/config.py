@@ -221,6 +221,18 @@ class NodeConfig:
     # restart warm-starts the CONSTANTS as well as the kernels. None
     # defers to TL_AUTOTUNE_DIR; both unset = off.
     autotune_dir: str | None = None
+    # capability microbench at WorkerNode start (runtime/profiling.py
+    # measure_capability): peak matmul TFLOPs + HBM read GB/s, cached
+    # in the autotune store under the chip-global key so restarts skip
+    # the measurement; published at /metrics, /node, and on heartbeat
+    # PONGs (the validator fleet table ROADMAP-1 placement consumes).
+    # None = on unless the TL_CAPABILITY_BENCH=0 environment kill
+    # switch is set (the test suite sets it: dozens of ephemeral
+    # workers must not each pay the bench); True forces it regardless.
+    capability_bench: bool | None = None
+    # retained jax.profiler captures from GET /profile (None = parsed
+    # and discarded per request)
+    profile_dir: str | None = None
 
     def __post_init__(self):
         # wire serialization (msgpack/json) round-trips tuples as lists;

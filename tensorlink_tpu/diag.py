@@ -147,6 +147,8 @@ def node_row(
         "error_events": 0,
         "kv_pool_pct": None,
         "spec_accept_pct": None,
+        "mfu_pct": None,
+        "bubble_pct": None,
         "flags": [],
     }
     if scrape.get("error"):
@@ -215,6 +217,26 @@ def node_row(
         # _maybe_self_heal): the condition cleared without operator
         # action — advisory flag replaced by the record of the fix
         row["flags"].append(f"SELF-HEALED({healed.get('to')})")
+    # device-time telemetry (PR 13): the node's CapabilityRecord (/node
+    # "capability") or its serving scheduler's device_time attribution.
+    # MFU% = best per-program MFU; BUBBLE% = host-gap fraction of the
+    # device timeline — above 30% the chip is waiting on the HOST
+    # (dispatch, scheduling, input pipeline), not on compute/bandwidth,
+    # and more chip will not make that node faster
+    cap = node.get("capability") or {}
+    dt = serving.get("device_time") or {}
+    progs = {**(cap.get("programs") or {}), **(dt.get("programs") or {})}
+    mfus = [
+        p.get("mfu") for p in progs.values()
+        if isinstance(p, dict) and p.get("mfu") is not None
+    ]
+    if mfus:
+        row["mfu_pct"] = round(max(mfus) * 100, 1)
+    gap = dt.get("host_gap_frac", cap.get("host_gap_frac"))
+    if gap is not None:
+        row["bubble_pct"] = round(float(gap) * 100, 1)
+        if float(gap) > 0.3:
+            row["flags"].append(f"HOST-BOUND({float(gap):.2f})")
     metrics = _route_body(scrape, "/metrics") or {}
     counters = metrics.get("counters") or {}
     row["anomalies"] = {
@@ -241,9 +263,11 @@ def cluster_table(
 def render_table(rows: list[dict[str, Any]]) -> str:
     cols = ("target", "role", "node_id", "healthy", "peers",
             "max_heartbeat_age_s", "skew", "kv_pool_pct",
-            "spec_accept_pct", "error_events", "flags")
+            "spec_accept_pct", "mfu_pct", "bubble_pct", "error_events",
+            "flags")
     titles = ("TARGET", "ROLE", "NODE", "OK", "PEERS", "HB-AGE",
-              "SKEW", "KV%", "SPEC%", "ERR-EVTS", "FLAGS")
+              "SKEW", "KV%", "SPEC%", "MFU%", "BUBBLE%", "ERR-EVTS",
+              "FLAGS")
 
     def cell(row: dict, col: str) -> str:
         v = row.get(col)
@@ -291,6 +315,10 @@ _HIGHER_BETTER = (
     # best hand-tuned static K on the same mixed workload (> 1.0 =
     # the measure->adapt loop pays)
     "vs_best_static",
+    # device-time telemetry: model-bandwidth utilization and the
+    # measured chip HBM bandwidth (capability_hbm_gbps) — more of
+    # either is strictly better ("mfu" already matches above)
+    "mbu", "gbps",
 )
 _LOWER_BETTER_RE = re.compile(
     r"(_s$|_s_per_call$|seconds|latency|bubble_fraction|drop_fraction"
@@ -302,7 +330,10 @@ _LOWER_BETTER_RE = re.compile(
     r"|kv_blocks|kv_pool_utilization|prefilled_tokens|cow_copies"
     # speculation at fixed traffic: fewer n-gram misses = the lookup
     # is finding real recurrences
-    r"|preempt|spec_fallback)"
+    r"|preempt|spec_fallback"
+    # device-time telemetry: host-gap (pipeline bubble) fraction and
+    # the measured always-on timing overhead — both pure waste
+    r"|host_gap|overhead_frac)"
 )
 
 
@@ -572,6 +603,79 @@ def latest_bench_record(root: str) -> tuple[str, dict] | None:
     return None
 
 
+# ------------------------------------------------------- /profile pull
+async def fetch_profile(
+    target: str, ms: int = 200, timeout: float | None = None
+) -> dict[str, Any]:
+    """Trigger a bounded ``GET /profile?ms=N`` capture on one node and
+    return its parsed payload (op_breakdown bundle). The HTTP timeout
+    covers the capture duration plus slack; a 409 means another capture
+    is already running there."""
+    host, port = parse_target(target)
+    status, body = await http_get(
+        host, port, f"/profile?ms={int(ms)}",
+        timeout or (ms / 1000.0 + 15.0),
+    )
+    try:
+        payload = json.loads(body) if body else None
+    except ValueError:
+        payload = {"text": body.decode(errors="replace")[:2000]}
+    return {"target": target, "status": status, "body": payload}
+
+
+def merge_profile_into_bundle(path: str, rec: dict[str, Any]) -> None:
+    """Attach a fetched /profile capture to a saved scrape bundle (the
+    node entry matching the target gains a ``/profile`` route; a fresh
+    bundle is created when the file does not exist)."""
+    import os
+
+    if os.path.exists(path):
+        with open(path) as f:
+            bundle = json.load(f)
+    else:
+        bundle = {"collected_at": time.time(),
+                  "targets": [rec["target"]], "nodes": []}
+    node = next(
+        (n for n in bundle.get("nodes", [])
+         if n.get("target") == rec["target"]),
+        None,
+    )
+    if node is None:
+        node = {"target": rec["target"], "routes": {}}
+        bundle.setdefault("nodes", []).append(node)
+    node.setdefault("routes", {})["/profile"] = {
+        "status": rec["status"], "body": rec["body"],
+    }
+    with open(path, "w") as f:
+        json.dump(bundle, f)
+
+
+def render_profile(rec: dict[str, Any]) -> str:
+    body = rec.get("body") or {}
+    if rec.get("status") != 200:
+        return (
+            f"{rec['target']}: /profile -> HTTP {rec.get('status')} "
+            f"({(body or {}).get('error', '?')})"
+        )
+    ob = body.get("op_breakdown") or {}
+    lines = [
+        f"{rec['target']}: {body.get('duration_ms')} ms capture, "
+        f"{ob.get('total_s', 0.0):.4f}s device time"
+    ]
+    for cat, d in list((ob.get("categories") or {}).items())[:8]:
+        lines.append(
+            f"  {cat}: {d['s']:.4f}s ({d['fraction']:.1%}, {d['ops']} ops)"
+        )
+    if not ob.get("categories"):
+        lines.append(
+            "  (no hlo_category events — CPU captures carry none; "
+            "this is a TPU instrument)"
+        )
+    if body.get("trace_dir"):
+        lines.append(f"  raw capture retained at {body['trace_dir']}")
+    return "\n".join(lines)
+
+
 # ------------------------------------------------------------------ CLI
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
@@ -600,6 +704,19 @@ def main(argv: list[str] | None = None) -> int:
                          "counts as moved (default 5%%)")
     bd.add_argument("--json", action="store_true", dest="as_json",
                     help="print the full diff as JSON")
+    pf = sub.add_parser(
+        "profile",
+        help="trigger a bounded jax.profiler capture on one node "
+             "(GET /profile?ms=N) and print the op breakdown",
+    )
+    pf.add_argument("target", metavar="HOST:PORT")
+    pf.add_argument("--ms", type=int, default=200,
+                    help="capture duration in milliseconds (server "
+                         "clamps to its bound)")
+    pf.add_argument("-o", "--out", default=None,
+                    help="attach the capture to this bundle JSON "
+                         "(created if missing)")
+    pf.add_argument("--timeout", type=float, default=None)
     md = sub.add_parser(
         "manifest-diff",
         help="direction verdicts between two tlhlo hlo.manifest.json "
@@ -640,6 +757,13 @@ def main(argv: list[str] | None = None) -> int:
         diff = bench_diff(old, new, args.threshold)
         print(json.dumps(diff) if args.as_json else render_bench_diff(diff))
         return 0
+    if args.cmd == "profile":
+        rec = asyncio.run(fetch_profile(args.target, args.ms, args.timeout))
+        if args.out:
+            merge_profile_into_bundle(args.out, rec)
+            print(f"capture attached to: {args.out}", file=sys.stderr)
+        print(render_profile(rec))
+        return 0 if rec.get("status") == 200 else 1
     if args.cmd == "manifest-diff":
         with open(args.old) as f:
             old = json.load(f)

@@ -106,6 +106,13 @@ class Node:
         self.flight = FlightRecorder(service=f"{cfg.role}:{self.node_id[:8]}")
         self.health = HealthState(self.flight)
         self._traffic_dog = None  # armed by start_heartbeat
+        # device-capability publishing (runtime/profiling.py): this
+        # node's own measured record (set by WorkerNode's microbench or
+        # by an operator) rides every PONG, and records harvested from
+        # peers' PONGs form the live fleet table a validator's /node
+        # serves — the placement input ROADMAP item 1 consumes
+        self.capability: dict | None = None
+        self.peer_capabilities: dict[str, dict] = {}
         self.register_handlers()
 
     # ------------------------------------------------------------ lifecycle
@@ -857,6 +864,9 @@ class Node:
         peer.stream.close()
         if self.peers.get(peer.node_id) is peer:
             del self.peers[peer.node_id]
+            # the fleet capability table is a LIVE view: a dead peer's
+            # record must not keep advertising capacity to placement
+            self.peer_capabilities.pop(peer.node_id, None)
             self.flight.record(
                 "peer_lost", "warn", peer=peer.node_id[:16], role=peer.role,
                 last_seen_age_s=round(time.time() - peer.last_seen, 3),
@@ -916,10 +926,56 @@ class Node:
             self._pending.pop(msg["id"], None)
             self._pending_peer.pop(msg["id"], None)
 
+    # capability-record sanitation bounds: a PONG arrives from the
+    # WIRE, so a hostile peer must not pin megabytes in the fleet table
+    _CAP_SCALARS = (
+        "schema", "chip", "peak_tflops", "hbm_gbps", "host_gap_frac",
+        "measured_at", "measure_s", "cached",
+    )
+    _CAP_MAX_PROGRAMS = 16
+
+    @staticmethod
+    def _cap_value(v: Any) -> Any | None:
+        """Bound one wire value: numbers/bools pass, strings truncate,
+        anything structured is dropped — a PONG field must never pin
+        more than a few bytes."""
+        if isinstance(v, bool) or isinstance(v, (int, float)):
+            return v
+        if isinstance(v, str):
+            return v[:64]
+        return None
+
+    def _note_peer_capability(self, peer: Peer, cap: Any) -> None:
+        if not isinstance(cap, dict):
+            return
+        rec: dict[str, Any] = {}
+        for k in self._CAP_SCALARS:
+            v = self._cap_value(cap.get(k))
+            if v is not None:
+                rec[k] = v
+        progs = cap.get("programs")
+        if isinstance(progs, dict):
+            rec["programs"] = {
+                str(name)[:64]: {
+                    str(pk)[:64]: cv
+                    for pk, pv in list(p.items())[:16]
+                    if (cv := self._cap_value(pv)) is not None
+                }
+                for name, p in list(progs.items())[: self._CAP_MAX_PROGRAMS]
+                if isinstance(p, dict)
+            }
+        rec["role"] = peer.role
+        rec["received_at"] = time.time()
+        self.peer_capabilities[peer.node_id] = rec
+
     async def ping(self, peer: Peer) -> float:
         t0 = time.perf_counter()
-        await self.request(peer, {"type": "PING"})
+        resp = await self.request(peer, {"type": "PING"})
         peer.ping_ms = (time.perf_counter() - t0) * 1e3
+        # heartbeat piggyback: every PONG from a capability-publishing
+        # peer refreshes this node's fleet table — a validator running
+        # start_heartbeat holds a LIVE capability view with no extra RPC
+        self._note_peer_capability(peer, resp.get("capability"))
         return peer.ping_ms
 
     # ------------------------------------------------------- failure detection
@@ -1036,7 +1092,32 @@ class Node:
 
     # ------------------------------------------------------------ handlers
     async def _h_ping(self, node, peer, msg) -> dict:
-        return {"type": "PONG", "t": time.time()}
+        out = {"type": "PONG", "t": time.time()}
+        cap = self.capability_record()
+        if cap is not None:
+            out["capability"] = cap
+        return out
+
+    def capability_record(self) -> dict | None:
+        """This node's CapabilityRecord, or None before any microbench
+        ran: the measured chip roofline (peak TFLOPs, HBM GB/s) plus —
+        when a serving scheduler is attached — its live per-program
+        device-time/MFU/MBU attribution and host-gap fraction. Rides
+        every PONG and is served at /node; WorkerNode extends it with
+        per-stage program MFU."""
+        if self.capability is None:
+            return None
+        rec = dict(self.capability)
+        serving = getattr(self, "serving", None)
+        if serving is not None and hasattr(serving, "device_time"):
+            try:
+                dt = serving.device_time()
+            except Exception:  # noqa: BLE001 — telemetry must not PONG 500s
+                dt = None
+            if dt:
+                rec["programs"] = dt["programs"]
+                rec["host_gap_frac"] = dt["host_gap_frac"]
+        return rec
 
     def dht_store_allowed(self, peer: Peer, key: str) -> bool:
         """Remote-write policy. 'rep:' (reputation) keys are local-only —
@@ -1104,6 +1185,17 @@ class Node:
                 out["serving"] = serving.stats()
             except Exception:  # noqa: BLE001 — status must not 500
                 pass
+        cap = self.capability_record()
+        if cap is not None:
+            out["capability"] = cap
+        if self.peer_capabilities:
+            # the live fleet table harvested from heartbeat PONGs —
+            # on a validator this is the per-worker roofline view the
+            # disaggregated-placement work (ROADMAP item 1) consumes
+            out["fleet"] = {
+                nid[:16]: rec
+                for nid, rec in self.peer_capabilities.items()
+            }
         return out
 
     def _straggler_report(self) -> dict:

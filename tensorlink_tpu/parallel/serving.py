@@ -156,9 +156,20 @@ class _Request:
     max_new: int
     seed: int
     submitted_at: float
+    # wall-clock anchor for the reconstructed span timeline: every
+    # other stamp is perf_counter (monotonic), converted at emission
+    submitted_ns: int = 0
     slot: int | None = None
     first_token: jax.Array | None = None  # device scalar from prefill
     first_token_at: float | None = None
+    # TTFT decomposition stamps: admission (slot mapped), first prefill
+    # program dispatched (== admission on the contiguous engine; a later
+    # scheduler step on the paged chunked-prefill path)
+    admitted_at: float | None = None
+    prefill_started_at: float | None = None
+    prefill_chunks: int = 0
+    # in-flight DispatchTimer token for this request's (last) prefill
+    disp: object | None = None
     tokens: list[int] = field(default_factory=list)
     done: bool = False
     finished_at: float | None = None
@@ -199,6 +210,9 @@ class ContinuousBatchingEngine:
         autotune_dir: str | None = None,
         metrics=None,
         recorder=None,
+        tracer=None,
+        device_timing: bool = True,
+        capability: dict | None = None,
     ):
         if engine.rolling:
             raise NotImplementedError(
@@ -226,8 +240,25 @@ class ContinuousBatchingEngine:
         self.keep_results = max(int(keep_results), 1)
         self.metrics = metrics
         self.recorder = recorder
+        self.tracer = tracer
         self.L = engine.cache_len
         self._lock = threading.Lock()
+
+        # always-on per-dispatch device timing (runtime/profiling.py):
+        # every decode/spec/prefill dispatch is attributed into
+        # device-busy vs host-gap, riding the drains that already
+        # synchronize — no block_until_ready added to the hot path.
+        # ``capability`` (measure_capability record) supplies the peak
+        # TFLOPs / HBM GB/s that turn per-program flops/bytes (captured
+        # at AOT compile) into MFU/MBU.
+        from tensorlink_tpu.runtime.profiling import DispatchTimer
+
+        self._timer = DispatchTimer(metrics=metrics) if device_timing else None
+        self.capability = capability
+        self._prog_cost: dict[str, dict] = {}
+        # per-phase TTFT decomposition EWMAs (queue vs prefill-compute
+        # vs first-dispatch), folded in at _finish
+        self._ttft_decomp: dict[str, float] = {}
 
         self._queue: collections.deque[_Request] = collections.deque()
         self._requests: dict[int, _Request] = {}
@@ -718,13 +749,20 @@ class ContinuousBatchingEngine:
             donate_argnums=(1,),
         )
 
+    def _decode_program_name(self) -> str:
+        return "spec_chunk" if self.spec is not None else "decode"
+
     def _dispatch_decode(self) -> tuple:
-        """Dispatch one decode/spec chunk; returns the device payload
-        for the in-flight queue ((toks,) plain, (toks, n_emit, n_acc,
-        fallback, n_prop) speculative)."""
+        """Dispatch one decode/spec chunk; returns (device payload for
+        the in-flight queue ((toks,) plain, (toks, n_emit, n_acc,
+        fallback, n_prop) speculative), dispatch-timer token)."""
         out = self._decode(*self._program_args(), *self._decode_extra())
         self._state = out[0]
-        return out[1:]
+        disp = None
+        if self._timer is not None:
+            # probe = the chunk's token OUTPUT (never the donated state)
+            disp = self._timer.dispatch(self._decode_program_name(), out[1])
+        return out[1:], disp
 
     def _bucket(self, t0: int) -> int:
         b = -(-t0 // self.prefill_block) * self.prefill_block
@@ -821,6 +859,11 @@ class ContinuousBatchingEngine:
         if fn is not None:
             self._prefill_jit.move_to_end(Tp)
             return fn
+        if self._timer is not None:
+            # about to pay an XLA compile: stamp anything already-ready
+            # NOW so the compile seconds don't inflate an in-flight
+            # dispatch's busy window (poll granularity, cold start)
+            self._timer.poll()
         t0 = time.perf_counter()
         jitfn = self._build_prefill(Tp)
         i32 = jnp.int32
@@ -842,6 +885,10 @@ class ContinuousBatchingEngine:
             fn = jitfn
             aot = False
         compile_s = self._record_compile("prefill", t0, aot, bucket=Tp)
+        if aot:
+            # per-bucket flops differ; the LAST compiled bucket's cost
+            # stands in for "prefill" (advisory MFU, not a pin)
+            self._note_cost("prefill", fn)
         if self.metrics is not None:
             self.metrics.observe("serving_prefill_compile_s", compile_s)
         self._prefill_jit[Tp] = fn
@@ -875,6 +922,8 @@ class ContinuousBatchingEngine:
         except Exception:  # noqa: BLE001 — fall back to lazy jit
             aot = False
         self._record_compile("decode", t0, aot)
+        if aot:
+            self._note_cost(self._decode_program_name(), self._decode)
         # the same bucket set the autotune store keys on — one
         # computation on purpose, so persisted tuning can never key on
         # a different set than the engine actually warms
@@ -942,6 +991,28 @@ class ContinuousBatchingEngine:
                 self.recorder.record(kind, severity, **data)
             except Exception:  # noqa: BLE001 — telemetry must not serve 500s
                 pass
+
+    def _note_cost(self, program: str, compiled) -> None:
+        """Stash an AOT-compiled program's XLA cost analysis (flops +
+        bytes accessed) under the DispatchTimer program name, so
+        ``device_time`` can derive per-program MFU/MBU from measured
+        device-busy time. Opportunistic: captured only where an AOT
+        compile already happened — never a hot-path compile."""
+        if self._timer is None:
+            return
+        try:
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            rec = {}
+            if cost.get("flops"):
+                rec["flops"] = float(cost["flops"])
+            if cost.get("bytes accessed"):
+                rec["bytes"] = float(cost["bytes accessed"])
+            if rec:
+                self._prog_cost[program] = rec
+        except Exception:  # noqa: BLE001 — advisory; not every backend reports
+            pass
 
     def _record_compile(self, program: str, t0: float, aot: bool = True,
                         **extra) -> float:
@@ -1012,6 +1083,9 @@ class ContinuousBatchingEngine:
             req = _Request(
                 rid=rid, ids=ids, max_new=max_new, seed=int(seed),
                 submitted_at=time.perf_counter(),
+                # wall-clock anchor: the span timeline converts the
+                # monotonic stamps against this pair
+                submitted_ns=time.time_ns(),
             )
             self._requests[rid] = req
             self._admit_or_queue(req)
@@ -1069,6 +1143,7 @@ class ContinuousBatchingEngine:
             jnp.asarray(pm), jnp.int32(slot), jnp.uint32(req.seed),
             jnp.int32(req.max_new),
         )
+        req.admitted_at = time.perf_counter()
         try:
             self._state, tok0 = fn(*args)
         except (TypeError, ValueError):
@@ -1078,7 +1153,13 @@ class ContinuousBatchingEngine:
             # consumed), fall back to the plain jit path for this bucket
             fn = self._prefill_jit[Tp] = self._build_prefill(Tp)
             self._state, tok0 = fn(*args)
+        # admission IS the prefill dispatch on this engine (the paged
+        # engine stamps these apart, chunked prefill runs later steps)
+        req.prefill_started_at = time.perf_counter()
+        req.prefill_chunks += 1
         req.first_token = tok0
+        if self._timer is not None:
+            req.disp = self._timer.dispatch("prefill", tok0)
         self._event("serving.admit", rid=req.rid, slot=slot, padded=Tp)
 
     def _maybe_record_ttft(self, req: _Request) -> None:
@@ -1092,10 +1173,71 @@ class ContinuousBatchingEngine:
                     "serving_ttft_s", req.first_token_at - req.submitted_at
                 )
 
+    def _ewma_decomp(self, name: str, value: float) -> None:
+        old = self._ttft_decomp.get(name)
+        self._ttft_decomp[name] = round(
+            value if old is None else 0.8 * old + 0.2 * value, 6
+        )
+
+    def _emit_request_timeline(self, req: _Request) -> None:
+        """Per-request span tree at finish: queue wait, prefill, decode
+        stitched under one ``serving.request`` root (its own trace in
+        /spans — one Perfetto row per request), plus the TTFT-
+        decomposition EWMAs ``stats()`` serves. Stamps were taken on
+        the hot path; reconstruction here costs one finished request's
+        worth of work, never a per-token span."""
+        sub, adm = req.submitted_at, req.admitted_at
+        ps, ft = req.prefill_started_at, req.first_token_at
+        if adm is not None:
+            self._ewma_decomp("queue_s", adm - sub)
+            if ps is not None:
+                self._ewma_decomp("dispatch_s", ps - adm)
+                if ft is not None:
+                    self._ewma_decomp("prefill_s", ft - ps)
+        if self.tracer is None or not req.submitted_ns:
+            return
+
+        def ns(t: float | None) -> int | None:
+            return (
+                None if t is None
+                else req.submitted_ns + int((t - sub) * 1e9)
+            )
+
+        end = ns(req.finished_at) or req.submitted_ns
+        root = self.tracer.record_span(
+            "serving.request", req.submitted_ns, end,
+            {
+                "rid": req.rid, "tokens": len(req.tokens),
+                "prefill_chunks": req.prefill_chunks,
+                "spec_rounds": req.spec_rounds,
+            },
+        )
+        if adm is not None:
+            self.tracer.record_span(
+                "serving.queue_wait", req.submitted_ns, ns(adm),
+                {"rid": req.rid}, parent=root,
+            )
+        if ps is not None and ft is not None:
+            self.tracer.record_span(
+                "serving.prefill", ns(ps), ns(ft),
+                {"rid": req.rid, "chunks": req.prefill_chunks},
+                parent=root,
+            )
+        if ft is not None:
+            self.tracer.record_span(
+                "serving.decode", ns(ft), end,
+                {
+                    "rid": req.rid, "tokens": len(req.tokens),
+                    "spec_rounds": req.spec_rounds,
+                },
+                parent=root,
+            )
+
     def _finish(self, req: _Request) -> None:
         req.done = True
         req.finished_at = time.perf_counter()
         req.ids = None  # prompt no longer needed; keep retention light
+        self._emit_request_timeline(req)
         slot = req.slot
         if slot is not None and self._slot_req[slot] is req:
             self._slot_req[slot] = None
@@ -1143,20 +1285,26 @@ class ContinuousBatchingEngine:
             self._finish(req)
 
     def _drain_one(self) -> None:
-        payload, snapshot = self._inflight.popleft()
+        payload, snapshot, disp = self._inflight.popleft()
         for req in snapshot:
             if req is not None:
                 self._take_first(req)
         if self.spec is None:
             arr = np.asarray(payload[0])  # [K, S] — THE host sync point
+            if disp is not None:
+                self._timer.drained(disp)  # right after the sync: exact
+            emitted = 0
             for k in range(arr.shape[0]):
                 for s, req in enumerate(snapshot):
                     if req is not None and not req.done:
                         self._append_token(req, arr[k, s])
+                        emitted += 1
+            if self._timer is not None:
+                self._timer.count_tokens("decode", emitted)
             return
-        self._drain_spec(payload, snapshot)
+        self._drain_spec(payload, snapshot, disp)
 
-    def _drain_spec(self, payload, snapshot) -> None:
+    def _drain_spec(self, payload, snapshot, disp=None) -> None:
         """Drain one speculative chunk: ``toks [R, K+1, S]`` gated by
         ``n_emit [R, S]`` (0 = the row was not live that round), with
         ``n_acc [R, S]`` the verifier's PRE-CLIP accepted-proposal
@@ -1168,6 +1316,8 @@ class ContinuousBatchingEngine:
         is ``n_acc / n_prop`` — and the same ratio feeds the adaptive
         controller, closing the measure→adapt loop per request."""
         toks = np.asarray(payload[0])  # THE host sync point
+        if disp is not None:
+            self._timer.drained(disp)  # right after the sync: exact
         ne = np.asarray(payload[1])
         na = np.asarray(payload[2])
         fb = np.asarray(payload[3])
@@ -1195,6 +1345,8 @@ class ContinuousBatchingEngine:
                     if req.done:
                         break
                     self._append_token(req, toks[r, k, s])
+        if self._timer is not None:
+            self._timer.count_tokens("spec_chunk", emitted)
         self.spec_rounds_total += rounds
         self.spec_emitted_total += emitted
         self.spec_accepted_total += accepted
@@ -1298,6 +1450,9 @@ class ContinuousBatchingEngine:
         ``req.tokens`` may legitimately be non-empty here.)"""
         if req.first_token is not None:
             t0 = int(np.asarray(req.first_token))
+            if req.disp is not None and self._timer is not None:
+                self._timer.drained(req.disp)  # prefill synced here
+            req.disp = None
             self._maybe_record_ttft(req)
             req.first_token = None
             self._append_token(req, t0)
@@ -1312,11 +1467,15 @@ class ContinuousBatchingEngine:
             self._admit_waiting()
             busy = any(r is not None for r in self._slot_req)
             if busy:
-                payload = self._dispatch_decode()
-                self._inflight.append((payload, tuple(self._slot_req)))
+                payload, disp = self._dispatch_decode()
+                self._inflight.append((payload, tuple(self._slot_req), disp))
             for r in self._slot_req:
                 if r is not None:
                     self._maybe_record_ttft(r)
+            if self._timer is not None:
+                # opportunistic ready stamping: one is_ready per pending
+                # FIFO head per step — the attribution granularity
+                self._timer.poll()
             while len(self._inflight) > (self.pipeline_depth if busy else 0):
                 self._drain_one()
             if not busy:
@@ -1418,6 +1577,35 @@ class ContinuousBatchingEngine:
             out["k_prior"] = self._kctl.prior()
         return out
 
+    def _device_time_locked(self) -> dict | None:
+        """Per-program device-busy/host-gap attribution + derived
+        MFU/MBU (when an AOT compile captured the program's cost and a
+        capability record supplies the chip peaks)."""
+        if self._timer is None:
+            return None
+        snap = self._timer.snapshot()
+        cap = self.capability or {}
+        for name, rec in snap["programs"].items():
+            cost = self._prog_cost.get(name)
+            if not cost or not rec["count"] or rec["busy_s"] <= 0:
+                continue
+            per = rec["busy_s"] / rec["count"]
+            if cost.get("flops") and cap.get("peak_tflops"):
+                rec["mfu"] = round(
+                    cost["flops"] / per / (cap["peak_tflops"] * 1e12), 4
+                )
+            if cost.get("bytes") and cap.get("hbm_gbps"):
+                rec["mbu"] = round(
+                    cost["bytes"] / per / (cap["hbm_gbps"] * 1e9), 4
+                )
+        return snap
+
+    def device_time(self) -> dict | None:
+        """Public (locked) form of the per-program attribution — what
+        ``capability_record`` piggybacks on heartbeats."""
+        with self._lock:
+            return self._device_time_locked()
+
     def stats(self) -> dict:
         """Host-side scheduler snapshot (queue depth, slot occupancy)."""
         with self._lock:
@@ -1430,6 +1618,13 @@ class ContinuousBatchingEngine:
                 "inflight_chunks": len(self._inflight),
                 "requests": len(self._requests),
             }
+            dt = self._device_time_locked()
+            if dt is not None:
+                out["device_time"] = dt
+            if self._ttft_decomp:
+                # TTFT decomposed: queue wait vs first prefill dispatch
+                # vs prefill compute (EWMAs over finished requests)
+                out["ttft_decomp"] = dict(self._ttft_decomp)
             if self.spec is not None:
                 out["spec"] = self._spec_stats()
             if self.spec_self_healed is not None:
@@ -1794,6 +1989,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             except Exception:  # noqa: BLE001 — AOT is an optimization only
                 aot = False
             self._record_compile(program, t0, aot)
+            if aot:
+                # map onto the DispatchTimer program names: the decode
+                # attr runs as the spec chunk when speculation is on
+                self._note_cost(
+                    self._decode_program_name() if attr == "_decode"
+                    else "prefill_chunk",
+                    getattr(self, attr),
+                )
 
     def _spec_open_mask(self, state, f0):
         """Paged rows are never padded and attend in LOGICAL
@@ -1960,6 +2163,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             return False
         slot = self._free.pop()
         req.slot = slot
+        req.admitted_at = time.perf_counter()
         self._slot_req[slot] = req
         self._slot_blocks[slot] = (
             hits + ([tail_bid] if tail is not None else []) + new_blocks
@@ -2028,6 +2232,13 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         )
         job["pos"] = pos + nreal
         req = self._slot_req[slot]
+        if req.prefill_started_at is None:
+            req.prefill_started_at = time.perf_counter()
+        req.prefill_chunks += 1
+        if self._timer is not None:
+            # every chunk is its own dispatch; tok0 (a device scalar
+            # output, garbage on non-final chunks) is the ready probe
+            req.disp = self._timer.dispatch("prefill_chunk", tok0)
         self._event(
             "serving.prefill_chunk", rid=req.rid, slot=slot, start=pos,
             tokens=nreal, final=is_final,
@@ -2200,7 +2411,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             if decoding:
                 decoding = self._grow_blocks(decoding)
             if decoding:
-                payload = self._dispatch_decode()
+                payload, disp = self._dispatch_decode()
                 live = set(decoding)
                 # mid-prefill slots are NOT live on device: their rows
                 # emit fill tokens that must never reach a request
@@ -2208,10 +2419,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     r if s in live else None
                     for s, r in enumerate(self._slot_req)
                 )
-                self._inflight.append((payload, snap))
+                self._inflight.append((payload, snap, disp))
             for r in self._slot_req:
                 if r is not None:
                     self._maybe_record_ttft(r)
+            if self._timer is not None:
+                self._timer.poll()
             # an undispatched staged array must not leak into a later
             # step whose controller has moved on
             self._k_dispatch = None

@@ -1087,6 +1087,11 @@ class UserNode(Node):
         kw.setdefault("recorder", self.flight)
         kw.setdefault("compile_cache_dir", self.cfg.compile_cache_dir)
         kw.setdefault("autotune_dir", self.cfg.autotune_dir)
+        # per-request span timelines land in this node's /spans, and a
+        # node that already measured its chip (self.capability) hands
+        # the peaks down so the engine's device_time reports MFU/MBU
+        kw.setdefault("tracer", self.tracer)
+        kw.setdefault("capability", self.capability)
         cls = PagedContinuousBatchingEngine if paged else ContinuousBatchingEngine
         self.serving = cls(engine, **kw)
         return self.serving

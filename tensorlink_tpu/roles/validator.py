@@ -151,13 +151,24 @@ class ValidatorNode(Node):
         data-parallel replica slot (reference: planned dp_factor,
         src/roles/user.py:161 — implemented here)."""
         spec = job.stages[stage_index]
+
+        def rank(kv):
+            nid, s = kv
+            # best-fit on memory first (smallest adequate slot), then —
+            # among equal-memory candidates — the FASTER chip by the
+            # measured peak TFLOPs its heartbeat capability record
+            # published (the fleet table's first placement consumer;
+            # ROADMAP item 1 extends this to full roofline placement)
+            cap = self.peer_capabilities.get(nid) or {}
+            return (s.get("memory", 0), -(cap.get("peak_tflops") or 0.0))
+
         candidates = sorted(
             (
                 (nid, s)
                 for nid, s in stats.items()
                 if nid not in taken and s.get("memory", 0) >= spec.param_bytes * 4
             ),
-            key=lambda kv: kv[1].get("memory", 0),
+            key=rank,
         )
         for nid, s in candidates:
             peer = self.peers.get(nid)

@@ -282,6 +282,21 @@ class StageRunner:
                             "output_bytes": int(m.output_size_in_bytes),
                             "code_bytes": int(m.generated_code_size_in_bytes),
                         }
+                        try:
+                            # cost analysis beside the memory numbers:
+                            # flops / measured stage{i}_fwd_s mean is the
+                            # per-stage MFU the CapabilityRecord reports
+                            cost = c.cost_analysis()
+                            if isinstance(cost, (list, tuple)):
+                                cost = cost[0]
+                            if cost.get("flops"):
+                                rec["flops"] = float(cost["flops"])
+                            if cost.get("bytes accessed"):
+                                rec["bytes_accessed"] = float(
+                                    cost["bytes accessed"]
+                                )
+                        except Exception:  # noqa: BLE001 — advisory only
+                            pass
                         # keep the LARGEST footprint per program across
                         # compiled shapes — the capacity model must see the
                         # peak, not whichever shape compiled last
@@ -522,6 +537,11 @@ class WorkerNode(Node):
         # pick them (runtime/autotune.py)
         self.autotune_warm_start_s: float | None = None
         self._load_autotune(cfg)
+        # capability microbench (runtime/profiling.py): runs in the
+        # background at start(), cached in the autotune store under the
+        # same chip-global key so restarts skip it; the record rides
+        # every heartbeat PONG into validators' fleet tables
+        self.capability_ready = asyncio.Event()
         self.registry = registry  # optional: verifies validator identity
         self.stages: dict[tuple[str, int], StageRunner] = {}
         # DP replica grad exchange: (job, stage, step, sender) -> (g, n)
@@ -566,8 +586,10 @@ class WorkerNode(Node):
     def save_autotune(self) -> str | None:
         """Persist this worker's installed flash-block overrides under
         the chip-global key (a tuning sweep's result must outlive the
-        process that ran it). Returns the written path or None when no
-        store is configured."""
+        process that ran it). A MERGE, not a blind save: the capability
+        microbench shares this key, and overwriting would force the
+        next restart to re-measure the chip. Returns the written path
+        or None when no store is configured."""
         from tensorlink_tpu.ops.flash import flash_block_overrides
         from tensorlink_tpu.runtime.autotune import AutotuneStore
 
@@ -576,10 +598,86 @@ class WorkerNode(Node):
         )
         if store is None:
             return None
-        return str(store.save(
+        return str(store.update(
             self._autotune_key(),
             {"flash_blocks": [list(t) for t in flash_block_overrides()]},
         ))
+
+    # ---------------------------------------------------------- capability
+    def _capability_enabled(self) -> bool:
+        import os
+
+        if self.cfg.capability_bench is not None:
+            return bool(self.cfg.capability_bench)
+        return os.environ.get("TL_CAPABILITY_BENCH", "1") != "0"
+
+    async def start(self) -> None:
+        await super().start()
+        if self._capability_enabled():
+            # off the start path: peers can handshake while the bench
+            # (two tiny jits + timed loops, autotune-cached) runs
+            self._spawn(self._measure_capability_task())
+
+    async def _measure_capability_task(self) -> None:
+        from tensorlink_tpu.runtime.autotune import AutotuneStore
+        from tensorlink_tpu.runtime.profiling import measure_capability
+
+        store = AutotuneStore.resolve(
+            self.cfg.autotune_dir, recorder=self.flight
+        )
+        try:
+            cap = await asyncio.to_thread(
+                measure_capability,
+                store=store,
+                key=self._autotune_key() if store is not None else None,
+                recorder=self.flight,
+            )
+        except Exception as e:  # noqa: BLE001 — telemetry must not kill start
+            self.flight.record(
+                "capability.failed", "warn", error=repr(e)
+            )
+            self.capability_ready.set()
+            return
+        self.capability = cap
+        self.metrics.observe("capability_peak_tflops", cap["peak_tflops"])
+        self.metrics.observe("capability_hbm_gbps", cap["hbm_gbps"])
+        self.capability_ready.set()
+
+    def capability_record(self) -> dict | None:
+        """Base record (chip peaks + any attached serving scheduler's
+        per-program attribution) extended with per-STAGE program MFU:
+        XLA's compile-time flops over the measured ``stage{i}_fwd_s``
+        mean — the roofline entry per loaded pipeline stage."""
+        rec = super().capability_record()
+        if rec is None:
+            return None
+        progs = dict(rec.get("programs") or {})
+        peak = rec.get("peak_tflops") or 0.0
+        gbps = rec.get("hbm_gbps") or 0.0
+        for (jid, idx), runner in self.stages.items():
+            mem = runner.memory_stats()["programs"]
+            for tag in ("fwd", "bwd"):
+                q = self.metrics.series.get(f"stage{idx}_{tag}_s")
+                if not q:
+                    continue
+                vals = list(q)
+                mean_s = sum(vals) / len(vals)
+                entry: dict = {"mean_s": round(mean_s, 6), "n": len(vals)}
+                prog = mem.get(tag) or mem.get(f"{tag}_train") or {}
+                # 6 decimals: a CI-sized stage on CPU has an MFU in the
+                # 1e-5 range — 4 would truncate it to a false zero
+                if mean_s > 0 and prog.get("flops") and peak:
+                    entry["mfu"] = round(
+                        prog["flops"] / mean_s / (peak * 1e12), 6
+                    )
+                if mean_s > 0 and prog.get("bytes_accessed") and gbps:
+                    entry["mbu"] = round(
+                        prog["bytes_accessed"] / mean_s / (gbps * 1e9), 6
+                    )
+                progs[f"stage{idx}_{tag}"] = entry
+        if progs:
+            rec["programs"] = progs
+        return rec
 
     def on_peer_lost(self, peer: Peer) -> None:
         """A lost job OWNER strands this worker's loaded stages: until

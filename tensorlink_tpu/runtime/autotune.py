@@ -175,6 +175,19 @@ class AutotuneStore:
             return None
         return rec
 
+    def update(self, key: str, patch: dict) -> Path:
+        """Merge ``patch`` into the record under ``key`` (load-modify-
+        save; missing/stale records start empty). The writer-owns-its-
+        keys discipline callers follow: WorkerNode persists
+        ``flash_blocks`` and the capability microbench persists
+        ``capability`` under the SAME chip-global key — a blind save
+        from either would silently drop the other's measurement."""
+        rec = self.load(key) or {}
+        for stamp in ("schema", "key", "jax", "chip", "saved_at"):
+            rec.pop(stamp, None)  # save() re-stamps these
+        rec.update(patch)
+        return self.save(key, rec)
+
     def save(self, key: str, record: dict) -> Path:
         """Atomically persist ``record`` under ``key`` (schema, key, and
         runtime facts stamped here, so a loader can validate them)."""

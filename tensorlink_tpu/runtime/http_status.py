@@ -18,6 +18,11 @@ node can serve status: a dependency-free asyncio HTTP/1.1 responder with
                      when a watchdog tripped / a readiness condition is
                      set / the event loop lags (truthful liveness +
                      readiness, not a hardcoded constant)
+    GET /profile  -> bounded jax.profiler capture of whatever the node
+                     is doing right now (?ms=N, clamped to
+                     profiling.MAX_PROFILE_MS), parsed into the
+                     op_breakdown bundle; a concurrent capture is
+                     refused with 409 — jax.profiler is process-global
 
 Read only, bound to the node's host; HEAD is answered with headers only.
 Every response carries ``Cache-Control: no-store`` — a proxy caching
@@ -60,9 +65,30 @@ class StatusServer:
 
     def _routes(self) -> dict[str, Callable[[dict], Any]]:
         """path -> handler(query_params) -> body. A handler returns a
-        JSON-serializable object, or ``(content_type, text)`` for
-        non-JSON payloads (the Prometheus exposition)."""
+        JSON-serializable object, ``(content_type, text)`` for non-JSON
+        payloads (the Prometheus exposition), or an awaitable of either
+        (the /profile capture runs off-loop)."""
         node = self.node
+
+        def profile(q: dict):
+            async def run():
+                from tensorlink_tpu.runtime import profiling
+
+                ms = int(q.get("ms", 200))
+                log_dir = getattr(
+                    getattr(node, "cfg", None), "profile_dir", None
+                )
+                try:
+                    # to_thread: the capture sleeps for its duration and
+                    # jax.profiler start/stop can block — never on the
+                    # node's event loop
+                    return await asyncio.to_thread(
+                        profiling.timed_capture, ms, log_dir
+                    )
+                except profiling.ProfileBusyError as e:
+                    return Response("409 Conflict", {"error": str(e)})
+
+            return run()
 
         def healthz(q: dict):
             health = getattr(node, "health", None)
@@ -76,6 +102,7 @@ class StatusServer:
         routes: dict[str, Callable[[dict], Any]] = {
             "/healthz": healthz,
             "/node": lambda q: node.status(),
+            "/profile": profile,
         }
         flight = getattr(node, "flight", None)
         if flight is not None:
@@ -150,6 +177,8 @@ class StatusServer:
             else:
                 try:
                     status, body = "200 OK", handler(query)
+                    if asyncio.iscoroutine(body):
+                        body = await body
                 except Exception as e:  # noqa: BLE001 — must answer 500
                     status, body = "500 Internal Server Error", {
                         "error": type(e).__name__

@@ -68,6 +68,15 @@ DEFAULT_BUCKETS = (
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
 )
 
+# Device-dispatch-shaped buckets (seconds): a decode chunk on a real
+# chip lands in the 10 µs..10 ms range, where DEFAULT_BUCKETS would bin
+# every observation into the first bucket and flatten the quantiles.
+# Used by the DispatchTimer per-program busy histograms.
+DEVICE_BUCKETS = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0,
+)
+
 
 class Histogram:
     """Fixed-bucket histogram with cumulative counts — O(len(buckets))

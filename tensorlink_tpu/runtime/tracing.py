@@ -199,6 +199,41 @@ class Tracer:
 
         return deco
 
+    def record_span(
+        self,
+        name: str,
+        start_ns: int,
+        end_ns: int,
+        attrs: dict | None = None,
+        *,
+        trace_id: str | None = None,
+        parent: Span | None = None,
+        status: str = "ok",
+    ) -> Span:
+        """Append an already-finished span from explicit timestamps —
+        for reconstructed timelines (the serving engines stitch each
+        request's queue/prefill/decode phases at finish time, from
+        stamps taken on the hot path where opening a live span per
+        phase would mean span context churn per token chunk). Same
+        buffer/eviction as live spans; ``parent`` nests it under
+        another recorded span, ``trace_id`` groups siblings."""
+        s = Span(
+            name=name,
+            trace_id=(
+                trace_id if trace_id is not None
+                else (parent.trace_id if parent is not None else _new_id())
+            ),
+            span_id=_new_id(),
+            parent_id=parent.span_id if parent is not None else None,
+            attrs=dict(attrs or {}),
+            start_ns=int(start_ns),
+            end_ns=int(end_ns),
+            status=status,
+        )
+        with self._lock:
+            self._spans.append(s)
+        return s
+
     # -------------------------------------------------------------- read
     def spans(self) -> list[Span]:
         with self._lock:
@@ -298,6 +333,12 @@ class StepTelemetry:
                 for x in jax.tree.leaves(batch)
             ),
         )
+
+    def seen(self, batch: Any, rng: Any) -> bool:
+        """Whether this call signature already compiled — i.e. the next
+        :meth:`step` will be a real step, not a compile (the device
+        timer skips compile calls)."""
+        return self.shape_key(batch, rng) in self._seen
 
     @contextlib.contextmanager
     def step(self, batch: Any, rng: Any) -> Iterator[None]:

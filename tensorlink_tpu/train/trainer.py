@@ -94,10 +94,17 @@ class Trainer:
             flight = default_recorder()
         self.flight = flight
         self._telemetry = None
+        self._timer = None
         if tracer is not None or metrics is not None:
+            from tensorlink_tpu.runtime.profiling import DispatchTimer
             from tensorlink_tpu.runtime.tracing import StepTelemetry
 
             self._telemetry = StepTelemetry(tracer, metrics, "trainer")
+            # per-step device-busy vs host-gap attribution: the
+            # telemetry path already syncs per step (the non-finite
+            # check below), so the device timer rides that sync — an
+            # uninstrumented trainer stays fully async and untimed
+            self._timer = DispatchTimer(metrics=metrics)
         if cfg.fsdp:
             # same convention as the train_only guard: a mode this class
             # cannot honor must fail loudly, not run silently replicated
@@ -242,16 +249,36 @@ class Trainer:
         return self._telemetry.data()
 
     # -- public ----------------------------------------------------------
+    def device_time(self) -> dict | None:
+        """Per-step device-busy vs host-gap attribution (None on an
+        uninstrumented trainer): ``host_gap_frac`` here is the input-
+        pipeline/host-work bubble — the device idle between the end of
+        one train step and the dispatch of the next."""
+        return None if self._timer is None else self._timer.snapshot()
+
     def train_step(self, state: TrainState, batch, rng):
         if self._telemetry is None:
             return self._train_step(state, batch, rng)
+        # skip device timing on a compile call (StepTelemetry's cache
+        # key): charging XLA compile as device-busy would poison the
+        # EWMAs for the whole run
+        time_this = self._timer is not None and self._telemetry.seen(
+            batch, rng
+        )
         with self._telemetry.step(batch, rng):
             state, stats = self._train_step(state, batch, rng)
+        disp = (
+            self._timer.dispatch("train_step", stats.get("loss"))
+            if time_this else None
+        )
         # host-side anomaly accounting. bool() forces a device sync, so
         # it rides ONLY the telemetry path — an uninstrumented trainer
         # keeps the fully-async dispatch (the in-jit flag is still in
         # stats for callers that want it)
-        if bool(stats.get("nonfinite", False)):
+        nonfinite = bool(stats.get("nonfinite", False))
+        if disp is not None:
+            self._timer.drained(disp)  # right after the sync above
+        if nonfinite:
             if self.metrics is not None:
                 self.metrics.incr("train_nonfinite_total")
             if self.flight is not None:

@@ -9,6 +9,11 @@ Must set XLA flags before jax initializes.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the shell may export axon/tpu
+# the WorkerNode capability microbench defaults ON in production; the
+# suite constructs dozens of ephemeral workers and must not pay a
+# per-worker bench — tests that exercise it opt back in with
+# NodeConfig(capability_bench=True)
+os.environ.setdefault("TL_CAPABILITY_BENCH", "0")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -46,6 +51,30 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "asyncio: async test executed via asyncio.run"
     )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """CI post-mortem: on a failing tier-1 run, dump the process flight
+    recorder + versions as a postmortem bundle into $TL_CI_DIAG_DIR so
+    the workflow can upload it as an artifact (the same bundle
+    `node.postmortem()` / the crash handler writes)."""
+    d = os.environ.get("TL_CI_DIAG_DIR")
+    if not d or exitstatus == 0:
+        return
+    try:
+        from tensorlink_tpu.runtime.flight import (
+            default_recorder,
+            write_postmortem,
+        )
+
+        os.makedirs(d, exist_ok=True)
+        write_postmortem(
+            os.path.join(d, "postmortem.json"),
+            f"pytest exit {exitstatus}",
+            recorder=default_recorder(),
+        )
+    except Exception as e:  # noqa: BLE001 — diagnostics must not mask the run
+        print(f"ci-diag postmortem failed: {e}")  # noqa: T201
 
 
 def pytest_pyfunc_call(pyfuncitem):

@@ -1,0 +1,527 @@
+"""PR-13 device-time telemetry: DispatchTimer attribution, capability
+microbench + heartbeat publishing, /profile endpoint, per-request
+timelines, and the bounded-cardinality guarantee."""
+
+import asyncio
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.config import MeshConfig, NodeConfig
+from tensorlink_tpu.models.llama import Llama, LlamaConfig
+from tensorlink_tpu.parallel.inference import (
+    GenerationConfig,
+    InferenceEngine,
+)
+from tensorlink_tpu.parallel.serving import (
+    ContinuousBatchingEngine,
+    PagedContinuousBatchingEngine,
+)
+from tensorlink_tpu.runtime.mesh import make_mesh
+from tensorlink_tpu.runtime.metrics import Metrics
+from tensorlink_tpu.runtime.profiling import (
+    MAX_PROFILE_MS,
+    MIN_PROFILE_MS,
+    DispatchTimer,
+    ProfileBusyError,
+    _clamp_ms,
+    measure_capability,
+    timed_capture,
+)
+
+KEY = jax.random.key(0)
+
+
+class FakeProbe:
+    def __init__(self, ready=False):
+        self.ready = ready
+
+    def is_ready(self):
+        return self.ready
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+# ------------------------------------------------------- timer math
+def test_dispatch_timer_attribution_math():
+    """Exact busy/gap decomposition from dispatch + ready stamps: the
+    device queue is serialized, so busy = ready - max(dispatch,
+    frontier) and gap = idle between the previous program's finish and
+    this dispatch."""
+    clk = FakeClock()
+    tm = DispatchTimer(clock=clk)
+    p1, p2 = FakeProbe(), FakeProbe()
+    tm.dispatch("prefill", p1)  # t=0
+    clk.t = 1.0
+    e2 = tm.dispatch("decode", p2)  # t=1, still queued behind prefill
+    clk.t = 2.0
+    p1.ready = True
+    tm.poll()  # prefill finished at 2 -> busy 2.0, frontier 2.0
+    clk.t = 5.0
+    tm.drained(e2)  # decode finished at 5 -> busy 5 - max(1, 2) = 3
+    s = tm.snapshot()
+    assert s["programs"]["prefill"]["busy_s"] == pytest.approx(2.0)
+    assert s["programs"]["decode"]["busy_s"] == pytest.approx(3.0)
+    assert s["programs"]["decode"]["gap_s"] == 0.0
+    # device idle 5 -> 7, then a 1 s chunk: gap 2, busy 1
+    clk.t = 7.0
+    e3 = tm.dispatch("decode", FakeProbe())
+    clk.t = 8.0
+    tm.drained(e3)
+    s = tm.snapshot()
+    assert s["programs"]["decode"]["gap_s"] == pytest.approx(2.0)
+    assert s["programs"]["decode"]["busy_s"] == pytest.approx(4.0)
+    assert s["host_gap_frac"] == pytest.approx(2.0 / 8.0)
+
+
+def test_dispatch_timer_fifo_charges_right_program():
+    """A drain of chunk N finalizes every EARLIER outstanding dispatch
+    first (they provably completed on the serialized queue), so the
+    drained chunk's wall time is never charged to a predecessor's
+    program — the pipelined-dispatch attribution contract."""
+    clk = FakeClock()
+    tm = DispatchTimer(clock=clk)
+    tm.dispatch("prefill", FakeProbe())  # t=0, never polled ready
+    clk.t = 1.0
+    e2 = tm.dispatch("decode", FakeProbe())
+    clk.t = 9.0
+    tm.drained(e2)  # syncs decode; prefill finalizes FIRST
+    s = tm.snapshot()
+    # prefill absorbs up to the sync instant, decode starts at the
+    # frontier — its busy is NOT the whole 8 s window
+    assert s["programs"]["prefill"]["count"] == 1
+    assert s["programs"]["decode"]["count"] == 1
+    assert s["programs"]["decode"]["busy_s"] == pytest.approx(0.0)
+    assert s["programs"]["prefill"]["busy_s"] == pytest.approx(9.0)
+    # double-drain is a no-op
+    tm.drained(e2)
+    assert tm.snapshot()["programs"]["decode"]["count"] == 1
+
+
+def test_dispatch_timer_cardinality_bounded():
+    """10k dispatches with per-request variety must not grow the
+    metrics registry: series/histogram names key on the PROGRAM (a
+    fixed set, capped at MAX_PROGRAMS), never on a request id."""
+    clk = FakeClock()
+    m = Metrics()
+    tm = DispatchTimer(metrics=m, clock=clk)
+    programs = ("decode", "prefill", "spec_chunk", "prefill_chunk")
+    for i in range(100):
+        clk.t += 1.0
+        e = tm.dispatch(programs[i % 4], FakeProbe())
+        clk.t += 0.5
+        tm.drained(e)
+    warm = (set(m.series), set(m.histograms))
+    for i in range(10_000):
+        clk.t += 1.0
+        e = tm.dispatch(programs[i % 4], FakeProbe())
+        clk.t += 0.5
+        tm.drained(e)
+        tm.count_tokens(programs[i % 4], i % 7)
+    assert (set(m.series), set(m.histograms)) == warm
+    # a hostile/unbounded name set lumps under "other" past the cap —
+    # in the snapshot AND in the metrics registry (the emission must
+    # use the canonical name, not the raw one)
+    for i in range(50):
+        e = tm.dispatch(f"evil_{i}", FakeProbe())
+        tm.drained(e)
+    assert len(tm.snapshot()["programs"]) <= DispatchTimer.MAX_PROGRAMS + 1
+    assert "other" in tm.snapshot()["programs"]
+    dev_hists = {n for n in m.histograms if n.startswith("dev_")}
+    dev_series = {n for n in m.series if n.startswith("dev_")}
+    assert len(dev_hists) <= DispatchTimer.MAX_PROGRAMS + 1
+    assert len(dev_series) <= DispatchTimer.MAX_PROGRAMS + 1
+    assert "dev_other_busy_s" in m.histograms
+    assert "dev_evil_49_busy_s" not in m.histograms
+
+
+# ------------------------------------------------------ engine wiring
+@pytest.fixture(scope="module")
+def tiny_engine():
+    cfg = LlamaConfig.tiny()
+    m = Llama(cfg)
+    p = m.init(KEY)
+    eng = InferenceEngine(
+        make_mesh(MeshConfig()), m, p, max_len=32,
+        cache_dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    return cfg, m, p, eng
+
+
+def _prompts(cfg, lengths, seed=0):
+    r = np.random.default_rng(seed)
+    return [r.integers(0, cfg.vocab_size, (n,)) for n in lengths]
+
+
+def test_pipelined_engine_attribution(tiny_engine):
+    """pipeline_depth >= 2 with interleaved prefills: every admission
+    lands exactly one 'prefill' sample, decode chunks land under
+    'decode', and nothing else appears."""
+    cfg, _, _, eng = tiny_engine
+    from tensorlink_tpu.runtime.flight import FlightRecorder
+
+    rec = FlightRecorder()
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=GenerationConfig(max_new_tokens=6),
+        decode_chunk=4, prefill_block=8, pipeline_depth=2,
+        recorder=rec,
+    )
+    prompts = _prompts(cfg, (5, 7, 3, 6, 4))
+    rids = [sch.submit(p, seed=i) for i, p in enumerate(prompts)]
+    for rid in rids:
+        sch.result(rid)
+    sch.run_until_idle()  # result() may leave pipelined chunks in flight
+    snap = sch.device_time()
+    assert set(snap["programs"]) == {"prefill", "decode"}
+    admits = len(rec.events(kind="serving.admit"))
+    assert snap["programs"]["prefill"]["count"] == admits == len(prompts)
+    assert snap["programs"]["decode"]["count"] > 0
+    assert snap["programs"]["decode"]["tokens"] > 0
+    assert snap["pending"] == 0  # everything finalized at idle
+    assert 0.0 <= snap["host_gap_frac"] <= 1.0
+    # stats() serves the same attribution + the TTFT decomposition
+    st = sch.stats()
+    assert st["device_time"]["programs"]["decode"]["count"] > 0
+    assert set(st["ttft_decomp"]) >= {"queue_s", "prefill_s"}
+
+
+def test_paged_engine_chunked_prefill_attribution(tiny_engine):
+    """The paged engine attributes under its own program names; each
+    dispatched prefill CHUNK is one sample (a long prompt = several)."""
+    cfg, _, _, eng = tiny_engine
+    from tensorlink_tpu.runtime.flight import FlightRecorder
+
+    rec = FlightRecorder()
+    sch = PagedContinuousBatchingEngine(
+        eng, slots=2, gen=GenerationConfig(max_new_tokens=5),
+        decode_chunk=4, block_size=8, prefill_chunk=8,
+        pipeline_depth=2, recorder=rec,
+    )
+    rids = [
+        sch.submit(p, seed=i)
+        for i, p in enumerate(_prompts(cfg, (5, 12, 3)))
+    ]
+    for rid in rids:
+        sch.result(rid)
+    snap = sch.device_time()
+    assert set(snap["programs"]) == {"prefill_chunk", "decode"}
+    chunks = len(rec.events(kind="serving.prefill_chunk"))
+    assert snap["programs"]["prefill_chunk"]["count"] == chunks >= 4
+    assert snap["programs"]["decode"]["count"] > 0
+
+
+def test_engine_metrics_cardinality_fixed_after_warmup(tiny_engine):
+    """Zero new metric series after warmup, regardless of how many more
+    requests run — the per-program names are the whole set."""
+    cfg, _, _, eng = tiny_engine
+    m = Metrics()
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=GenerationConfig(max_new_tokens=4),
+        decode_chunk=4, prefill_block=8, metrics=m,
+    )
+    for i, p in enumerate(_prompts(cfg, (5, 6))):
+        sch.result(sch.submit(p, seed=i))
+    warm = (set(m.series), set(m.histograms), set(m.counters))
+    for i, p in enumerate(_prompts(cfg, (4, 7, 5, 6, 3, 5), seed=1)):
+        sch.result(sch.submit(p, seed=100 + i))
+    assert (set(m.series), set(m.histograms), set(m.counters)) == warm
+
+
+def test_mfu_mbu_from_aot_cost_and_capability(tiny_engine):
+    """warm_buckets AOT compiles capture each program's XLA cost; with
+    a capability record the attribution derives MFU/MBU."""
+    cfg, _, _, eng = tiny_engine
+    cap = measure_capability(matmul_dim=64, hbm_mb=2, reps=2)
+    assert cap["peak_tflops"] > 0 and cap["hbm_gbps"] > 0
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=GenerationConfig(max_new_tokens=4),
+        decode_chunk=4, prefill_block=8, capability=cap,
+        warm_buckets=True,
+    )
+    for i, p in enumerate(_prompts(cfg, (5, 6, 4))):
+        sch.result(sch.submit(p, seed=i))
+    progs = sch.device_time()["programs"]
+    assert progs["decode"]["mfu"] > 0
+    assert progs["decode"]["mbu"] > 0
+    assert progs["prefill"]["mfu"] > 0
+
+
+def test_device_timing_kill_switch(tiny_engine):
+    cfg, _, _, eng = tiny_engine
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=GenerationConfig(max_new_tokens=4),
+        decode_chunk=4, prefill_block=8, device_timing=False,
+    )
+    sch.result(sch.submit(_prompts(cfg, (5,))[0]))
+    assert sch.device_time() is None
+    assert "device_time" not in sch.stats()
+
+
+def test_request_span_timeline(tiny_engine):
+    """Each finished request stitches a queue/prefill/decode span tree
+    under its own trace in /spans."""
+    from tensorlink_tpu.runtime.tracing import Tracer
+
+    cfg, _, _, eng = tiny_engine
+    tr = Tracer("test")
+    sch = ContinuousBatchingEngine(
+        eng, slots=2, gen=GenerationConfig(max_new_tokens=5),
+        decode_chunk=4, prefill_block=8, tracer=tr,
+    )
+    rids = [
+        sch.submit(p, seed=i)
+        for i, p in enumerate(_prompts(cfg, (5, 6, 4)))
+    ]
+    for rid in rids:
+        sch.result(rid)
+    spans = tr.spans()
+    roots = [s for s in spans if s.name == "serving.request"]
+    assert len(roots) == 3
+    # one trace per request; children parent onto the root
+    assert len({s.trace_id for s in roots}) == 3
+    for root in roots:
+        kids = {s.name for s in spans if s.parent_id == root.span_id}
+        assert {"serving.queue_wait", "serving.prefill",
+                "serving.decode"} <= kids
+        assert root.attrs["tokens"] == 5
+    assert all(s.end_ns >= s.start_ns for s in spans)
+
+
+# -------------------------------------------------- capability bench
+def test_capability_microbench_cached_on_warm_restart(tmp_path):
+    from tensorlink_tpu.runtime.autotune import AutotuneStore, store_key
+
+    store = AutotuneStore.resolve(str(tmp_path))
+    key = store_key("global", ())
+    cap1 = measure_capability(
+        matmul_dim=64, hbm_mb=2, reps=2, store=store, key=key
+    )
+    assert "cached" not in cap1
+    cap2 = measure_capability(
+        matmul_dim=64, hbm_mb=2, reps=2, store=store, key=key
+    )
+    assert cap2["cached"] is True
+    assert cap2["peak_tflops"] == cap1["peak_tflops"]
+    assert cap2["hbm_gbps"] == cap1["hbm_gbps"]
+
+
+def test_autotune_update_merges_not_overwrites(tmp_path):
+    """The chip-global key is SHARED: the worker's flash-block save
+    must not clobber the cached capability record, and vice versa."""
+    from tensorlink_tpu.runtime.autotune import AutotuneStore
+
+    store = AutotuneStore.resolve(str(tmp_path))
+    store.update("k1", {"capability": {"chip": "x", "peak_tflops": 1.0}})
+    store.update("k1", {"flash_blocks": [[128, None, 64]]})
+    rec = store.load("k1")
+    assert rec["capability"]["chip"] == "x"
+    assert rec["flash_blocks"] == [[128, None, 64]]
+
+
+@pytest.mark.asyncio
+async def test_worker_capability_skips_bench_on_warm_restart(tmp_path):
+    """Two workers sharing an autotune store: the second one's record
+    comes from the cache (the restart-skips-microbench acceptance)."""
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    def cfg():
+        return NodeConfig(
+            role="worker", host="127.0.0.1", port=0,
+            capability_bench=True, autotune_dir=str(tmp_path),
+        )
+
+    w1 = WorkerNode(cfg())
+    await w1.start()
+    await asyncio.wait_for(w1.capability_ready.wait(), 60)
+    assert w1.capability is not None and "cached" not in w1.capability
+    w2 = WorkerNode(cfg())
+    await w2.start()
+    await asyncio.wait_for(w2.capability_ready.wait(), 60)
+    assert w2.capability["cached"] is True
+    assert w2.capability["peak_tflops"] == w1.capability["peak_tflops"]
+    await w1.stop()
+    await w2.stop()
+
+
+@pytest.mark.asyncio
+async def test_capability_record_heartbeat_to_validator_node():
+    """ISSUE-13 acceptance: a validator holds a worker's
+    CapabilityRecord (measured HBM GB/s + per-program MFU) received
+    via heartbeat PONGs, served at the validator's /node."""
+    from tensorlink_tpu.diag import http_get
+    from tensorlink_tpu.models.mlp import MLP, MLPConfig
+    from tensorlink_tpu.p2p.serialization import pack_arrays
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.roles.worker import WorkerNode, StageRunner
+    from tensorlink_tpu.train.optim import make_optimizer
+
+    v = ValidatorNode(NodeConfig(
+        role="validator", host="127.0.0.1", port=0, http_status_port=0,
+    ))
+    await v.start()
+    w = WorkerNode(NodeConfig(
+        role="worker", host="127.0.0.1", port=0, capability_bench=True,
+    ))
+    await w.start()
+    await w.connect("127.0.0.1", v.port)
+    await asyncio.wait_for(w.capability_ready.wait(), 60)
+
+    # load a real stage and run FORWARDs through the handler so the
+    # worker has a measured stage{0}_fwd_s series + compiled flops
+    # (big enough that the MFU survives the first call's compile time
+    # in the mean — a toy 8-wide MLP's flops round to zero on CPU)
+    mod = MLP(MLPConfig(in_dim=256, hidden_dim=512, out_dim=8,
+                        num_layers=2))
+    params = mod.init(KEY)
+    opt = make_optimizer("adam", 1e-3)
+    v_peer = next(iter(w.peers.values()))
+    runner = StageRunner(
+        job_id="j1", stage_index=0, module=mod, params=params,
+        opt=opt, opt_state=opt.init(params), owner=v_peer.node_id,
+    )
+    w.stages[("j1", 0)] = runner
+    x = np.ones((64, 256), np.float32)
+    for micro in range(4):
+        reply = await w._h_forward(w, v_peer, {
+            "job_id": "j1", "stage": 0, "step": 0, "micro": micro,
+            "data": pack_arrays({"x": x}), "infer": True,
+        })
+        assert reply["type"] == "ACTIVATION"
+
+    # the validator's heartbeat loop harvests the PONG piggyback
+    v.start_heartbeat(interval_s=0.05, timeout_s=2.0, max_misses=20)
+    deadline = time.monotonic() + 10.0
+    while w.node_id not in v.peer_capabilities:
+        assert time.monotonic() < deadline, "capability never arrived"
+        await asyncio.sleep(0.05)
+
+    st, body = await http_get(
+        "127.0.0.1", v._http.bound_port, "/node", timeout=5.0
+    )
+    assert st == 200
+    fleet = json.loads(body)["fleet"]
+    rec = fleet[w.node_id[:16]]
+    assert rec["hbm_gbps"] > 0
+    assert rec["peak_tflops"] > 0
+    assert rec["programs"]["stage0_fwd"]["mean_s"] > 0
+    assert rec["programs"]["stage0_fwd"]["mfu"] > 0
+    # the table is live: a dropped worker's record leaves it
+    await w.stop()
+    deadline = time.monotonic() + 10.0
+    while w.node_id in v.peer_capabilities:
+        assert time.monotonic() < deadline, "record outlived the peer"
+        await asyncio.sleep(0.05)
+    await v.stop()
+
+
+# ------------------------------------------------------ /profile
+def test_profile_clamp_bounds():
+    assert _clamp_ms(-5) == MIN_PROFILE_MS
+    assert _clamp_ms(10**9) == MAX_PROFILE_MS
+    assert _clamp_ms(250) == 250
+
+
+def test_timed_capture_shape_and_busy_refusal():
+    out = timed_capture(ms=MIN_PROFILE_MS)
+    assert out["duration_ms"] == MIN_PROFILE_MS
+    assert "op_breakdown" in out and "trace_dir" not in out
+    from tensorlink_tpu.runtime import profiling
+
+    assert profiling._capture_lock.acquire(blocking=False)
+    try:
+        with pytest.raises(ProfileBusyError):
+            timed_capture(ms=MIN_PROFILE_MS)
+    finally:
+        profiling._capture_lock.release()
+
+
+@pytest.mark.asyncio
+async def test_profile_endpoint_and_concurrent_409():
+    from tensorlink_tpu.diag import (
+        fetch_profile,
+        merge_profile_into_bundle,
+        render_profile,
+    )
+    from tensorlink_tpu.p2p.node import Node
+    from tensorlink_tpu.runtime import profiling
+
+    n = Node(NodeConfig(role="worker", host="127.0.0.1", port=0,
+                        http_status_port=0))
+    await n.start()
+    try:
+        port = n._http.bound_port
+        rec = await fetch_profile(f"127.0.0.1:{port}", ms=40)
+        assert rec["status"] == 200
+        assert rec["body"]["duration_ms"] == 40
+        assert "op_breakdown" in rec["body"]
+        assert "40 ms capture" in render_profile(rec)
+        # a concurrent capture is refused, never queued
+        assert profiling._capture_lock.acquire(blocking=False)
+        try:
+            busy = await fetch_profile(f"127.0.0.1:{port}", ms=40)
+        finally:
+            profiling._capture_lock.release()
+        assert busy["status"] == 409
+        # tldiag profile -o pulls the capture into a bundle
+        import os
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "bundle.json")
+            # file IO off the loop: this test IS an async handler
+            await asyncio.to_thread(merge_profile_into_bundle, path, rec)
+            from pathlib import Path
+
+            raw = await asyncio.to_thread(Path(path).read_text)
+            bundle = json.loads(raw)
+            got = bundle["nodes"][0]["routes"]["/profile"]
+            assert got["status"] == 200
+            assert got["body"]["duration_ms"] == 40
+    finally:
+        await n.stop()
+
+
+# ------------------------------------------------------------ trainer
+def test_trainer_device_time_skips_compile():
+    from tensorlink_tpu.config import TrainConfig
+    from tensorlink_tpu.models.mlp import MLP, MLPConfig
+    from tensorlink_tpu.train.trainer import Trainer, softmax_cross_entropy
+
+    m = MLP(MLPConfig(in_dim=8, hidden_dim=16, out_dim=4, num_layers=2))
+
+    def loss(module, params, batch, rng):
+        return softmax_cross_entropy(
+            module.apply(params, batch["x"]), batch["y"]
+        )
+
+    mt = Metrics()
+    tr = Trainer(
+        m, loss,
+        TrainConfig(batch_size=8, micro_batches=1, dtype="float32"),
+        metrics=mt,
+    )
+    st = tr.init_state(KEY)
+    batch = {"x": jnp.ones((8, 8)), "y": jnp.zeros((8,), jnp.int32)}
+    for _ in range(4):
+        st, _ = tr.train_step(st, batch, None)
+    snap = tr.device_time()
+    # the first (compile) call is excluded from device attribution
+    assert snap["programs"]["train_step"]["count"] == 3
+    assert snap["programs"]["train_step"]["busy_s"] > 0
+    assert "dev_train_step_busy_s" in mt.histograms
+    # an uninstrumented trainer stays untimed
+    tr2 = Trainer(
+        m, loss,
+        TrainConfig(batch_size=8, micro_batches=1, dtype="float32"),
+    )
+    assert tr2.device_time() is None

@@ -634,3 +634,81 @@ async def _healthz(node) -> tuple[int, dict]:
     writer.close()
     head, _, body = raw.partition(b"\r\n\r\n")
     return int(head.split()[1]), json.loads(body) if body else {}
+
+
+# ------------------------------------------------ device-time telemetry
+
+
+def test_node_row_mfu_bubble_and_host_bound_flag():
+    """PR-13 columns: MFU% from the best per-program MFU (capability
+    record or serving device_time), BUBBLE% from host_gap_frac, and a
+    HOST-BOUND flag above 30% — the chip is waiting on the host, so
+    faster silicon will not help that node."""
+    def scrape(node_body):
+        return {
+            "target": "w:1",
+            "routes": {
+                "/healthz": {"status": 200, "body": {"ok": True}},
+                "/node": {"status": 200, "body": {
+                    "role": "worker", "node_id": "w" * 64, "peers": {},
+                    **node_body,
+                }},
+            },
+        }
+
+    row = node_row(scrape({
+        "capability": {
+            "chip": "TPU v5e", "peak_tflops": 394.0, "hbm_gbps": 819.0,
+            "host_gap_frac": 0.45,
+            "programs": {"stage0_fwd": {"mfu": 0.38, "mean_s": 0.01}},
+        },
+    }), 10.0, 2.0)
+    assert row["mfu_pct"] == 38.0
+    assert row["bubble_pct"] == 45.0
+    assert any(f.startswith("HOST-BOUND") for f in row["flags"])
+
+    # serving device_time path; below the threshold no flag renders
+    row2 = node_row(scrape({
+        "serving": {"device_time": {
+            "host_gap_frac": 0.12,
+            "programs": {
+                "decode": {"mfu": 0.06, "mbu": 0.71},
+                "prefill": {"mfu": 0.41},
+            },
+        }},
+    }), 10.0, 2.0)
+    assert row2["mfu_pct"] == 41.0
+    assert row2["bubble_pct"] == 12.0
+    assert not any(f.startswith("HOST-BOUND") for f in row2["flags"])
+    text = render_table([row, row2])
+    assert "MFU%" in text and "BUBBLE%" in text and "HOST-BOUND" in text
+
+    # no telemetry at all: columns render as dashes, nothing crashes
+    bare = node_row(scrape({}), 10.0, 2.0)
+    assert bare["mfu_pct"] is None and bare["bubble_pct"] is None
+
+
+def test_bench_diff_devtime_key_directions():
+    """ISSUE-13 bench keys: MFU/MBU and the measured chip bandwidth
+    are higher-better; the host-gap fraction and the always-on timing
+    overhead are pure waste (lower-better)."""
+    old = {
+        "decode_mfu": 0.40, "decode_mbu": 0.70,
+        "capability_hbm_gbps": 800.0,
+        "serving_host_gap_frac": 0.10,
+        "serving_timing_overhead_frac": 0.004,
+    }
+    new = {
+        "decode_mfu": 0.30,              # -25% -> regression
+        "decode_mbu": 0.80,              # +14% -> improvement
+        "capability_hbm_gbps": 600.0,    # -25% -> regression
+        "serving_host_gap_frac": 0.20,   # doubled bubble -> regression
+        "serving_timing_overhead_frac": 0.002,  # cheaper -> improvement
+    }
+    d = bench_diff(old, new, threshold=0.05)
+    assert set(d["regressions"]) == {
+        "decode_mfu", "capability_hbm_gbps", "serving_host_gap_frac",
+    }
+    assert set(d["improvements"]) == {
+        "decode_mbu", "serving_timing_overhead_frac",
+    }
