@@ -58,6 +58,23 @@ def main() -> None:
                          "prompt traffic hitting the prefix cache")
     ap.add_argument("--slots", type=int, default=4,
                     help="decode slots for --continuous/--paged")
+    ap.add_argument("--priority", default="standard",
+                    choices=("interactive", "standard", "batch"),
+                    help="SLO class for the submitted requests: under "
+                         "pressure the scheduler preempts/sheds BATCH "
+                         "before STANDARD before INTERACTIVE")
+    ap.add_argument("--deadline", type=float, default=None,
+                    help="per-request deadline in seconds: admission "
+                         "rejects provably-unmeetable deadlines from "
+                         "the measured TPOT, and a request whose "
+                         "deadline passes is cancelled (slot + KV "
+                         "blocks freed) with a typed error")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="bound pending admissions: overflow is SHED "
+                         "with a typed OverloadedError carrying a "
+                         "measured retry_after_s (try --slots 1 "
+                         "--max-queue 1 to watch a shed + honored "
+                         "retry-after live)")
     ap.add_argument("--speculate", action="store_true",
                     help="speculative decoding with a DRAFT MODEL (the "
                          "target's int8 sibling here): the draft "
@@ -175,6 +192,59 @@ def main() -> None:
             )
     if args.autotune_dir:
         spec_kw["autotune_dir"] = args.autotune_dir
+    if args.max_queue is not None:
+        spec_kw["max_queue"] = args.max_queue
+
+    def submit_all(sch, prompt_list):
+        """Submit with the chosen SLO class/deadline; a shed request
+        prints its typed 429 and HONORS the advertised retry-after
+        (pumping the scheduler while waiting) before retrying."""
+        import time as _t
+
+        from tensorlink_tpu.parallel.serving import (
+            DeadlineExceededError,
+            OverloadedError,
+        )
+
+        rids = []
+        for i, pr in enumerate(prompt_list):
+            while True:
+                try:
+                    rids.append(sch.submit(
+                        pr, seed=i, priority=args.priority,
+                        deadline_s=args.deadline,
+                    ))
+                    break
+                except OverloadedError as e:
+                    print(
+                        f"request {i} SHED ({e.reason}): advertised "
+                        f"retry_after_s={e.retry_after_s} — honoring it"
+                    )
+                    t0 = _t.perf_counter()
+                    while _t.perf_counter() - t0 < (e.retry_after_s or 0.05):
+                        sch.step()
+                except DeadlineExceededError as e:
+                    print(f"request {i} rejected at admission: {e}")
+                    rids.append(None)
+                    break
+        return rids
+
+    def print_result(sch, rid):
+        from tensorlink_tpu.parallel.serving import (
+            DeadlineExceededError,
+            OverloadedError,
+        )
+
+        if rid is None:
+            return
+        try:
+            print(f"request {rid}:", sch.result(rid))
+        except DeadlineExceededError as e:
+            print(f"request {rid} MISSED its deadline (cancelled, "
+                  f"slot/blocks freed): {e}")
+        except OverloadedError as e:
+            print(f"request {rid} shed ({e.reason}), retry_after_s="
+                  f"{e.retry_after_s}")
 
     def print_spec(st) -> None:
         sp = st.get("spec")
@@ -246,18 +316,15 @@ def main() -> None:
             block_size=16, prefill_chunk=16, **spec_kw,
         )
         system = rng.integers(0, cfg.vocab_size, (24,))
-        rids = [
-            sch.submit(
-                np.concatenate(
-                    [system, rng.integers(0, cfg.vocab_size, (n,))]
-                ),
-                seed=i,
+        rids = submit_all(sch, [
+            np.concatenate(
+                [system, rng.integers(0, cfg.vocab_size, (n,))]
             )
-            for i, n in enumerate((5, 8, 3, 11, 6, 8))
-        ]
+            for n in (5, 8, 3, 11, 6, 8)
+        ])
         ktraj = []
         for rid in rids:
-            print(f"request {rid}:", sch.result(rid))
+            print_result(sch, rid)
             sp = sch.stats().get("spec") or {}
             if sp.get("adaptive"):
                 ktraj.append(sp["k_prior"]["k"])
@@ -284,13 +351,13 @@ def main() -> None:
             eng, slots=args.slots, gen=gen, decode_chunk=8,
             prefill_block=8, **spec_kw,
         )
-        rids = [
-            sch.submit(rng.integers(0, cfg.vocab_size, (n,)), seed=i)
-            for i, n in enumerate((5, 8, 3, 11, 6, 8))
-        ]
+        rids = submit_all(sch, [
+            rng.integers(0, cfg.vocab_size, (n,))
+            for n in (5, 8, 3, 11, 6, 8)
+        ])
         ktraj = []
         for rid in rids:
-            print(f"request {rid}:", sch.result(rid))
+            print_result(sch, rid)
             sp = sch.stats().get("spec") or {}
             if sp.get("adaptive"):
                 ktraj.append(sp["k_prior"]["k"])

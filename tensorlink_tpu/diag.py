@@ -217,6 +217,16 @@ def node_row(
         # _maybe_self_heal): the condition cleared without operator
         # action — advisory flag replaced by the record of the fix
         row["flags"].append(f"SELF-HEALED({healed.get('to')})")
+    adm = serving.get("admission") or {}
+    if adm.get("shed_total"):
+        # SLO admission control is actively shedding (serving.py
+        # OverloadedError): the total is CLIMBING when the last shed is
+        # recent — a historical shed from yesterday's burst is history,
+        # not a flag. Clients see typed 429s with the retry_after_s
+        # this row's /node reports under serving.admission.
+        age = adm.get("last_shed_age_s")
+        if age is not None and float(age) < 60.0:
+            row["flags"].append(f"SHEDDING({adm['shed_total']})")
     # device-time telemetry (PR 13): the node's CapabilityRecord (/node
     # "capability") or its serving scheduler's device_time attribution.
     # MFU% = best per-program MFU; BUBBLE% = host-gap fraction of the
@@ -331,6 +341,10 @@ _LOWER_BETTER_RE = re.compile(
     # speculation at fixed traffic: fewer n-gram misses = the lookup
     # is finding real recurrences
     r"|preempt|spec_fallback"
+    # overload robustness (serving_under_load round): shed load and
+    # missed deadlines at fixed offered traffic are pure degradation,
+    # as is INTERACTIVE p99 growing over its uncontended baseline
+    r"|shed_rate|shed_total|deadline_miss|p99_degradation"
     # device-time telemetry: host-gap (pipeline bubble) fraction and
     # the measured always-on timing overhead — both pure waste
     r"|host_gap|overhead_frac)"

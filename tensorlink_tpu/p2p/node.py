@@ -12,6 +12,7 @@ busy-wait polling shared dicts.
 from __future__ import annotations
 
 import asyncio
+import random
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -98,8 +99,16 @@ class Node:
         # spans propagate to peers through the _trace envelope field
         self.tracer = Tracer(service=f"{cfg.role}:{self.node_id[:8]}")
         self._trace_ctx = current_trace_context  # hot-path binding (send)
+        from tensorlink_tpu.runtime import chaos
         from tensorlink_tpu.runtime.flight import FlightRecorder, HealthState
 
+        # fault-injection hook handle (runtime/chaos.py): the hot-path
+        # guard is one attribute read + identity test on
+        # ``_chaos.ACTIVE`` — a disarmed harness costs nothing
+        self._chaos = chaos
+        # jittered-exponential-backoff RNG for request_idempotent;
+        # tests seed it for deterministic retry schedules
+        self._retry_rng = random.Random()
         # black box (runtime/flight.py): ring of lifecycle/failure events
         # published via GET /events; health computed from watchdogs +
         # readiness conditions, served as a truthful GET /healthz
@@ -888,6 +897,22 @@ class Node:
 
     # ------------------------------------------------------------ messaging
     async def send(self, peer: Peer, msg: dict) -> None:
+        h = self._chaos.ACTIVE
+        if h is not None:
+            # scripted churn (runtime/chaos.py): delay or drop outbound
+            # frames at the send boundary — a dropped frame looks to
+            # the caller exactly like the network losing it (a request
+            # rides out its timeout; retry paths must recover)
+            drop = False
+            for act in h.actions(
+                "p2p.send", type=msg.get("type"), role=self.role
+            ):
+                if act["action"] == "delay" and act["delay_s"] > 0:
+                    await asyncio.sleep(act["delay_s"])
+                drop = drop or act["action"] == "drop"
+            if drop:
+                self.metrics.incr("chaos_frames_dropped_total")
+                return
         peer.msgs_out += 1
         self.metrics.incr("msgs_out")
         if "_trace" not in msg:
@@ -925,6 +950,57 @@ class Node:
         finally:
             self._pending.pop(msg["id"], None)
             self._pending_peer.pop(msg["id"], None)
+
+    async def request_idempotent(
+        self,
+        peer: Peer,
+        msg: dict,
+        timeout: float | None = None,
+        retries: int = 2,
+        backoff_s: float = 0.05,
+        max_backoff_s: float = 2.0,
+    ) -> dict:
+        """``request`` with jittered-exponential-backoff retries, for
+        RPCs that are SAFE to deliver twice (reads like DHT_QUERY /
+        PEERS / STATS_REQUEST, and writes the receiver dedupes by key,
+        like DHT_STORE or a replica's per-sender GRAD_SHARE slot). A
+        transient peer blip — one lost frame, a heartbeat-window stall,
+        a connection the remote is re-establishing — then costs one
+        backoff instead of a failed request. Between attempts the peer
+        is re-resolved from the live table and, if it dropped, re-dialed
+        with its identity pinned; full jitter (0.5-1.5x) on the delay
+        keeps a churn event from re-synchronizing every retrier into
+        the next thundering herd. NEVER route non-idempotent RPCs here:
+        a retry after a timeout can double-apply them."""
+        last: Exception | None = None
+        for attempt in range(retries + 1):
+            target = self.peers.get(peer.node_id) or peer
+            try:
+                return await self.request(target, msg, timeout=timeout)
+            except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+                last = e
+                if attempt >= retries:
+                    break
+                delay = min(max_backoff_s, backoff_s * (2 ** attempt))
+                delay *= 0.5 + self._retry_rng.random()
+                self.metrics.incr("rpc_retries_total")
+                self.flight.record(
+                    "rpc_retry", "info", type=str(msg.get("type")),
+                    peer=peer.node_id[:16], attempt=attempt + 1,
+                    delay_s=round(delay, 4), error=str(e)[:120],
+                )
+                await asyncio.sleep(delay)
+                if peer.node_id not in self.peers and not self._stopping:
+                    try:
+                        await self.connect_candidates(
+                            peer.info.host, peer.info.port,
+                            tuple(getattr(peer.info, "alt_hosts", ()) or ()),
+                            expect_id=peer.node_id,
+                        )
+                    except (ConnectionError, OSError,
+                            asyncio.TimeoutError):
+                        continue  # still down; next attempt may rejoin
+        raise last
 
     # capability-record sanitation bounds: a PONG arrives from the
     # WIRE, so a hostile peer must not pin megabytes in the fleet table
@@ -1046,11 +1122,13 @@ class Node:
             if peer is None:
                 continue
             try:
-                await self.request(
+                # idempotent by construction (a second store of the
+                # same key/value is a no-op): retry through blips
+                await self.request_idempotent(
                     peer, {"type": "DHT_STORE", "key": key, "value": value}
                 )
                 n += 1
-            except (asyncio.TimeoutError, ConnectionError):
+            except (asyncio.TimeoutError, ConnectionError, OSError):
                 continue
         return n
 
@@ -1072,19 +1150,19 @@ class Node:
             exclude.add(info.node_id)
             max_hops -= 1
             try:
-                resp = await self.request(
+                resp = await self.request_idempotent(
                     peer,
                     {"type": "DHT_QUERY", "key": key, "exclude": sorted(exclude)},
                 )
                 if resp.get("value") is not None:
                     return resp["value"]
-            except (asyncio.TimeoutError, ConnectionError):
+            except (asyncio.TimeoutError, ConnectionError, OSError):
                 continue
         return None
 
     async def discover_peers(self, peer: Peer) -> list[PeerInfo]:
         """Ask a peer for its peer list; merge into routing table."""
-        resp = await self.request(peer, {"type": "PEERS"})
+        resp = await self.request_idempotent(peer, {"type": "PEERS"})
         infos = [PeerInfo.from_wire(d) for d in resp.get("peers", [])]
         for i in infos:
             self.dht.table.add(i)

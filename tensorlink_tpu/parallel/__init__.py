@@ -12,7 +12,11 @@ from tensorlink_tpu.parallel.kvpool import (  # noqa: F401
 )
 from tensorlink_tpu.parallel.serving import (  # noqa: F401
     ContinuousBatchingEngine,
+    DeadlineExceededError,
+    OverloadedError,
     PagedContinuousBatchingEngine,
+    PoolOverloadedError,
+    Priority,
     PromptTooLongError,
     QueueFullError,
     ServingError,

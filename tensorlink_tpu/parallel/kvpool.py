@@ -86,6 +86,13 @@ class BlockPool:
             collections.OrderedDict()
         )
         self._cached: set[int] = set()  # prefix-registered block ids
+        # SLO class of the request whose prefix a cached block backs
+        # (serving.Priority value; jax-free here on purpose — it is
+        # just an eviction rank). Under allocation pressure the least
+        # protected class evicts first, oldest-first within a class,
+        # so BATCH system prompts never push an INTERACTIVE tenant's
+        # resident prefix out of the pool.
+        self._cached_prio: dict[int, int] = {}
         # owner wires this to PrefixIndex.forget_block so evicting a
         # reusable block also drops its index entries
         self.evict_hook = None
@@ -134,7 +141,8 @@ class BlockPool:
             if self._free:
                 bid = self._free.popleft()
             else:
-                bid, _ = self._reusable.popitem(last=False)  # oldest
+                bid = self._evict_candidate()
+                del self._reusable[bid]
                 self._forget(bid)
                 evicted += 1
             self._refs[bid] = 1
@@ -145,8 +153,12 @@ class BlockPool:
         )
         return out
 
-    def retain(self, bid: int) -> None:
-        """Refcount++ (prefix hit / sharer). Revives a reusable block."""
+    def retain(self, bid: int, priority: int | None = None) -> None:
+        """Refcount++ (prefix hit / sharer). Revives a reusable block.
+        ``priority`` upgrades (never downgrades) the block's cached
+        eviction class: a prefix WARMED by BATCH but HIT by INTERACTIVE
+        is protecting interactive traffic and must be ranked by its
+        most protected consumer, not its first writer."""
         if self._refs[bid] == 0:
             if bid not in self._reusable:
                 raise ValueError(
@@ -156,6 +168,10 @@ class BlockPool:
             del self._reusable[bid]
             self.in_use += 1
         self._refs[bid] += 1
+        if priority is not None and bid in self._cached:
+            self._cached_prio[bid] = min(
+                self._cached_prio.get(bid, 2), int(priority)
+            )
 
     def release(self, bid: int) -> None:
         """Refcount--. At zero the block parks reusable if it still
@@ -175,10 +191,33 @@ class BlockPool:
                 self._free.append(bid)
             self._event("kvpool.free", block=bid, in_use=self.in_use)
 
-    def mark_cached(self, bid: int) -> None:
+    def _evict_candidate(self) -> int:
+        """Priority-then-LRU eviction: the OLDEST reusable block of the
+        LEAST protected priority class. Iteration is oldest-first, so
+        the first block seen of the worst class present wins; a pool
+        with no priority annotations degenerates to plain LRU."""
+        worst = None
+        worst_p = -1
+        for bid in self._reusable:  # oldest -> newest
+            p = self._cached_prio.get(bid, 2)
+            if p > worst_p:
+                worst, worst_p = bid, p
+                if p >= 2:  # least protected class, oldest — done
+                    break
+        return worst
+
+    def mark_cached(self, bid: int, priority: int = 2) -> None:
         """Flag a block as prefix-registered: at refcount 0 it parks
-        reusable (serving future prefix hits) instead of freeing."""
+        reusable (serving future prefix hits) instead of freeing.
+        ``priority`` (serving.Priority value; defaults to the least
+        protected class) ranks it for pressure eviction — min-merged
+        with any existing annotation, so re-registration can upgrade
+        but never strip protection."""
         self._cached.add(bid)
+        old = self._cached_prio.get(bid)
+        self._cached_prio[bid] = (
+            int(priority) if old is None else min(old, int(priority))
+        )
 
     def touch(self, bid: int) -> None:
         """LRU bump for a reusable block that served a read-only hit."""
@@ -187,6 +226,7 @@ class BlockPool:
 
     def _forget(self, bid: int) -> None:
         self._cached.discard(bid)
+        self._cached_prio.pop(bid, None)
         if self.evict_hook is not None:
             self.evict_hook(bid)
         self._event("kvpool.evict", block=bid)

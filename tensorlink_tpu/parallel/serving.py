@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import enum
 import threading
 import time
 from dataclasses import dataclass, field
@@ -84,6 +85,7 @@ from tensorlink_tpu.parallel.speculative import (
     autopair_draft,
     ngram_propose,
 )
+from tensorlink_tpu.runtime import chaos
 from tensorlink_tpu.runtime.autotune import (
     AutotuneStore,
     apply_flash_overrides,
@@ -94,12 +96,17 @@ from tensorlink_tpu.runtime.compile_cache import (
     cache_entries,
     enable_compile_cache,
 )
+from tensorlink_tpu.runtime.metrics import DEFAULT_BUCKETS
 
 __all__ = [
     "ContinuousBatchingEngine",
+    "DeadlineExceededError",
+    "OverloadedError",
     "PagedContinuousBatchingEngine",
     "PoolExhaustedError",
+    "PoolOverloadedError",
     "PromptTooLongError",
+    "Priority",
     "QueueFullError",
     "ServingError",
     "SpecConfig",
@@ -113,6 +120,18 @@ HEAL_MIN_PROPOSED = 32
 # per-request acceptance-rate histogram bounds (a rate lives in [0, 1];
 # the latency-shaped default buckets would bin every value together)
 _ACCEPTANCE_BUCKETS = tuple(i / 10 for i in range(1, 11))
+
+# retry-after TPOT stand-in before the FIRST request finishes (a cold
+# engine has measured nothing); every later estimate is the EWMA of
+# this engine's own completions
+_RETRY_TPOT_FALLBACK_S = 0.02
+
+# per-priority TTFT buckets extend the latency-shaped defaults upward:
+# under deliberate oversubscription a BATCH request legitimately waits
+# far past the 10 s default cap (that queueing IS the measurement the
+# serving_under_load round reports), and a saturated top bucket would
+# flatten its p99 into the INTERACTIVE one
+_TTFT_CLASS_BUCKETS = (*DEFAULT_BUCKETS, 30.0, 60.0, 120.0)
 
 
 def _is_index_leaf(leaf) -> bool:
@@ -137,6 +156,35 @@ def _with_cache_index(caches, new_index):
     )
 
 
+class Priority(enum.IntEnum):
+    """SLO class on ``submit()``. Lower value = more protected: the
+    scheduler admits, queues, and — under pool pressure — PRESERVES
+    requests in this order (a BATCH stream is always preempted or shed
+    before any STANDARD one, STANDARD before INTERACTIVE; within a
+    class, newest first). The token-identical preempt/resume machinery
+    makes demotion safe: a preempted stream continues exactly where it
+    left off once pressure clears."""
+
+    INTERACTIVE = 0
+    STANDARD = 1
+    BATCH = 2
+
+
+_PRIO_NAMES = {int(p): p.name.lower() for p in Priority}
+
+
+def _coerce_priority(p) -> int:
+    if isinstance(p, str):
+        try:
+            return int(Priority[p.upper()])
+        except KeyError:
+            raise ValueError(
+                f"unknown priority {p!r} (use "
+                f"{'/'.join(n.name for n in Priority)})"
+            ) from None
+    return int(Priority(int(p)))
+
+
 class ServingError(RuntimeError):
     """Base class for scheduler rejections."""
 
@@ -145,8 +193,51 @@ class PromptTooLongError(ServingError):
     """Prompt (plus its token budget) cannot fit a slot's cache region."""
 
 
-class QueueFullError(ServingError):
+class OverloadedError(ServingError):
+    """Typed 429: the scheduler shed this request. ``retry_after_s``
+    is DERIVED, not a constant — measured TPOT x the token backlog
+    ahead of a new arrival / decode width x pool pressure — so a
+    client honoring it re-arrives roughly when capacity exists.
+    ``reason`` says which resource shed it (``queue_full``,
+    ``pool_exhausted``, ``displaced``)."""
+
+    def __init__(
+        self, msg: str, *, retry_after_s: float | None = None,
+        reason: str = "overloaded",
+    ):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+        self.reason = reason
+
+
+class QueueFullError(OverloadedError):
     """Admission queue at max_queue — back-pressure the caller."""
+
+    def __init__(self, msg: str, **kw):
+        kw.setdefault("reason", "queue_full")
+        super().__init__(msg, **kw)
+
+
+class PoolOverloadedError(OverloadedError, PoolExhaustedError):
+    """Paged backpressure: the queue backed up on KV blocks, not decode
+    width. Catchable as either ``PoolExhaustedError`` (the pool-level
+    type admission has always raised) or ``OverloadedError`` (the
+    retry-after contract)."""
+
+    def __init__(self, msg: str, **kw):
+        kw.setdefault("reason", "pool_exhausted")
+        super().__init__(msg, **kw)
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline is (or became) unmeetable: rejected at
+    admission when measured TPOT proves the decode alone cannot finish
+    in time, or cancelled later — slot and KV blocks freed — when the
+    deadline passes while queued/running/awaited."""
+
+    def __init__(self, msg: str, *, rid: int | None = None):
+        super().__init__(msg)
+        self.rid = rid
 
 
 @dataclass
@@ -156,6 +247,12 @@ class _Request:
     max_new: int
     seed: int
     submitted_at: float
+    priority: int = int(Priority.STANDARD)
+    deadline_s: float | None = None
+    deadline_at: float | None = None  # perf_counter absolute
+    # terminal failure (shed / deadline miss / cancel): result() raises
+    # this instead of returning tokens
+    failed: BaseException | None = None
     # wall-clock anchor for the reconstructed span timeline: every
     # other stamp is perf_counter (monotonic), converted at emission
     submitted_ns: int = 0
@@ -262,6 +359,16 @@ class ContinuousBatchingEngine:
 
         self._queue: collections.deque[_Request] = collections.deque()
         self._requests: dict[int, _Request] = {}
+        # SLO-aware admission state: measured TPOT/TTFT EWMAs feed the
+        # retry-after computation and the deadline-feasibility check;
+        # shed/deadline counters feed stats() (tldiag SHEDDING flag)
+        self._tpot_ewma: float | None = None
+        self._ttft_ewma: float | None = None
+        self._sheds = 0
+        self._shed_by_prio: dict[int, int] = {}
+        self._last_shed_at: float | None = None
+        self._deadline_misses = 0
+        self._deadlined = 0  # live requests carrying a deadline
         self._done_order: collections.deque[int] = collections.deque()
         self._slot_req: list[_Request | None] = [None] * self.slots
         self._free: list[int] = list(range(self.slots))[::-1]
@@ -756,6 +863,11 @@ class ContinuousBatchingEngine:
         """Dispatch one decode/spec chunk; returns (device payload for
         the in-flight queue ((toks,) plain, (toks, n_emit, n_acc,
         fallback, n_prop) speculative), dispatch-timer token)."""
+        h = chaos.ACTIVE  # fault injection (runtime/chaos.py): a
+        if h is not None:  # disarmed harness costs one identity test
+            h.apply_sync(
+                "serving.dispatch", program=self._decode_program_name()
+            )
         out = self._decode(*self._program_args(), *self._decode_extra())
         self._state = out[0]
         disp = None
@@ -1053,18 +1165,36 @@ class ContinuousBatchingEngine:
 
     # ----------------------------------------------------------------- API
     def submit(
-        self, ids, *, max_new: int | None = None, seed: int = 0
+        self, ids, *, max_new: int | None = None, seed: int = 0,
+        priority: Priority | int | str = Priority.STANDARD,
+        deadline_s: float | None = None,
     ) -> int:
         """Enqueue one prompt (1-D token array). Returns a request id;
-        never blocks. Raises ``PromptTooLongError`` when the prompt plus
-        its token budget cannot fit a slot's cache region, and
-        ``QueueFullError`` past ``max_queue`` pending admissions."""
+        never blocks. ``priority`` is the request's SLO class
+        (:class:`Priority`): it orders admission from the queue and
+        protects the stream under pool pressure (BATCH is preempted /
+        shed before STANDARD before INTERACTIVE). ``deadline_s``
+        (seconds from now) makes lateness a typed failure: admission
+        raises ``DeadlineExceededError`` when the measured TPOT proves
+        the decode alone cannot finish in time, and a queued/running
+        request whose deadline passes is cancelled — slot and KV
+        blocks freed — with ``result()`` raising the same type.
+
+        Raises ``PromptTooLongError`` when the prompt plus its token
+        budget cannot fit a slot's cache region, and an
+        ``OverloadedError`` (``QueueFullError`` /
+        ``PoolOverloadedError``) carrying a measured ``retry_after_s``
+        past ``max_queue`` pending admissions — unless a strictly
+        lower-priority queued request can be shed to make room."""
         ids = np.asarray(ids).reshape(-1)
         if ids.size == 0:
             raise ValueError("empty prompt")
         max_new = int(max_new if max_new is not None else self.gen.max_new_tokens)
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
+        prio = _coerce_priority(priority)
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         t0 = int(ids.size)
         with self._lock:
             # a due mode downgrade applies BEFORE this prompt admits:
@@ -1074,25 +1204,211 @@ class ContinuousBatchingEngine:
             # under the lock: the paged fit check reads the block pool,
             # which a concurrent self-heal rebuild swaps (tlint TL601)
             self._check_fit(t0, max_new)
+            self._check_deadline_feasible(max_new, deadline_s, prio)
+            # expired work frees its slot/blocks before this arrival
+            # competes for them
+            self._expire_deadlines_locked()
             # fill free slots first so max_queue bounds genuinely
             # WAITING work, not work a free slot could take right now
             self._admit_waiting()
-            self._check_backpressure()
+            self._check_backpressure(prio)
             rid = self._next_rid
             self._next_rid += 1
+            now = time.perf_counter()
             req = _Request(
                 rid=rid, ids=ids, max_new=max_new, seed=int(seed),
-                submitted_at=time.perf_counter(),
+                submitted_at=now,
+                priority=prio, deadline_s=deadline_s,
+                deadline_at=(
+                    now + deadline_s if deadline_s is not None else None
+                ),
                 # wall-clock anchor: the span timeline converts the
                 # monotonic stamps against this pair
                 submitted_ns=time.time_ns(),
             )
+            if deadline_s is not None:
+                self._deadlined += 1
             self._requests[rid] = req
             self._admit_or_queue(req)
         if self.metrics is not None:
             self.metrics.incr("serving_requests_total")
-        self._event("serving.submit", rid=rid, prompt_len=t0)
+            self.metrics.incr(
+                f"serving_requests_total:{_PRIO_NAMES[prio]}"
+            )
+        self._event(
+            "serving.submit", rid=rid, prompt_len=t0,
+            priority=_PRIO_NAMES[prio],
+        )
         return rid
+
+    # ------------------------------------------------- admission control
+    def _check_deadline_feasible(
+        self, max_new: int, deadline_s: float | None, prio: int
+    ) -> None:
+        """Reject work whose deadline is PROVABLY unmeetable: even with
+        zero queueing, ``max_new`` tokens cost at least
+        ``(max_new - 1) x measured TPOT`` of decode — a floor built
+        from this engine's own finished requests, never a guess. With
+        nothing measured yet (cold engine), nothing is provable and
+        the request admits."""
+        if deadline_s is None or self._tpot_ewma is None:
+            return
+        floor = (max_new - 1) * self._tpot_ewma
+        if floor <= deadline_s:
+            return
+        self._deadline_misses += 1
+        if self.metrics is not None:
+            self.metrics.incr("serving_deadline_miss_total")
+            self.metrics.incr(
+                f"serving_deadline_miss_total:{_PRIO_NAMES[prio]}"
+            )
+        self._event(
+            "serving.deadline_miss", "warn", phase="admission",
+            priority=_PRIO_NAMES[prio], deadline_s=deadline_s,
+            service_floor_s=round(floor, 4),
+        )
+        raise DeadlineExceededError(
+            f"deadline {deadline_s}s is provably unmeetable: "
+            f"{max_new} tokens x measured TPOT "
+            f"{self._tpot_ewma:.5f}s/token = {floor:.3f}s of decode "
+            "alone"
+        )
+
+    def _pool_pressure_locked(self) -> float:
+        return 1.0  # contiguous slots: the queue estimate is complete
+
+    def _retry_after_locked(self) -> float:
+        """Measured retry-after: TPOT x the token backlog ahead of a
+        new arrival / decode width x pool pressure. Uses the EWMA of
+        this engine's own finished requests; before anything finished
+        the fallback is one conservative guess — replaced by a
+        measurement the moment one exists."""
+        tpot = (
+            self._tpot_ewma if self._tpot_ewma is not None
+            else _RETRY_TPOT_FALLBACK_S
+        )
+        ahead = 0
+        for r in self._slot_req:
+            if r is not None and not r.done:
+                ahead += max(r.max_new - len(r.tokens), 1)
+        for r in self._queue:
+            ahead += max(r.max_new - len(r.tokens), 1)
+        eta = tpot * ahead / max(self.slots, 1)
+        return round(max(eta * self._pool_pressure_locked(), tpot), 4)
+
+    def _note_shed(
+        self, prio: int, reason: str, retry_after_s: float | None,
+        rid: int | None = None,
+    ) -> None:
+        self._sheds += 1
+        self._shed_by_prio[prio] = self._shed_by_prio.get(prio, 0) + 1
+        self._last_shed_at = time.perf_counter()
+        name = _PRIO_NAMES.get(prio, "standard")
+        if self.metrics is not None:
+            # bounded cardinality by construction: Priority is a closed
+            # 3-member enum, so the per-class counter family is fixed
+            self.metrics.incr("serving_shed_total")
+            self.metrics.incr(f"serving_shed_total:{name}")
+        self._event(
+            "serving.shed", "warn", rid=rid, priority=name,
+            reason=reason, retry_after_s=retry_after_s,
+            queued=len(self._queue),
+        )
+
+    def _displace_for_locked(self, prio: int) -> bool:
+        """Make queue room for a higher-priority arrival by shedding
+        the newest queued request of a STRICTLY lower class (its
+        result() raises the OverloadedError it would have gotten at
+        submit, retry-after included). False when nothing queued is
+        lower-priority — the arrival itself must shed."""
+        if not self._queue:
+            return False
+        victim = max(self._queue, key=lambda r: (r.priority, r.rid))
+        if victim.priority <= prio:
+            return False
+        ra = self._retry_after_locked()
+        self._abort_locked(victim, OverloadedError(
+            f"request {victim.rid} shed: displaced by a "
+            f"{_PRIO_NAMES[prio]} admission under backpressure; "
+            f"retry in {ra}s",
+            retry_after_s=ra, reason="displaced",
+        ))
+        return True
+
+    def _abort_locked(self, req: _Request, error: BaseException) -> None:
+        """Terminal failure for a queued or running request: ``failed``
+        set (result() raises it), queue entry removed, slot and — on
+        the paged engine — device row + KV blocks freed via the usual
+        ``_finish`` path. Caller holds the scheduler lock."""
+        req.failed = error
+        name = _PRIO_NAMES.get(req.priority, "standard")
+        if isinstance(error, DeadlineExceededError):
+            self._deadline_misses += 1
+            if self.metrics is not None:
+                self.metrics.incr("serving_deadline_miss_total")
+                self.metrics.incr(
+                    f"serving_deadline_miss_total:{name}"
+                )
+            self._event(
+                "serving.deadline_miss", "warn", rid=req.rid,
+                priority=name, deadline_s=req.deadline_s,
+                phase="queued" if req.slot is None else "running",
+            )
+        elif isinstance(error, OverloadedError):
+            self._note_shed(
+                req.priority, error.reason, error.retry_after_s,
+                rid=req.rid,
+            )
+        if req in self._queue:
+            self._queue.remove(req)
+        if req.slot is not None and not req.done:
+            self._drain_for_abort(req)
+        if not req.done:
+            self._finish(req)
+
+    def _drain_for_abort(self, req: _Request) -> None:
+        """Pre-``_finish`` safety for aborting a RUNNING request. The
+        contiguous engine needs none: a slot's cache region is private,
+        and the next admission's prefill fully resets the row. The
+        paged engine overrides (retire the device row, then drain
+        in-flight chunks) — blocks must never return to the pool while
+        a dispatched chunk could still write through the old table."""
+
+    def cancel(self, rid: int, *, error: BaseException | None = None) -> bool:
+        """Cancel a queued or running request: its slot and (paged) KV
+        blocks free immediately and ``result(rid)`` raises. Returns
+        False when the request is unknown or already finished."""
+        with self._lock:
+            req = self._requests.get(rid)
+            if req is None or req.done:
+                return False
+            self._abort_locked(
+                req, error or ServingError(f"request {rid} cancelled")
+            )
+            return True
+
+    def _expire_deadlines_locked(self) -> None:
+        """Cancel queued/running requests whose deadline passed — an
+        abandoned deadline must free its slot and blocks for work that
+        can still make its SLO, not pin them until max-tokens. O(1)
+        when no live request carries a deadline."""
+        if not self._deadlined:
+            return
+        now = time.perf_counter()
+        expired = [
+            r for r in self._queue
+            if r.deadline_at is not None and r.deadline_at < now
+        ]
+        expired += [
+            r for r in self._slot_req
+            if r is not None and not r.done
+            and r.deadline_at is not None and r.deadline_at < now
+        ]
+        for req in expired:
+            self._abort_locked(req, DeadlineExceededError(
+                f"request {req.rid} missed its {req.deadline_s}s "
+                "deadline; cancelled", rid=req.rid,
+            ))
 
     def _check_fit(self, t0: int, max_new: int) -> None:
         if t0 + max_new > self.engine.max_len:
@@ -1106,16 +1422,24 @@ class ContinuousBatchingEngine:
                 f"exceeds the slot cache region ({self.L} slots)"
             )
 
-    def _check_backpressure(self) -> None:
+    def _check_backpressure(
+        self, prio: int = int(Priority.STANDARD)
+    ) -> None:
         if (
-            self.max_queue is not None
-            and not self._free
-            and len(self._queue) >= self.max_queue
+            self.max_queue is None
+            or self._free
+            or len(self._queue) < self.max_queue
         ):
-            raise QueueFullError(
-                f"{len(self._queue)} requests pending (max_queue="
-                f"{self.max_queue})"
-            )
+            return
+        if self._displace_for_locked(prio):
+            return  # a lower-priority queued request was shed instead
+        ra = self._retry_after_locked()
+        self._note_shed(prio, "queue_full", ra)
+        raise QueueFullError(
+            f"{len(self._queue)} requests pending (max_queue="
+            f"{self.max_queue}); retry in {ra}s",
+            retry_after_s=ra,
+        )
 
     def _admit_or_queue(self, req: _Request) -> None:
         if self._free:
@@ -1123,9 +1447,17 @@ class ContinuousBatchingEngine:
         else:
             self._queue.append(req)
 
+    def _next_queued_locked(self) -> _Request:
+        """Admission order: priority class first, FIFO (rid) within —
+        a preempted request resumes ahead of later same-class arrivals
+        because it keeps its original rid."""
+        return min(self._queue, key=lambda r: (r.priority, r.rid))
+
     def _admit_waiting(self) -> None:
         while self._free and self._queue:
-            self._admit(self._queue.popleft())
+            req = self._next_queued_locked()
+            self._queue.remove(req)
+            self._admit(req)
 
     def _admit(self, req: _Request) -> None:
         slot = self._free.pop()
@@ -1165,12 +1497,26 @@ class ContinuousBatchingEngine:
     def _maybe_record_ttft(self, req: _Request) -> None:
         if req.first_token_at is not None or req.first_token is None:
             return
+        if req.failed is not None:
+            # a shed/cancelled request's first token may still drain
+            # after the abort — the scheduler killed it, so its "TTFT"
+            # is not a latency the per-class histograms should serve
+            return
         ready = getattr(req.first_token, "is_ready", None)
         if ready is None or ready():
             req.first_token_at = time.perf_counter()
+            ttft = req.first_token_at - req.submitted_at
+            self._ttft_ewma = (
+                ttft if self._ttft_ewma is None
+                else 0.8 * self._ttft_ewma + 0.2 * ttft
+            )
             if self.metrics is not None:
+                self.metrics.observe_hist("serving_ttft_s", ttft)
+                # per-SLO-class latency (bounded: Priority is a closed
+                # 3-member enum) — the bench/tldiag per-priority p99s
                 self.metrics.observe_hist(
-                    "serving_ttft_s", req.first_token_at - req.submitted_at
+                    f"serving_ttft_s:{_PRIO_NAMES[req.priority]}", ttft,
+                    buckets=_TTFT_CLASS_BUCKETS,
                 )
 
     def _ewma_decomp(self, name: str, value: float) -> None:
@@ -1242,6 +1588,29 @@ class ContinuousBatchingEngine:
         if slot is not None and self._slot_req[slot] is req:
             self._slot_req[slot] = None
             self._free.append(slot)
+        if req.deadline_at is not None:
+            self._deadlined = max(self._deadlined - 1, 0)
+        # measured TPOT — the deadline-feasibility floor, the
+        # retry-after computation, and the per-class histograms all
+        # derive from it. Aborted requests are excluded EVERYWHERE: a
+        # shed/cancelled stream's finished_at is the abort time, so its
+        # "TPOT" would fold post-preemption queue wait into a
+        # service-rate measurement (inflating exactly the per-class
+        # p99s the overload bench reads).
+        tpot = None
+        if (
+            req.failed is None
+            and req.first_token_at is not None
+            and len(req.tokens) > 1
+        ):
+            tpot = (
+                (req.finished_at - req.first_token_at)
+                / (len(req.tokens) - 1)
+            )
+            self._tpot_ewma = (
+                tpot if self._tpot_ewma is None
+                else 0.8 * self._tpot_ewma + 0.2 * tpot
+            )
         if self._kctl is not None:
             # fold the finished request's acceptance into the prior the
             # next request starts from (and the autotune store persists)
@@ -1254,11 +1623,11 @@ class ContinuousBatchingEngine:
             self._requests.pop(self._done_order.popleft(), None)
         if self.metrics is not None:
             self.metrics.incr("serving_tokens_total", len(req.tokens))
-            if req.first_token_at is not None and len(req.tokens) > 1:
+            if tpot is not None:
+                self.metrics.observe_hist("serving_tpot_s", tpot)
                 self.metrics.observe_hist(
-                    "serving_tpot_s",
-                    (req.finished_at - req.first_token_at)
-                    / (len(req.tokens) - 1),
+                    f"serving_tpot_s:{_PRIO_NAMES[req.priority]}",
+                    tpot,
                 )
             if req.spec_proposed:
                 # per-request acceptance rate, alongside TTFT/TPOT in
@@ -1285,6 +1654,9 @@ class ContinuousBatchingEngine:
             self._finish(req)
 
     def _drain_one(self) -> None:
+        h = chaos.ACTIVE  # scripted drain-loop stall (worker-kill /
+        if h is not None:  # failover blackout emulation in-process)
+            h.apply_sync("serving.drain")
         payload, snapshot, disp = self._inflight.popleft()
         for req in snapshot:
             if req is not None:
@@ -1464,6 +1836,7 @@ class ContinuousBatchingEngine:
         (nothing queued, running, or in flight)."""
         with self._lock:
             self._maybe_self_heal()
+            self._expire_deadlines_locked()
             self._admit_waiting()
             busy = any(r is not None for r in self._slot_req)
             if busy:
@@ -1484,9 +1857,21 @@ class ContinuousBatchingEngine:
                 busy or self._queue or self._inflight
             )
 
-    def result(self, rid: int, *, timeout_s: float | None = None) -> np.ndarray:
+    def result(
+        self, rid: int, *, timeout_s: float | None = None,
+        deadline_s: float | None = None,
+    ) -> np.ndarray:
         """Drive the serving loop until request ``rid`` finishes; return
-        its generated tokens (length <= its max_new; ends at EOS)."""
+        its generated tokens (length <= its max_new; ends at EOS).
+
+        ``deadline_s`` bounds the wait with CANCELLATION: past it the
+        request is aborted — its slot and (paged) KV blocks freed, so
+        an abandoned caller never pins capacity until max-tokens — and
+        a typed ``DeadlineExceededError`` raised. ``timeout_s`` is the
+        legacy soft bound: it raises ``TimeoutError`` but leaves the
+        request running (a later ``result()`` can still collect it).
+        A request that was shed or deadline-cancelled elsewhere raises
+        its recorded failure here instead of returning tokens."""
         # under the lock: a pump thread's _finish may be evicting old
         # entries from this dict concurrently (tlint TL601)
         with self._lock:
@@ -1497,9 +1882,9 @@ class ContinuousBatchingEngine:
                 f"result was evicted after {self.keep_results} newer "
                 "completions — raise keep_results to retain more)"
             )
-        deadline = (
-            time.perf_counter() + timeout_s if timeout_s is not None else None
-        )
+        now = time.perf_counter()
+        cancel_at = now + deadline_s if deadline_s is not None else None
+        timeout_at = now + timeout_s if timeout_s is not None else None
         while not req.done:
             progressed = self.step()
             if not progressed and not req.done:
@@ -1507,12 +1892,28 @@ class ContinuousBatchingEngine:
                     f"request {rid} cannot complete: scheduler idle "
                     "(internal accounting bug)"
                 )
-            if deadline is not None and time.perf_counter() > deadline:
+            now = time.perf_counter()
+            if cancel_at is not None and now > cancel_at and not req.done:
+                err = DeadlineExceededError(
+                    f"request {rid} not done within deadline_s="
+                    f"{deadline_s}; cancelled and freed", rid=rid,
+                )
+                if self.cancel(rid, error=err):
+                    raise err
+                # lost the race: a pump thread finished the request
+                # between the done check and the cancel — fall through
+                # to its real outcome instead of claiming a miss
+                continue
+            if timeout_at is not None and now > timeout_at and not req.done:
                 raise TimeoutError(f"request {rid} not done in {timeout_s}s")
+        if req.failed is not None:
+            raise req.failed
         return np.asarray(req.tokens, np.int32)
 
     async def asubmit(
-        self, ids, *, max_new: int | None = None, seed: int = 0
+        self, ids, *, max_new: int | None = None, seed: int = 0,
+        priority: Priority | int | str = Priority.STANDARD,
+        deadline_s: float | None = None,
     ) -> int:
         """Asyncio wrapper for ``submit``: admission dispatches a
         prefill (and, for a new prompt-length bucket, compiles one) and
@@ -1521,15 +1922,23 @@ class ContinuousBatchingEngine:
         loop."""
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
-            None, lambda: self.submit(ids, max_new=max_new, seed=seed)
+            None, lambda: self.submit(
+                ids, max_new=max_new, seed=seed, priority=priority,
+                deadline_s=deadline_s,
+            )
         )
 
-    async def aresult(self, rid: int, *, timeout_s: float | None = None):
+    async def aresult(
+        self, rid: int, *, timeout_s: float | None = None,
+        deadline_s: float | None = None,
+    ):
         """Asyncio wrapper: pump in a worker thread so a node event loop
         can serve generation without blocking its RPC handlers."""
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
-            None, lambda: self.result(rid, timeout_s=timeout_s)
+            None, lambda: self.result(
+                rid, timeout_s=timeout_s, deadline_s=deadline_s
+            )
         )
 
     def run_until_idle(self) -> None:
@@ -1618,6 +2027,27 @@ class ContinuousBatchingEngine:
                 "inflight_chunks": len(self._inflight),
                 "requests": len(self._requests),
             }
+            adm: dict = {
+                "retry_after_s": self._retry_after_locked(),
+                "shed_total": self._sheds,
+                "deadline_miss_total": self._deadline_misses,
+            }
+            if self._tpot_ewma is not None:
+                adm["tpot_ewma_s"] = round(self._tpot_ewma, 6)
+            if self._ttft_ewma is not None:
+                adm["ttft_ewma_s"] = round(self._ttft_ewma, 6)
+            if self._sheds:
+                adm["shed_by_priority"] = {
+                    _PRIO_NAMES[p]: n
+                    for p, n in sorted(self._shed_by_prio.items())
+                }
+                adm["last_shed_age_s"] = round(
+                    time.perf_counter() - self._last_shed_at, 3
+                )
+            # the SLO-admission picture tldiag reads from /node: what a
+            # shed client is being told (retry_after_s), how much was
+            # shed per class, and the measured EWMAs behind both
+            out["admission"] = adm
             dt = self._device_time_locked()
             if dt is not None:
                 out["device_time"] = dt
@@ -2074,38 +2504,64 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 f"the pool holds {self.pool.num_blocks} total"
             )
 
-    def _check_backpressure(self) -> None:
+    def _pool_pressure_locked(self) -> float:
+        # a near-full pool inflates the retry-after: freed capacity is
+        # contended by every queued request, so the naive TPOT x
+        # backlog estimate under-promises exactly when shedding peaks
+        util = self.pool.in_use / self.pool.num_blocks
+        return min(4.0, 1.0 / max(1.0 - util, 0.25))
+
+    def _check_backpressure(
+        self, prio: int = int(Priority.STANDARD)
+    ) -> None:
         if self.max_queue is None or len(self._queue) < self.max_queue:
             return
         if self._free:
             # slots are free yet admissions back up: the queue is
             # starved on KV blocks, not on decode width
-            self._event(
-                "serving.reject", "warn", reason="pool_exhausted",
-                queued=len(self._queue), **self.pool.stats(),
-            )
-            raise PoolExhaustedError(
+            if self._displace_for_locked(prio):
+                return
+            ra = self._retry_after_locked()
+            self._note_shed(prio, "pool_exhausted", ra)
+            raise PoolOverloadedError(
                 f"{len(self._queue)} requests pending on KV blocks "
                 f"({self.pool.in_use}/{self.pool.num_blocks} in use, "
-                f"max_queue={self.max_queue})"
+                f"max_queue={self.max_queue}); retry in {ra}s",
+                retry_after_s=ra,
             )
-        super()._check_backpressure()
+        super()._check_backpressure(prio)
 
     def _admit_or_queue(self, req: _Request) -> None:
-        # a non-empty queue means the head is starved on blocks (slots
-        # may be free): the new arrival must wait behind it — admitting
-        # it now would let steady small-prompt traffic starve a queued
-        # long prompt forever
-        if self._queue or not self._free or not self._try_admit(req):
-            self._queue.append(req)
+        # queue first, then drain in (priority, rid) order: a non-empty
+        # queue means the best-priority head is starved on blocks, and
+        # only a STRICTLY higher-priority arrival may pass it (same-
+        # class bypass would let steady small-prompt traffic starve a
+        # queued long prompt forever)
+        self._queue.append(req)
+        self._admit_waiting()
 
     def _admit_waiting(self) -> None:
-        # FIFO: when the head cannot get blocks, later arrivals wait too
-        # (no head-of-line bypass — it would starve long prompts)
+        # (priority, FIFO-within-class): when the best head cannot get
+        # blocks, try preempting strictly lower-priority RUNNING work
+        # for it; with no such victims, everyone behind it waits too
         while self._free and self._queue:
-            if not self._try_admit(self._queue[0]):
+            head = self._next_queued_locked()
+            if self._try_admit(head):
+                self._queue.remove(head)
+                continue
+            victims = [
+                s for s, r in enumerate(self._slot_req)
+                if r is not None and r.priority > head.priority
+            ]
+            if not victims:
                 break
-            self._queue.popleft()
+            # priority-then-newest, the same order pool pressure uses
+            self._preempt(max(
+                victims,
+                key=lambda s: (
+                    self._slot_req[s].priority, self._slot_req[s].rid
+                ),
+            ))
 
     def _try_admit(self, req: _Request) -> bool:
         """Map a request into a free slot: prefix-match, retain/COW
@@ -2138,7 +2594,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         tail_bid = None
         try:
             for b in hits:
-                self.pool.retain(b)
+                # a hit UPGRADES the block's eviction class to the most
+                # protected consumer: a prefix warmed by BATCH but hit
+                # by INTERACTIVE now shields interactive traffic
+                self.pool.retain(b, priority=req.priority)
                 taken.append(b)
             if tail is not None:
                 bid, fill = tail
@@ -2146,7 +2605,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     # sole owner: revive and extend in place — the index
                     # entry vouches only for its first `fill` tokens,
                     # which stay untouched
-                    self.pool.retain(bid)
+                    self.pool.retain(bid, priority=req.priority)
                     taken.append(bid)
                     tail_bid = bid
                 else:
@@ -2214,8 +2673,12 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         bounded by one chunk's latency, not a whole prompt's."""
         if not self._pending:
             return False
+        # SLO order for the one-chunk-per-step budget too: an
+        # INTERACTIVE prompt's TTFT must not wait behind a BATCH
+        # prompt's remaining chunks
         slot = min(
-            self._pending, key=lambda s: self._slot_req[s].rid
+            self._pending,
+            key=lambda s: (self._slot_req[s].priority, self._slot_req[s].rid),
         )
         job = self._pending[slot]
         ids, pos = job["ids"], job["pos"]
@@ -2256,7 +2719,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     self._slot_blocks[slot],
                 )
                 for b in newly:
-                    self.pool.mark_cached(b)
+                    # priority-aware reuse: under allocation pressure
+                    # the pool evicts BATCH-cached prefixes before
+                    # STANDARD before INTERACTIVE (kvpool.py)
+                    self.pool.mark_cached(b, priority=req.priority)
         return True
 
     # ------------------------------------------------------ blocks / decode
@@ -2308,11 +2774,27 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._slot_req[slot] = None
         req.slot = None
         self._free.append(slot)
-        self._queue.appendleft(req)
+        # (priority, rid) ordering makes queue position irrelevant: the
+        # preempted request resumes ahead of later same-class arrivals
+        # because it keeps its original rid
+        self._queue.append(req)
+
+    def _drain_for_abort(self, req: _Request) -> None:
+        # same discipline as _preempt: retire the device row FIRST so
+        # parked writes drop, then drain in-flight chunks — only then
+        # may _finish return this slot's blocks to the pool (a chunk
+        # dispatched before the retire could still write through the
+        # old table into a block about to be remapped)
+        self._state = self._retire_op(self._state, jnp.int32(req.slot))
+        while self._inflight:
+            self._drain_one()
 
     def _alloc_with_preemption(self, n: int, protect: int):
-        """Allocate ``n`` blocks, preempting the newest other request
-        under pressure. Returns None when ``protect`` itself had to be
+        """Allocate ``n`` blocks, preempting under pressure in
+        priority-then-newest order: the newest request of the LEAST
+        protected class among the others (a BATCH stream always goes
+        before any STANDARD one, STANDARD before INTERACTIVE — the SLO
+        contract). Returns None when ``protect`` itself had to be
         preempted (pool too small for the live set)."""
         while True:
             try:
@@ -2326,7 +2808,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     self._preempt(protect)
                     return None
                 self._preempt(
-                    max(victims, key=lambda s: self._slot_req[s].rid)
+                    max(victims, key=lambda s: (
+                        self._slot_req[s].priority,
+                        self._slot_req[s].rid,
+                    ))
                 )
 
     def _advance_bound(self, slot: int) -> int:
@@ -2395,6 +2880,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         chunk, grow block tables, dispatch one decode chunk, drain."""
         with self._lock:
             self._maybe_self_heal()
+            self._expire_deadlines_locked()
             self._admit_waiting()
             prefilling = self._dispatch_prefill_chunk()
             decoding = [

@@ -130,9 +130,14 @@ class ValidatorNode(Node):
 
         async def one(p: Peer):
             try:
-                s = await self.request(p, {"type": "STATS_REQUEST"})
+                # read-only, so safe to retry: a worker mid-GC or
+                # riding out a transient blip still makes the
+                # recruitment round instead of vanishing from it
+                s = await self.request_idempotent(
+                    p, {"type": "STATS_REQUEST"}
+                )
                 stats[p.node_id] = s
-            except (asyncio.TimeoutError, ConnectionError):
+            except (asyncio.TimeoutError, ConnectionError, OSError):
                 pass
 
         await asyncio.gather(*(one(p) for p in self._workers()))

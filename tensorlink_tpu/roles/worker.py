@@ -1314,7 +1314,11 @@ class WorkerNode(Node):
 
         async def push(info: dict):
             p = await self._replica_peer(info)
-            await self.request(
+            # idempotent: the receiver's inbox slot is keyed (job,
+            # stage, step, sender) — a duplicate delivery overwrites
+            # with identical bytes — so a transient replica blip costs
+            # one jittered backoff, not the whole training step
+            await self.request_idempotent(
                 p,
                 {
                     "type": "GRAD_SHARE",

@@ -209,6 +209,80 @@ def test_node_row_self_healed_replaces_low_accept():
     assert "SELF-HEALED" in text
 
 
+def test_bench_diff_serving_load_key_directions():
+    """The serving_under_load round's keys (ISSUE 14): per-priority
+    TTFT/TPOT p99s, shed rate, deadline misses, and the INTERACTIVE
+    p99 degradation ratio are all lower-better; throughput under load
+    is higher-better; the retry-after honesty ratio is a calibration
+    number (closer to 1 is better in BOTH directions), so it must stay
+    direction-less."""
+    old = {
+        "serving_load_interactive_ttft_p99_s": 0.05,
+        "serving_load_batch_tpot_p99_s": 0.002,
+        "serving_load_shed_rate": 0.20,
+        "serving_load_deadline_miss_total": 4,
+        "serving_load_interactive_p99_degradation": 1.5,
+        "serving_load_tokens_per_sec": 900.0,
+        "serving_load_retry_after_honesty": 1.1,
+        "serving_load_admission_overhead_frac": 0.004,
+    }
+    new = {
+        "serving_load_interactive_ttft_p99_s": 0.08,   # worse
+        "serving_load_batch_tpot_p99_s": 0.001,        # better
+        "serving_load_shed_rate": 0.35,                # worse
+        "serving_load_deadline_miss_total": 1,         # better
+        "serving_load_interactive_p99_degradation": 2.5,  # worse
+        "serving_load_tokens_per_sec": 700.0,          # worse
+        "serving_load_retry_after_honesty": 2.0,       # report only
+        "serving_load_admission_overhead_frac": 0.02,  # worse
+    }
+    d = bench_diff(old, new, threshold=0.05)
+    assert set(d["regressions"]) == {
+        "serving_load_interactive_ttft_p99_s",
+        "serving_load_shed_rate",
+        "serving_load_interactive_p99_degradation",
+        "serving_load_tokens_per_sec",
+        "serving_load_admission_overhead_frac",
+    }
+    assert set(d["improvements"]) == {
+        "serving_load_batch_tpot_p99_s",
+        "serving_load_deadline_miss_total",
+    }
+    assert d["keys"]["serving_load_retry_after_honesty"]["direction"] is None
+
+
+def test_node_row_flags_shedding():
+    """A node whose serving admission stats show a RECENT shed renders
+    SHEDDING(total); an old shed total with no recent activity is
+    history, not a flag."""
+    def scrape(admission):
+        return {
+            "target": "s:1",
+            "routes": {
+                "/healthz": {"status": 200, "body": {"ok": True}},
+                "/node": {"status": 200, "body": {
+                    "role": "user", "node_id": "u" * 64, "peers": {},
+                    "serving": {"admission": admission},
+                }},
+            },
+        }
+
+    hot = node_row(scrape({
+        "shed_total": 17, "retry_after_s": 0.4, "last_shed_age_s": 2.5,
+        "shed_by_priority": {"batch": 15, "standard": 2},
+    }), 10.0, 2.0)
+    assert "SHEDDING(17)" in hot["flags"]
+    calm = node_row(scrape({
+        "shed_total": 17, "retry_after_s": 0.01,
+        "last_shed_age_s": 3600.0,
+    }), 10.0, 2.0)
+    assert not any(f.startswith("SHEDDING") for f in calm["flags"])
+    never = node_row(scrape({"shed_total": 0, "retry_after_s": 0.01}),
+                     10.0, 2.0)
+    assert not any(f.startswith("SHEDDING") for f in never["flags"])
+    assert "SHEDDING" in render_table([hot])
+
+
 def test_node_row_flags_kv_pool_pressure():
     """A serving node whose /node reports a paged KV pool near capacity
     is flagged KV-PRESSURE (admissions about to backpressure); a calm

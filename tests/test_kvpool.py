@@ -180,3 +180,55 @@ def test_pool_and_index_evict_integration():
     assert c == a
     blocks, n, _ = idx.match(ids)
     assert blocks == [] and n == 0  # chain starts at the evicted block
+
+
+def test_priority_aware_reusable_eviction():
+    """Pressure eviction is priority-then-LRU (ISSUE 14): the OLDEST
+    reusable block of the LEAST protected class evicts first, so a
+    BATCH tenant's cached system prompt can never push an INTERACTIVE
+    tenant's resident prefix out of the pool — even when the
+    INTERACTIVE block is older."""
+    pool = BlockPool(3, 4)
+    a, b, c = pool.alloc(3)
+    # a: INTERACTIVE-cached (priority 0), parked FIRST (oldest);
+    # b: BATCH-cached (priority 2); c: un-annotated (defaults to 2)
+    pool.mark_cached(a, priority=0)
+    pool.mark_cached(b, priority=2)
+    pool.mark_cached(c)
+    for bid in (a, b, c):
+        pool.release(bid)
+    evicted = []
+    pool.evict_hook = evicted.append
+    (x,) = pool.alloc(1)
+    assert x == b  # oldest of the least protected class, NOT oldest (a)
+    (y,) = pool.alloc(1)
+    assert y == c  # next batch-class block
+    (z,) = pool.alloc(1)
+    assert z == a  # the protected block goes last, only when nothing else
+    assert evicted == [b, c, a]
+    # eviction forgot the annotation: re-caching without one is class 2
+    pool.release(z)
+    pool.mark_cached(a)
+    assert pool._cached_prio[a] == 2
+
+
+def test_prefix_hit_upgrades_cached_priority():
+    """A prefix warmed by BATCH but HIT by INTERACTIVE is protecting
+    interactive traffic: the hit upgrades the block's eviction class
+    (min-merge), and a later BATCH re-registration cannot strip it."""
+    pool = BlockPool(2, 4)
+    a, b = pool.alloc(2)
+    pool.mark_cached(a, priority=2)  # warmed by BATCH
+    pool.mark_cached(b, priority=2)
+    pool.release(a)
+    pool.release(b)
+    pool.retain(a, priority=0)  # INTERACTIVE prefix hit revives it
+    pool.release(a)
+    # under pressure the un-upgraded BATCH block evicts first, even
+    # though the upgraded one parked reusable EARLIER
+    (x,) = pool.alloc(1)
+    assert x == b
+    # re-marking with a lower class never downgrades
+    pool.retain(a)
+    pool.mark_cached(a, priority=2)
+    assert pool._cached_prio[a] == 0
