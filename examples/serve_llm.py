@@ -109,7 +109,18 @@ def main() -> None:
                          "tensorboard --logdir DIR / xprof); the "
                          "always-on device-time attribution prints "
                          "either way")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="DISTRIBUTED serving demo over two local p2p "
+                         "nodes: a validator places each request's "
+                         "prefill leg on the highest-TFLOPs worker and "
+                         "its decode leg on the highest-HBM worker, "
+                         "the filled KV blocks cross the wire "
+                         "(CRC-framed, byte-counted), and the output "
+                         "is token-identical to colocated serving")
     args = ap.parse_args()
+    if args.disaggregate:
+        _disaggregate_demo(args)
+        return
     if args.speculate and args.ngram:
         ap.error("--speculate and --ngram are exclusive")
     if args.draft is not None and args.draft != "auto":
@@ -376,6 +387,106 @@ def main() -> None:
             f"jax.profiler capture in {args.profile_dir} — open with: "
             f"tensorboard --logdir {args.profile_dir}"
         )
+
+
+
+
+def _disaggregate_demo(args) -> None:
+    """Two worker nodes on localhost: prefill on one, decode on the
+    other, paged KV blocks as the wire unit (ISSUE 15 / ROADMAP 1)."""
+    import asyncio
+
+    from tensorlink_tpu.config import NodeConfig
+    from tensorlink_tpu.parallel.serving import PagedContinuousBatchingEngine
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    cfg = LlamaConfig(
+        vocab_size=512, dim=64, num_layers=2, num_heads=8, num_kv_heads=4,
+        hidden_dim=128, max_len=256, rope_theta=10000.0,
+    )
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0))
+    gen = GenerationConfig(
+        max_new_tokens=args.max_new, temperature=args.temperature,
+        top_p=args.top_p,
+    )
+
+    def engine():
+        return InferenceEngine(make_mesh(MeshConfig()), model, params,
+                               max_len=256)
+
+    rng = np.random.default_rng(0)
+    system = rng.integers(0, cfg.vocab_size, (24,))
+    prompts = [
+        np.concatenate([system, rng.integers(0, cfg.vocab_size, (n,))])
+        for n in (9, 17, 5)
+    ]
+    # colocated reference for the token-parity check
+    ref_eng = PagedContinuousBatchingEngine(
+        engine(), slots=2, gen=gen, block_size=16,
+    )
+    refs = [ref_eng.result(ref_eng.submit(p, seed=i))
+            for i, p in enumerate(prompts)]
+
+    async def demo():
+        nc = lambda role: NodeConfig(  # noqa: E731
+            role=role, host="127.0.0.1", port=0, capability_bench=False,
+        )
+        val, wp, wd = ValidatorNode(nc("validator")), WorkerNode(
+            nc("worker")), WorkerNode(nc("worker"))
+        user = UserNode(nc("user"))
+        for n in (val, wp, wd, user):
+            await n.start()
+        kw = dict(slots=2, gen=gen, block_size=16)
+        wp.serving_engine(engine(), paged=True, mode="prefill", **kw)
+        wd.serving_engine(engine(), paged=True, mode="decode", **kw)
+        # a real deployment measures these (WorkerNode capability
+        # microbench); the demo pins an asymmetric fleet so the
+        # roofline placement has something to choose between
+        wp.capability = {"peak_tflops": 400.0, "hbm_gbps": 50.0}
+        wd.capability = {"peak_tflops": 40.0, "hbm_gbps": 800.0}
+        for w in (wp, wd):
+            await val.ping(await val.connect("127.0.0.1", w.port))
+        print("fleet (validator's heartbeat-harvested roofline view):")
+        for nid, rec in val.peer_capabilities.items():
+            print(f"  {nid[:8]}  mode={rec['serving_mode']:9s} "
+                  f"peak_tflops={rec.get('peak_tflops')} "
+                  f"hbm_gbps={rec.get('hbm_gbps')} "
+                  f"kv_free={rec.get('kv_blocks_free')}")
+        client = user.remote_serving(
+            await user.connect("127.0.0.1", val.port)
+        )
+        for i, (p, ref) in enumerate(zip(prompts, refs)):
+            rid = await client.submit(p, seed=i)
+            out = await client.result(rid)
+            parity = "token-identical" if np.array_equal(out, ref) \
+                else "MISMATCH"
+            print(f"request {i}: {len(p)}-token prompt -> "
+                  f"{out.tolist()} ({parity} vs colocated)")
+        for name, w in (("prefill", wp), ("decode", wd)):
+            c = w.metrics.snapshot()["counters"]
+            st = w.serving.stats().get("disagg", {})
+            print(f"{name} worker: kv_wire_bytes_total="
+                  f"{c.get('kv_wire_bytes_total')} "
+                  f"transfers={c.get('kv_wire_transfers_total')} "
+                  f"disagg={st}")
+        tid = next(s.trace_id for s in user.tracer.spans()
+                   if s.name == "serving.disagg_request")
+        spans = [
+            (w, s) for w in (user, val, wp, wd)
+            for s in w.tracer.spans() if s.trace_id == tid
+        ]
+        print(f"one stitched trace ({tid[:8]}…) across "
+              f"{len({id(w) for w, _ in spans})} nodes:")
+        for w, s in spans:
+            print(f"  [{w.role:9s}] {s.name} "
+                  f"({(s.end_ns - s.start_ns) / 1e6:.1f} ms)")
+        for n in (user, val, wp, wd):
+            await n.stop()
+
+    asyncio.run(demo())
 
 
 if __name__ == "__main__":

@@ -165,6 +165,13 @@ def node_row(
         )
     node = _route_body(scrape, "/node") or {}
     row["role"] = node.get("role", "?")
+    # disaggregated serving: the ROLE column names the advertised leg
+    # (worker/prefill, worker/decode, worker/colocated) straight from
+    # the capability record, so the cluster table reads as a serving
+    # topology, not just a process list
+    serve_mode = (node.get("capability") or {}).get("serving_mode")
+    if serve_mode:
+        row["role"] = f"{row['role']}/{serve_mode}"
     row["node_id"] = str(node.get("node_id", "?"))[:16]
     peers = node.get("peers") or {}
     row["peers"] = len(peers)
@@ -217,6 +224,17 @@ def node_row(
         # _maybe_self_heal): the condition cleared without operator
         # action — advisory flag replaced by the record of the fix
         row["flags"].append(f"SELF-HEALED({healed.get('to')})")
+    disagg = serving.get("disagg") or {}
+    wire_s = disagg.get("wire_s_ewma")
+    pre_s = disagg.get("prefill_s_ewma")
+    if wire_s is not None and pre_s is not None and float(wire_s) > float(pre_s):
+        # the DCN hop costs more than the prefill compute it ships:
+        # this prefill worker is transfer-bound — bigger blocks, better
+        # compression, or a closer decode peer would pay more than a
+        # faster chip
+        row["flags"].append(
+            f"XFER-STALLED({float(wire_s):.3f}s>{float(pre_s):.3f}s)"
+        )
     adm = serving.get("admission") or {}
     if adm.get("shed_total"):
         # SLO admission control is actively shedding (serving.py
@@ -329,6 +347,11 @@ _HIGHER_BETTER = (
     # measured chip HBM bandwidth (capability_hbm_gbps) — more of
     # either is strictly better ("mfu" already matches above)
     "mbu", "gbps",
+    # disaggregated serving: tokens/s of the split prefill/decode path
+    # over the colocated baseline (1.0 = parity; the wire-byte keys
+    # stay deliberately directionless — payload size is a property of
+    # the workload, not a regression axis)
+    "vs_colocated",
 )
 _LOWER_BETTER_RE = re.compile(
     r"(_s$|_s_per_call$|seconds|latency|bubble_fraction|drop_fraction"

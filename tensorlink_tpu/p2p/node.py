@@ -626,6 +626,57 @@ class Node:
         self.on("STREAM_BEGIN", self._h_stream_begin)
         self.on("STREAM_CHUNK", self._h_stream_chunk)
         self.on("STREAM_END", self._h_stream_end)
+        self.on("KV_BLOCKS", self._h_kv_blocks)
+
+    # ------------------------------------------------------ KV-block wire
+    # Disaggregated serving's data plane (ROADMAP item 1): a prefill
+    # worker ships a request's filled KV blocks — one CRC-framed blob
+    # from parallel/kvwire.py — to the decode worker that will continue
+    # the stream. The frame layer counts bytes on BOTH legs
+    # (kv_wire_bytes_total / kv_wire_transfers_total in /metrics); what
+    # to do with a received payload is the role's business
+    # (WorkerNode.handle_kv_blocks imports it into its serving engine).
+
+    KV_TRANSFER_TIMEOUT_S = 120.0
+
+    async def send_kv_blocks(
+        self, peer: Peer, blob: bytes, meta: dict | None = None,
+        timeout: float | None = None,
+    ) -> dict:
+        """Ship one packed KV-block payload (``kvwire.pack_kv_payload``)
+        and await the receiver's import verdict (``KV_IMPORTED`` with
+        the decode-side rid, or a typed ``SERVE_FAILED``)."""
+        resp = await self.request(
+            peer,
+            {"type": "KV_BLOCKS", "meta": dict(meta or {}), "blob": blob},
+            timeout=timeout or self.KV_TRANSFER_TIMEOUT_S,
+        )
+        # counted only once the receiver's reply proves the payload
+        # crossed — a send that dies on a dead decode peer must not
+        # inflate the sender-leg wire counters the acceptance criterion
+        # and tldiag's transfer narrative read
+        self.metrics.incr("kv_wire_bytes_total", len(blob))
+        self.metrics.incr("kv_wire_transfers_total")
+        return resp
+
+    async def _h_kv_blocks(self, node, peer, msg) -> dict:
+        blob = msg.get("blob")
+        if not isinstance(blob, (bytes, bytearray)):
+            peer.ghosts += 1
+            self._penalize(peer)
+            return {"type": "ERROR", "error": "KV_BLOCKS carries no blob"}
+        self.metrics.incr("kv_wire_bytes_total", len(blob))
+        self.metrics.incr("kv_wire_transfers_total")
+        return await self.handle_kv_blocks(peer, msg)
+
+    async def handle_kv_blocks(self, peer: Peer, msg: dict) -> dict:
+        """Role hook: consume a received KV-block payload. The base
+        node has no pool to graft into."""
+        return {
+            "type": "SERVE_FAILED",
+            "error_type": "ServingError",
+            "error": f"{self.role} node has no KV sink",
+        }
 
     # ------------------------------------------------------------ streaming
     # Chunked array transfer (serialization.py streaming section): large
@@ -1007,6 +1058,11 @@ class Node:
     _CAP_SCALARS = (
         "schema", "chip", "peak_tflops", "hbm_gbps", "host_gap_frac",
         "measured_at", "measure_s", "cached",
+        # disaggregated serving: the advertised leg (prefill/decode/
+        # colocated) and the live KV-pool headroom the validator's
+        # two-leg placement gates on
+        "serving_mode", "kv_blocks_free", "kv_blocks_total",
+        "kv_block_size",
     )
     _CAP_MAX_PROGRAMS = 16
 
@@ -1176,16 +1232,45 @@ class Node:
             out["capability"] = cap
         return out
 
+    def _build_serving(self, engine, *, paged: bool = False, **kw):
+        """Shared scheduler construction for the serving roles: wire
+        this node's observability surfaces — metrics, flight recorder,
+        tracer, compile/autotune caches, measured chip capability (so
+        the engine's device_time reports MFU/MBU and per-request spans
+        land in this node's /spans) — into the engine unless the caller
+        overrides them, and attach it as ``self.serving``
+        (:meth:`capability_record` and /node read it there)."""
+        from tensorlink_tpu.parallel.serving import (
+            ContinuousBatchingEngine,
+            PagedContinuousBatchingEngine,
+        )
+
+        kw.setdefault("metrics", self.metrics)
+        kw.setdefault("recorder", self.flight)
+        kw.setdefault("compile_cache_dir", self.cfg.compile_cache_dir)
+        kw.setdefault("autotune_dir", self.cfg.autotune_dir)
+        kw.setdefault("tracer", self.tracer)
+        kw.setdefault("capability", self.capability)
+        cls = (
+            PagedContinuousBatchingEngine if paged
+            else ContinuousBatchingEngine
+        )
+        self.serving = cls(engine, **kw)
+        return self.serving
+
     def capability_record(self) -> dict | None:
-        """This node's CapabilityRecord, or None before any microbench
-        ran: the measured chip roofline (peak TFLOPs, HBM GB/s) plus —
-        when a serving scheduler is attached — its live per-program
-        device-time/MFU/MBU attribution and host-gap fraction. Rides
+        """This node's CapabilityRecord: the measured chip roofline
+        (peak TFLOPs, HBM GB/s) plus — when a serving scheduler is
+        attached — its live per-program device-time/MFU/MBU attribution,
+        host-gap fraction, and (disaggregated serving) the advertised
+        serving mode with live KV-pool headroom. None when there is
+        neither a measurement nor an advertised serving role. Rides
         every PONG and is served at /node; WorkerNode extends it with
         per-stage program MFU."""
-        if self.capability is None:
+        mode = getattr(self, "serving_mode", None)
+        if self.capability is None and mode is None:
             return None
-        rec = dict(self.capability)
+        rec = dict(self.capability or {})
         serving = getattr(self, "serving", None)
         if serving is not None and hasattr(serving, "device_time"):
             try:
@@ -1195,6 +1280,16 @@ class Node:
             if dt:
                 rec["programs"] = dt["programs"]
                 rec["host_gap_frac"] = dt["host_gap_frac"]
+        if mode is not None:
+            rec["serving_mode"] = mode
+            pool = getattr(serving, "pool", None)
+            if pool is not None:
+                # live headroom for the validator's placement gate: a
+                # PONG's worth of staleness is the accepted tradeoff
+                # (typed import backpressure covers the race)
+                rec["kv_blocks_free"] = pool.available
+                rec["kv_blocks_total"] = pool.num_blocks
+                rec["kv_block_size"] = pool.block_size
         return rec
 
     def dht_store_allowed(self, peer: Peer, key: str) -> bool:

@@ -344,6 +344,25 @@ class PrefixIndex:
                 break
         return newly
 
+    def chain_digest(self, ids: np.ndarray) -> bytes:
+        """The chained digest over the FULL blocks of ``ids`` — the key
+        the last full block indexes under. Exported with a KV-block
+        wire payload (parallel/kvwire.py) so the decode leg can verify
+        the token ids it was handed actually correspond to the blocks
+        before registering them: the digest it recomputes from the ids
+        must match, or the payload is internally inconsistent. Both
+        sides computing the SAME chain is also what makes remote blocks
+        index into the receiver's ``PrefixIndex`` at the same keys a
+        local prefill would have produced."""
+        ids = np.asarray(ids).reshape(-1)
+        bs = self.block_size
+        key = b""
+        n = 0
+        while n + bs <= len(ids):
+            key = self._digest(key, ids[n:n + bs])
+            n += bs
+        return key
+
     def forget_block(self, bid: int) -> None:
         """Drop every entry pointing at ``bid`` (pool eviction hook)."""
         for entry in self._by_block.pop(bid, []):

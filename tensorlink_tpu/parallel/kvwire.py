@@ -1,0 +1,112 @@
+"""Paged KV blocks as the wire unit (disaggregated prefill/decode).
+
+Disaggregated serving splits one request across the mesh: a PREFILL
+worker runs chunked prefill into its local ``BlockPool`` and ships only
+the filled block payloads plus their logical metadata; the DECODE
+worker grafts the blocks into its own pool through the block-table
+indirection and decodes as if it had prefilled locally. This module is
+the wire format between the two legs:
+
+- the payload is ``{per-layer k/v block stacks, prompt ids, scalars}``
+  where every k/v array is ``[n_blocks, block_size, Hkv, D]`` — BLOCK
+  granularity, never a contiguous ``[T]``-width cache (the
+  bandwidth-optimal discipline of arXiv 2112.01075: ship exactly the
+  logical blocks, reassemble through indirection, no materialized
+  intermediate on either side);
+- bytes ride the native CRC-framed gather (``p2p/serialization.py
+  pack_arrays`` over ``native/wirecodec.cpp``): one memory pass
+  concatenates + checksums, and the receiver rejects a corrupt blob
+  with a typed error instead of decoding garbage into its pool;
+- scalar metadata (logical length, first sampled token, RNG seed,
+  remaining budget, prefix digest) travels as 0-d arrays INSIDE the
+  same blob, so the CRC covers the metadata a decode leg trusts, not
+  just the tensors.
+
+``serving.PagedContinuousBatchingEngine.prefill_export`` produces the
+payload dict; ``import_prefill`` consumes it. ``pack_kv_payload`` /
+``unpack_kv_payload`` are the byte codec between them; the blob's
+``len()`` is what the ``kv_wire_bytes_total`` counters on both legs
+count.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tensorlink_tpu.p2p.serialization import pack_arrays, unpack_arrays
+
+# bump when the payload schema changes: an old decode worker must
+# reject a new prefill worker's blob with a typed error, not misread it
+KV_WIRE_SCHEMA = 1
+
+_SCALARS = (
+    "schema", "n_valid", "tok0", "seed", "remaining", "block_size",
+)
+
+
+def flatten_kv_payload(payload: dict) -> dict[str, np.ndarray]:
+    """Payload dict -> flat ``{name: array}`` for the CRC-framed gather.
+    Every field — per-layer block stacks, prompt ids, scalars — becomes
+    an array so ONE checksum covers the whole payload."""
+    flat: dict[str, np.ndarray] = {
+        "prompt_ids": np.asarray(payload["prompt_ids"], np.int32),
+    }
+    for name in _SCALARS:
+        if name == "schema":
+            flat[name] = np.asarray(KV_WIRE_SCHEMA, np.int64)
+        else:
+            flat[name] = np.asarray(int(payload[name]), np.int64)
+    digest = payload.get("prefix_digest")
+    if digest:
+        flat["prefix_digest"] = np.frombuffer(digest, np.uint8)
+    for i, layer in enumerate(payload["layers"]):
+        flat[f"L{i}.k"] = np.asarray(layer["k"])
+        flat[f"L{i}.v"] = np.asarray(layer["v"])
+    return flat
+
+
+def _scalar(v) -> int:
+    return int(np.asarray(v).reshape(-1)[0])
+
+
+def unflatten_kv_payload(flat: dict[str, np.ndarray]) -> dict:
+    schema = _scalar(flat["schema"]) if "schema" in flat else -1
+    if schema != KV_WIRE_SCHEMA:
+        raise ValueError(
+            f"kv wire schema {schema} != {KV_WIRE_SCHEMA} (peer runs an "
+            "incompatible build)"
+        )
+    layers = []
+    for i in range(len(flat)):
+        k = flat.get(f"L{i}.k")
+        if k is None:
+            break
+        layers.append({"k": k, "v": flat[f"L{i}.v"]})
+    if not layers:
+        raise ValueError("kv wire payload carries no layer blocks")
+    out = {
+        "prompt_ids": np.asarray(flat["prompt_ids"], np.int32),
+        "layers": layers,
+    }
+    for name in _SCALARS[1:]:
+        out[name] = _scalar(flat[name])
+    if "prefix_digest" in flat:
+        out["prefix_digest"] = bytes(
+            np.asarray(flat["prefix_digest"], np.uint8).tobytes()
+        )
+    return out
+
+
+def pack_kv_payload(payload: dict, codec: str = "zstd") -> bytes:
+    """Payload -> one CRC-framed blob (native gather + checksum in a
+    single memory pass; zstd on top — decode-side KV blocks are
+    low-entropy enough that the compression usually pays for itself
+    on a DCN hop)."""
+    return pack_arrays(flatten_kv_payload(payload), codec=codec)
+
+
+def unpack_kv_payload(data: bytes) -> dict:
+    """Blob -> payload. Raises ``ValueError`` on CRC mismatch (the
+    receiver must never graft a corrupt block into its pool) or on a
+    schema/shape the importer cannot trust."""
+    return unflatten_kv_payload(unpack_arrays(data))

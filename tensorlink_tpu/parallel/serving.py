@@ -111,6 +111,8 @@ __all__ = [
     "ServingError",
     "SpecConfig",
     "autopair_draft",
+    "serve_error_from_wire",
+    "serve_error_to_wire",
 ]
 
 # speculation self-healing acts only after this many verified proposals
@@ -240,6 +242,48 @@ class DeadlineExceededError(ServingError):
         self.rid = rid
 
 
+# typed scheduler errors crossing the mesh (disaggregated serving): a
+# remote leg's rejection must re-raise as the SAME type on the caller,
+# retry-after contract included, so a client's except-clauses work
+# identically for local and remote engines
+_WIRE_ERRORS = {
+    cls.__name__: cls
+    for cls in (
+        ServingError, PromptTooLongError, OverloadedError,
+        QueueFullError, PoolOverloadedError, DeadlineExceededError,
+        PoolExhaustedError,
+        # result(timeout_s=) soft timeout: the request is STILL RUNNING
+        # and collectable later — the client must see TimeoutError, not
+        # a generic failure, to know a re-poll can succeed
+        TimeoutError,
+    )
+}
+
+
+def serve_error_to_wire(e: BaseException) -> dict:
+    """Scheduler exception -> SERVE_FAILED reply dict."""
+    out = {
+        "type": "SERVE_FAILED",
+        "error_type": type(e).__name__,
+        "error": str(e)[:300],
+    }
+    ra = getattr(e, "retry_after_s", None)
+    if ra is not None:
+        out["retry_after_s"] = ra
+    return out
+
+
+def serve_error_from_wire(resp: dict) -> BaseException:
+    """SERVE_FAILED reply -> the typed exception to raise locally.
+    Unknown types degrade to ``ServingError`` (an older peer may ship
+    a type this build does not know)."""
+    cls = _WIRE_ERRORS.get(str(resp.get("error_type")), ServingError)
+    msg = str(resp.get("error", "remote serving leg failed"))
+    if issubclass(cls, OverloadedError):
+        return cls(msg, retry_after_s=resp.get("retry_after_s"))
+    return cls(msg)
+
+
 @dataclass
 class _Request:
     rid: int
@@ -270,6 +314,10 @@ class _Request:
     tokens: list[int] = field(default_factory=list)
     done: bool = False
     finished_at: float | None = None
+    # prefill-leg hold (disaggregated serving): the scheduler prefills
+    # this request but never dispatches decode for it — its filled KV
+    # blocks are exported over the wire instead (prefill_export)
+    hold: bool = False
     # speculative-decoding accounting (0 when speculation is off)
     spec_rounds: int = 0  # verify passes this request was live for
     spec_proposed: int = 0  # drafted tokens verified on its behalf
@@ -1168,6 +1216,7 @@ class ContinuousBatchingEngine:
         self, ids, *, max_new: int | None = None, seed: int = 0,
         priority: Priority | int | str = Priority.STANDARD,
         deadline_s: float | None = None,
+        _hold: bool = False,
     ) -> int:
         """Enqueue one prompt (1-D token array). Returns a request id;
         never blocks. ``priority`` is the request's SLO class
@@ -1226,6 +1275,11 @@ class ContinuousBatchingEngine:
                 # monotonic stamps against this pair
                 submitted_ns=time.time_ns(),
             )
+            # internal (prefill_export): the hold must be set UNDER the
+            # admission lock — set after submit() returns, a concurrent
+            # pump thread could dispatch decode for the request in the
+            # race window and consume the first token the export needs
+            req.hold = _hold
             if deadline_s is not None:
                 self._deadlined += 1
             self._requests[rid] = req
@@ -2179,6 +2233,25 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._table_op = self._build_table_op()
         self._retire_op = self._build_retire_op()
         self._copy_op = self._build_copy_op()
+        self._graft_op = self._build_graft_op()
+        self._adopt_op = self._build_adopt_op()
+        # immutable pool geometry (block shape never changes across
+        # self-heal rebuilds): import_prefill validates payloads
+        # against these OUTSIDE the scheduler lock, so multi-MB
+        # payload staging never stalls live decode threads
+        self._n_layers = len(caches)
+        self._block_shape = tuple(
+            caches[0]["attn"]["k"].shape[1:]
+        )  # (bs, Hkv, D)
+        # disaggregated-serving accounting (prefill_export /
+        # import_prefill + note_disagg_transfer): the stats() "disagg"
+        # block tldiag reads ROLE/XFER-STALLED from
+        self.disagg: dict[str, int] = {
+            "exports": 0, "export_blocks": 0, "export_tokens": 0,
+            "imports": 0, "import_blocks": 0, "import_tokens": 0,
+            "fallbacks": 0,
+        }
+        self._disagg_ewma: dict[str, float] = {}
         state = {
             "caches": caches,
             "valid": jnp.zeros((S, L), bool),
@@ -2389,6 +2462,460 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             )
 
         return jax.jit(run, donate_argnums=(0,))
+
+    # ------------------------------------------- disaggregated serving
+    # Prefill/decode disaggregation across the mesh (ROADMAP item 1):
+    # the paged KV BLOCK is the wire unit. prefill_export runs chunked
+    # prefill into the local pool and reads back ONLY the request's
+    # filled blocks ([n_blocks, block_size, Hkv, D] per layer — never a
+    # contiguous cache); import_prefill on the decode side allocates
+    # local block ids, scatter-grafts the payloads into its own pools
+    # through ONE shape-static program, points the slot's block table
+    # at them, and decodes as if it had prefilled locally. Sampling
+    # keys are (request seed, logical position), so the decode leg is
+    # token-identical to colocated serving by construction.
+
+    _GRAFT_WIDTH = 8  # blocks scatter-grafted per import dispatch
+
+    def _build_graft_op(self):
+        """Scatter up to ``_GRAFT_WIDTH`` received blocks into every
+        layer's k/v pools at once: ``bids`` rows past the pool width
+        (the padding sentinel) DROP, so one shape-static program
+        serves any block count."""
+
+        def run(state, blocks, bids):
+            def upd(c, bl):
+                return {
+                    **c,
+                    "k": c["k"].at[bids].set(
+                        bl["k"].astype(c["k"].dtype), mode="drop"
+                    ),
+                    "v": c["v"].at[bids].set(
+                        bl["v"].astype(c["v"].dtype), mode="drop"
+                    ),
+                }
+
+            return {
+                **state,
+                "caches": [
+                    {"attn": upd(lc["attn"], bl)}
+                    for lc, bl in zip(state["caches"], blocks)
+                ],
+            }
+
+        return jax.jit(run, donate_argnums=(0,))
+
+    def _build_adopt_op(self):
+        """Adopt an imported prefill into a slot's scalar row state —
+        exactly what the final prefill chunk would have left behind:
+        valid over the prompt, write index parked at ``n_valid`` (set
+        separately via ``_set_row``), the already-sampled first token
+        staged as the next fed token."""
+        L = self.L
+        spec = self.spec
+        ngram = spec is not None and spec.mode == "ngram"
+
+        def run(state, slot, nv, tok, seed, remaining, live, ids_row):
+            out = {
+                **state,
+                "valid": state["valid"].at[slot].set(jnp.arange(L) < nv),
+                "n_valid": state["n_valid"].at[slot].set(nv),
+                "tok": state["tok"].at[slot].set(tok),
+                "seed": state["seed"].at[slot].set(seed),
+                "remaining": state["remaining"].at[slot].set(remaining),
+                "live": state["live"].at[slot].set(live),
+            }
+            if ngram:
+                # the n-gram drafter's prompt-lookup context: the
+                # decode leg proposes from the SAME banked ids a local
+                # prefill would have written
+                out["ids"] = state["ids"].at[slot].set(ids_row)
+            return out
+
+        return jax.jit(run, donate_argnums=(0,))
+
+    def _disagg_guard(self) -> None:
+        with self._lock:  # a self-heal may swap self.spec
+            spec = self.spec
+        if spec is not None and spec.mode == "draft":
+            raise NotImplementedError(
+                "disaggregated serving with a draft model would need "
+                "the draft's prefill cache shipped beside the target's "
+                "blocks; use n-gram speculation or a non-spec decode "
+                "leg"
+            )
+
+    def prefill_export(
+        self, ids, *, max_new: int | None = None, seed: int = 0,
+        priority: Priority | int | str = Priority.STANDARD,
+        deadline_s: float | None = None, timeout_s: float | None = None,
+    ) -> dict:
+        """Run this request's PREFILL leg only and export the result.
+
+        The prompt admits through the normal queue (priority-ordered,
+        prefix-matched against the local index, chunked prefill
+        interleaved with any co-resident traffic) but the slot is HELD:
+        the scheduler never dispatches decode for it. Once the final
+        chunk lands, the filled blocks are read back at block
+        granularity and the slot torn down — the prompt prefix STAYS
+        registered in the local ``PrefixIndex``, so a repeat export of
+        a shared prefix re-prefills only the tail.
+
+        Returns the payload dict ``parallel/kvwire.py`` packs: per-layer
+        ``[n_blocks, block_size, Hkv, D]`` k/v stacks, the prompt ids,
+        the first sampled token, and the RNG/budget scalars the decode
+        leg needs for a token-identical continuation. Never materializes
+        a contiguous cache: the only device reads are block gathers."""
+        self._disagg_guard()
+        rid = self.submit(
+            ids, max_new=max_new, seed=seed, priority=priority,
+            deadline_s=deadline_s, _hold=True,
+        )
+        with self._lock:
+            req = self._requests[rid]
+        deadline = (
+            time.perf_counter() + timeout_s if timeout_s is not None
+            else None
+        )
+        idle_recheck = False
+        while True:
+            export_err: BaseException | None = None
+            with self._lock:
+                if req.failed is not None:
+                    raise req.failed
+                slot = req.slot
+                if (
+                    slot is not None
+                    and self._slot_req[slot] is req
+                    and slot not in self._pending
+                    and req.first_token is not None
+                ):
+                    try:
+                        return self._export_slot_locked(req, slot)
+                    except BaseException as e:
+                        # re-raised below, after cancel(rid) OUTSIDE
+                        # the non-reentrant lock: a failed export (the
+                        # accounting-mismatch guard, a device error in
+                        # the gather) must not leave the held slot and
+                        # its blocks pinned forever
+                        export_err = e
+            if export_err is not None:
+                self.cancel(rid)
+                raise export_err
+            if idle_recheck:
+                # an idle step() can race a concurrent pump thread that
+                # drained our final chunk between the readiness check
+                # and our step() — the re-check above just said we are
+                # STILL not ready after an idle pass, so this really is
+                # stuck; cancel so the held slot + blocks do not leak
+                self.cancel(rid)
+                raise ServingError(
+                    f"prefill-export request {rid} cannot complete: "
+                    "scheduler idle (internal accounting bug)"
+                )
+            progressed = self.step()
+            if deadline is not None and time.perf_counter() > deadline:
+                self.cancel(rid)
+                raise TimeoutError(
+                    f"prefill of request {rid} not done in {timeout_s}s"
+                )
+            idle_recheck = (
+                not progressed and req.failed is None and not req.done
+            )
+
+    def _export_slot_locked(self, req: _Request, slot: int) -> dict:
+        bs = self.block_size
+        prompt_ids = np.asarray(req.ids, np.int32).reshape(-1)
+        t0 = int(prompt_ids.size)
+        bids = list(self._slot_blocks[slot])
+        need = -(-t0 // bs)
+        if len(bids) != need:  # held slots never grow past the prompt
+            raise ServingError(
+                f"export expected {need} prompt blocks, slot maps "
+                f"{len(bids)} (internal accounting bug)"
+            )
+        tok0 = int(np.asarray(req.first_token))
+        if req.disp is not None and self._timer is not None:
+            self._timer.drained(req.disp)
+            req.disp = None
+        self._maybe_record_ttft(req)
+        # the block gathers MUST sync under the scheduler lock: every
+        # serving program DONATES the state tree, so a leaf reference
+        # captured here and read after releasing the lock could be
+        # invalidated by the very next dispatched chunk (use-after-
+        # donate) — the lock hold is the price of zero-copy donation
+        idx = jnp.asarray(np.asarray(bids, np.int32))
+        layers = [
+            {
+                "k": np.asarray(lc["attn"]["k"][idx]),
+                "v": np.asarray(lc["attn"]["v"][idx]),
+            }
+            for lc in self._state["caches"]
+        ]
+        payload = {
+            "prompt_ids": prompt_ids,
+            "layers": layers,
+            "n_valid": t0,
+            "tok0": tok0,
+            "seed": int(req.seed),
+            "remaining": int(req.max_new) - 1,
+            "block_size": bs,
+        }
+        if self.index is not None:
+            payload["prefix_digest"] = self.index.chain_digest(prompt_ids)
+        self.disagg["exports"] += 1
+        self.disagg["export_blocks"] += len(bids)
+        self.disagg["export_tokens"] += t0
+        if self.metrics is not None:
+            self.metrics.incr("kv_blocks_exported_total", len(bids))
+        self._event(
+            "serving.kv_export", rid=req.rid, blocks=len(bids),
+            tokens=t0,
+        )
+        req.first_token = None
+        # teardown: the paged _finish retires the device row BEFORE the
+        # blocks return to the pool; the registered prefix keeps them
+        # reusable, so the local cache stays warm for the next export
+        self._finish(req)
+        return payload
+
+    def import_prefill(
+        self, payload: dict, *,
+        priority: Priority | int | str = Priority.STANDARD,
+        deadline_s: float | None = None,
+    ) -> int:
+        """Graft a prefill leg's exported blocks into THIS engine's pool
+        and start decoding them: the decode side of disaggregated
+        serving. Validates geometry and the chained prefix digest
+        (kvpool.PrefixIndex.chain_digest — the ids the payload claims
+        must reproduce the digest the prefill leg computed), allocates
+        local block ids, scatter-grafts the payloads through the one
+        shape-static graft program, points the slot's block table at
+        them, and registers the prompt prefix in the local index so the
+        remote blocks serve future prefix hits HERE too.
+
+        Raises ``OverloadedError``/``PoolOverloadedError`` (typed 429 +
+        measured retry-after) when no slot or blocks are free — an
+        imported payload is never queued host-side — and ``ValueError``
+        on a payload this engine cannot trust. Returns the rid; drive
+        ``result(rid)``/``step()`` exactly like a local submission."""
+        self._disagg_guard()
+        prompt_ids = np.asarray(payload["prompt_ids"], np.int32).reshape(-1)
+        t0 = int(prompt_ids.size)
+        n_valid = int(payload["n_valid"])
+        tok0 = int(payload["tok0"])
+        seed = int(payload["seed"])
+        remaining = int(payload["remaining"])
+        bs = int(payload["block_size"])
+        layers = payload["layers"]
+        prio = _coerce_priority(priority)
+        if bs != self.block_size:
+            raise ValueError(
+                f"payload block_size {bs} != engine block_size "
+                f"{self.block_size}"
+            )
+        if n_valid != t0 or t0 == 0:
+            raise ValueError(
+                f"payload n_valid {n_valid} != prompt length {t0}"
+            )
+        if remaining < 0:
+            raise ValueError(f"negative remaining budget {remaining}")
+        nblk = -(-t0 // bs)
+        max_new = remaining + 1  # tok0 is already the first generation
+        # geometry validation + payload staging run OUTSIDE the lock:
+        # _n_layers/_block_shape are immutable engine geometry, and the
+        # multi-MB host->device staging must not stall live decode
+        # threads behind the scheduler lock
+        if len(layers) != self._n_layers:
+            raise ValueError(
+                f"payload has {len(layers)} layers, engine has "
+                f"{self._n_layers}"
+            )
+        want = (nblk, *self._block_shape)
+        for i, bl in enumerate(layers):
+            for kv in ("k", "v"):
+                shape = tuple(np.asarray(bl[kv]).shape)
+                if shape != want:
+                    raise ValueError(
+                        f"layer {i} {kv} blocks have shape {shape}, "
+                        f"expected {want}"
+                    )
+        # pre-stage the graft groups (pad the tail group to the fixed
+        # _GRAFT_WIDTH); only the tiny bid arrays depend on allocation
+        W = self._GRAFT_WIDTH
+        groups: list[list[dict]] = []
+        for off in range(0, nblk, W):
+            stacked = []
+            for bl in layers:
+                ent = {}
+                for kv in ("k", "v"):
+                    arr = np.asarray(bl[kv])[off:off + W]
+                    if arr.shape[0] < W:
+                        pad = np.zeros(
+                            (W - arr.shape[0], *arr.shape[1:]), arr.dtype
+                        )
+                        arr = np.concatenate([arr, pad], axis=0)
+                    ent[kv] = jnp.asarray(arr)
+                stacked.append(ent)
+            groups.append(stacked)
+        ids_row = np.zeros((self.L,), np.int32)
+        ids_row[:t0] = prompt_ids[: self.L]
+        eos = self.gen.eos_token_id
+        done0 = remaining <= 0 or (eos is not None and tok0 == eos)
+        with self._lock:
+            digest = payload.get("prefix_digest")
+            if digest is not None and self.index is not None:
+                # the index is swapped by self-heal rebuilds: read it
+                # under the lock
+                if self.index.chain_digest(prompt_ids) != digest:
+                    raise ValueError(
+                        "prefix digest mismatch: the payload's prompt "
+                        "ids do not correspond to its blocks"
+                    )
+            self._check_fit(t0, max_new)
+            self._expire_deadlines_locked()
+            if not self._free:
+                ra = self._retry_after_locked()
+                self._note_shed(prio, "no_decode_slot", ra)
+                raise OverloadedError(
+                    f"no free decode slot for imported prefill; retry "
+                    f"in {ra}s", retry_after_s=ra, reason="no_decode_slot",
+                )
+            try:
+                bids = self.pool.alloc(nblk)
+            except PoolExhaustedError as e:
+                ra = self._retry_after_locked()
+                self._note_shed(prio, "pool_exhausted", ra)
+                raise PoolOverloadedError(
+                    f"{e}; retry in {ra}s", retry_after_s=ra
+                ) from e
+            rid = self._next_rid
+            self._next_rid += 1
+            now = time.perf_counter()
+            req = _Request(
+                rid=rid, ids=prompt_ids, max_new=max_new, seed=seed,
+                submitted_at=now, priority=prio, deadline_s=deadline_s,
+                deadline_at=(
+                    now + deadline_s if deadline_s is not None else None
+                ),
+                submitted_ns=time.time_ns(),
+            )
+            if deadline_s is not None:
+                self._deadlined += 1
+            self._requests[rid] = req
+            slot = self._free.pop()
+            req.slot = slot
+            req.admitted_at = now
+            self._slot_req[slot] = req
+            self._slot_blocks[slot] = list(bids)
+            self._slot_limit[slot] = min(t0 + max_new, self.L)
+            self._slot_ub[slot] = t0
+            try:
+                # graft the received blocks into the pools, one staged
+                # group per dispatch of the one shape-static program
+                # (pad rows carry the pool-width sentinel and DROP)
+                sent = self.pool.num_blocks
+                for gi, stacked in enumerate(groups):
+                    grp = bids[gi * W:(gi + 1) * W]
+                    bid_arr = np.full((W,), sent, np.int32)
+                    bid_arr[: len(grp)] = grp
+                    self._state = self._graft_op(
+                        self._state, stacked, jnp.asarray(bid_arr)
+                    )
+                self._set_row(slot, start=t0)
+                self._state = self._adopt_op(
+                    self._state, jnp.int32(slot), jnp.int32(t0),
+                    jnp.int32(tok0), jnp.uint32(seed),
+                    jnp.int32(remaining),
+                    jnp.bool_(not done0), jnp.asarray(ids_row),
+                )
+                if self.index is not None:
+                    newly = self.index.register(prompt_ids, list(bids))
+                    for b in newly:
+                        self.pool.mark_cached(b, priority=prio)
+            except BaseException:
+                # a failed device dispatch (e.g. RESOURCE_EXHAUSTED
+                # staging a big payload) must not leak the slot, the
+                # blocks, or a never-finishable request — repeat
+                # imports would otherwise bleed the engine dry
+                try:
+                    self._state = self._retire_op(
+                        self._state, jnp.int32(slot)
+                    )
+                except Exception:  # noqa: BLE001 — best-effort retire
+                    pass
+                self._slot_req[slot] = None
+                self._slot_blocks[slot] = []
+                self._slot_ub[slot] = 0
+                self._slot_limit[slot] = 0
+                self._free.append(slot)
+                for b in reversed(bids):
+                    self.pool.release(b)
+                if deadline_s is not None:
+                    self._deadlined = max(self._deadlined - 1, 0)
+                self._requests.pop(rid, None)
+                raise
+            self.disagg["imports"] += 1
+            self.disagg["import_blocks"] += nblk
+            self.disagg["import_tokens"] += t0
+            req.first_token = np.int32(tok0)
+            if done0:
+                # nothing to decode: the request is complete at import
+                req.first_token = None
+                self._maybe_record_ttft_stamp(req)
+                self._append_token(req, tok0)
+        if self.metrics is not None:
+            self.metrics.incr("kv_blocks_imported_total", nblk)
+            self.metrics.incr("serving_requests_total")
+            self.metrics.incr(
+                f"serving_requests_total:{_PRIO_NAMES[prio]}"
+            )
+        self._event(
+            "serving.kv_import", rid=rid, blocks=nblk, tokens=t0,
+            slot=slot,
+        )
+        return rid
+
+    def _maybe_record_ttft_stamp(self, req: _Request) -> None:
+        # an import that finishes instantly has no device scalar to
+        # await; stamp its (trivially zero) TTFT directly
+        if req.first_token_at is None:
+            req.first_token_at = time.perf_counter()
+
+    def disagg_wire_ewma_s(self) -> float:
+        """Measured wire-transfer EWMA, 0.0 until a transfer completed.
+        The prefill role charges this against an end-to-end deadline
+        BEFORE shipping: the decode leg re-anchors its budget at import
+        arrival, so un-charged wire time would silently extend the SLO
+        (per-transfer wall time is unknowable across node clocks)."""
+        with self._lock:
+            return float(self._disagg_ewma.get("wire_s_ewma") or 0.0)
+
+    def note_disagg_transfer(
+        self, *, prefill_s: float | None = None,
+        wire_s: float | None = None, wire_bytes: int | None = None,
+        fallback: bool = False,
+    ) -> None:
+        """Fold one completed prefill-leg transfer into the EWMAs the
+        tldiag XFER-STALLED flag reads (wire-transfer time exceeding
+        prefill compute means the DCN hop, not the chip, bounds this
+        worker). Called by the worker role after each SERVE_PREFILL."""
+        with self._lock:
+            for name, v in (
+                ("prefill_s_ewma", prefill_s), ("wire_s_ewma", wire_s),
+            ):
+                if v is None:
+                    continue
+                old = self._disagg_ewma.get(name)
+                self._disagg_ewma[name] = round(
+                    v if old is None else 0.8 * old + 0.2 * v, 6
+                )
+            if wire_bytes:
+                self.disagg["wire_bytes"] = (
+                    self.disagg.get("wire_bytes", 0) + int(wire_bytes)
+                )
+            if fallback:
+                self.disagg["fallbacks"] += 1
 
     def _warm(self) -> None:
         """AOT-compile the (single) decode and prefill-chunk programs at
@@ -2885,7 +3412,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             prefilling = self._dispatch_prefill_chunk()
             decoding = [
                 s for s, r in enumerate(self._slot_req)
-                if r is not None and s not in self._pending
+                if r is not None and s not in self._pending and not r.hold
             ]
             if decoding and self.spec is not None:
                 # stage the masked-K array NOW: block growth below and
@@ -2962,4 +3489,9 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     ),
                 }
             )
+            if any(self.disagg.values()) or self._disagg_ewma:
+                # disaggregated-serving legs this engine served: export/
+                # import counters plus the prefill-vs-wire EWMAs behind
+                # the tldiag XFER-STALLED flag
+                out["disagg"] = {**self.disagg, **self._disagg_ewma}
         return out

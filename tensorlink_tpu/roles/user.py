@@ -1078,23 +1078,26 @@ class UserNode(Node):
         aresult(rid)`` — both hop to a worker thread, so neither
         prefill compiles nor chunk syncs land on the node's event loop;
         the distributed pipelined path stays ``DistributedJob.forward``."""
-        from tensorlink_tpu.parallel.serving import (
-            ContinuousBatchingEngine,
-            PagedContinuousBatchingEngine,
-        )
+        return self._build_serving(engine, paged=paged, **kw)
 
-        kw.setdefault("metrics", self.metrics)
-        kw.setdefault("recorder", self.flight)
-        kw.setdefault("compile_cache_dir", self.cfg.compile_cache_dir)
-        kw.setdefault("autotune_dir", self.cfg.autotune_dir)
-        # per-request span timelines land in this node's /spans, and a
-        # node that already measured its chip (self.capability) hands
-        # the peaks down so the engine's device_time reports MFU/MBU
-        kw.setdefault("tracer", self.tracer)
-        kw.setdefault("capability", self.capability)
-        cls = PagedContinuousBatchingEngine if paged else ContinuousBatchingEngine
-        self.serving = cls(engine, **kw)
-        return self.serving
+    def remote_serving(self, validator: Peer | None = None) -> "RemoteServingClient":
+        """The DISTRIBUTED serving front end (ROADMAP item 1): the same
+        submit()/result() surface as a local engine, but each request's
+        prefill and decode legs are placed across the mesh by a
+        validator's fleet-roofline table and the KV blocks cross the
+        wire between them. Falls back to colocated serving when the
+        fleet cannot split (or a leg dies mid-request). ``validator``
+        defaults to the first connected validator peer."""
+        if validator is None:
+            validator = next(
+                (p for p in self.peers.values() if p.role == "validator"),
+                None,
+            )
+            if validator is None:
+                raise ValueError(
+                    "remote_serving needs a connected validator peer"
+                )
+        return RemoteServingClient(self, validator)
 
     def on_peer_lost(self, peer: Peer) -> None:
         for dj in list(self._jobs.values()):
@@ -1644,3 +1647,309 @@ class UserNode(Node):
             + [a + 1 for _, a, _ in fetched]
         )
         return dj
+
+
+class RemoteServingClient:
+    """Disaggregated serving stitched behind the engine API.
+
+    ``submit()`` asks the validator for a two-leg placement
+    (``SERVE_PLAN`` over the fleet roofline table), runs the prefill
+    leg (``SERVE_PREFILL`` — the prefill worker ships the filled KV
+    blocks straight to the decode worker over ``KV_BLOCKS``), and
+    remembers where the stream now lives; ``result()`` fetches the
+    tokens from that worker. Priorities and deadlines flow through
+    unchanged, and remote typed rejections (overload with measured
+    retry-after, unmeetable deadlines) re-raise as the same exception
+    types a local engine raises.
+
+    Failure semantics: a prefill worker that cannot reach the decode
+    leg already falls back to colocated serving on itself (its reply
+    says so); a decode leg that dies AFTER import — mid-decode — makes
+    ``result()`` fall back to a full colocated re-submit on the
+    surviving prefill worker (token-identical by the (seed, position)
+    sampling-key construction), recorded as a ``serving.disagg_fallback``
+    flight event. Only when no leg survives does the typed error
+    propagate.
+
+    One root span per request (``serving.disagg_request``) parents the
+    plan/prefill/decode leg spans; the workers' handler spans continue
+    the same trace over the wire, so /spans on any involved node shows
+    the stitched prefill -> transfer -> decode timeline.
+    """
+
+    RESULT_TIMEOUT_S = 120.0
+
+    def __init__(self, user: "UserNode", validator: Peer):
+        self.user = user
+        self.validator = validator
+        self._handles: dict[int, dict] = {}
+        self._next_rid = 0
+
+    def _wire_request(
+        self, ids, max_new, seed, priority, deadline_s
+    ) -> dict:
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        if ids.size == 0:
+            raise ValueError("empty prompt")
+        req: dict = {
+            "ids": [int(t) for t in ids],
+            "seed": int(seed),
+            "priority": str(priority),
+        }
+        if max_new is not None:
+            req["max_new"] = int(max_new)
+        if deadline_s is not None:
+            req["deadline_s"] = float(deadline_s)
+        return req
+
+    async def _peer(self, winfo: dict) -> Peer:
+        node = self.user
+        p = node.peers.get(winfo["node_id"])
+        if p is not None:
+            return p
+        return await node.connect_candidates(
+            winfo["host"], int(winfo["port"]),
+            tuple(winfo.get("alt_hosts", ()) or ()),
+            expect_id=winfo["node_id"],
+        )
+
+    def _terminal(self, rid: int, h: dict) -> None:
+        """A request just failed for good: finish its root span as an
+        error and drop the handle — keeping it would leak the prompt +
+        plan per failed request on a long-lived client, and a re-poll
+        reaching finish_span twice would duplicate the root span row
+        in /spans. (A soft TimeoutError is NOT terminal: that path
+        leaves handle and span live for the next poll.)"""
+        self.user.tracer.finish_span(h["root"], status="error")
+        self._handles.pop(rid, None)
+
+    @staticmethod
+    def _check(resp: dict, *want: str) -> dict:
+        from tensorlink_tpu.parallel.serving import (
+            ServingError,
+            serve_error_from_wire,
+        )
+
+        if resp.get("type") == "SERVE_FAILED":
+            raise serve_error_from_wire(resp)
+        if resp.get("type") not in want:
+            raise ServingError(f"unexpected serving reply: {resp}")
+        return resp
+
+    async def submit(
+        self, ids, *, max_new: int | None = None, seed: int = 0,
+        priority="standard", deadline_s: float | None = None,
+    ) -> int:
+        """Place and launch one request; returns a client-side rid for
+        :meth:`result`. Raises the same typed errors a local engine's
+        ``submit`` raises (re-raised from the placed leg)."""
+        node = self.user
+        req = self._wire_request(ids, max_new, seed, priority, deadline_s)
+        root = node.tracer.start_span(
+            "serving.disagg_request", {"prompt_len": len(req["ids"])}
+        )
+        ctx = root.context()
+        with node.tracer.span("serving.leg.plan", remote=ctx):
+            plan = self._check(
+                await node.request(
+                    self.validator,
+                    # tokens this request will pin in a KV pool (prompt
+                    # + decode budget when known) — the validator's
+                    # headroom gate converts per candidate through each
+                    # worker's advertised block size
+                    {"type": "SERVE_PLAN",
+                     "need_tokens": len(req["ids"]) + req.get("max_new", 0)},
+                ),
+                "SERVE_PLAN",
+            )
+        if plan.get("error"):
+            from tensorlink_tpu.parallel.serving import OverloadedError
+
+            node.tracer.finish_span(root, status="error")
+            raise OverloadedError(
+                f"validator could not place the request: {plan['error']}",
+                reason="unplaceable",
+            )
+        handle: dict = {
+            "root": root, "req": req, "plan": plan,
+            "t0": time.perf_counter(),
+        }
+        try:
+            if plan.get("colocated"):
+                peer = await self._peer(plan["node"])
+                with node.tracer.span(
+                    "serving.leg.colocated_submit", remote=ctx
+                ):
+                    resp = self._check(
+                        await node.request(
+                            peer, {"type": "SERVE_SUBMIT", **req}
+                        ),
+                        "SERVE_ACCEPTED",
+                    )
+                handle.update(
+                    result_peer=peer, remote_rid=int(resp["rid"]),
+                    fallback_info=None, colocated=True,
+                )
+            else:
+                ppeer = await self._peer(plan["prefill"])
+                with node.tracer.span(
+                    "serving.leg.prefill", remote=ctx,
+                    attrs={"worker": plan["prefill"]["node_id"][:8]},
+                ):
+                    resp = self._check(
+                        await node.request(
+                            ppeer,
+                            {"type": "SERVE_PREFILL", **req,
+                             "decode": plan["decode"]},
+                            timeout=self.RESULT_TIMEOUT_S,
+                        ),
+                        "SERVE_PREFILLED",
+                    )
+                # on the root span, not the handle: /spans then shows
+                # how many bytes this request's KV payload put on the
+                # wire (nothing ever read it off the handle)
+                root.attrs["wire_bytes"] = int(resp.get("wire_bytes", 0))
+                if resp.get("fallback"):
+                    # the prefill worker could not reach the decode leg
+                    # and now serves the request colocated on itself
+                    node.flight.record(
+                        "serving.disagg_fallback", "warn", stage="prefill",
+                        reason=str(resp.get("reason", ""))[:200],
+                    )
+                    handle.update(
+                        result_peer=ppeer, remote_rid=int(resp["rid"]),
+                        fallback_info=None, colocated=True,
+                    )
+                else:
+                    dpeer = await self._peer(plan["decode"])
+                    handle.update(
+                        result_peer=dpeer,
+                        remote_rid=int(resp["decode_rid"]),
+                        # the surviving-leg fallback target if decode
+                        # dies mid-request
+                        fallback_info=plan["prefill"],
+                        colocated=False,
+                    )
+        except BaseException:
+            node.tracer.finish_span(root, status="error")
+            raise
+        rid = self._next_rid
+        self._next_rid += 1
+        self._handles[rid] = handle
+        return rid
+
+    async def result(
+        self, rid: int, *, timeout_s: float | None = None
+    ) -> np.ndarray:
+        """Fetch the finished stream for a :meth:`submit` rid (drives
+        the remote engine exactly like a local ``result()``)."""
+        from tensorlink_tpu.parallel.serving import ServingError
+
+        node = self.user
+        h = self._handles.get(rid)
+        if h is None:
+            raise KeyError(f"unknown remote serving request {rid}")
+        ctx = h["root"].context()
+        wait = timeout_s if timeout_s is not None else self.RESULT_TIMEOUT_S
+        msg = {
+            "type": "SERVE_RESULT", "rid": h["remote_rid"],
+            "timeout_s": wait,
+        }
+        try:
+            with node.tracer.span(
+                "serving.leg.decode" if not h.get("colocated")
+                else "serving.leg.colocated_result",
+                remote=ctx,
+            ):
+                # generous envelope past the engine-side wait: the
+                # reply must carry the typed timeout, not race it
+                raw = await node.request(
+                    h["result_peer"], msg, timeout=wait + 30.0
+                )
+        except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+            fb = h.get("fallback_info")
+            if fb is None:
+                self._terminal(rid, h)
+                raise ServingError(
+                    f"serving leg on {h['result_peer'].node_id[:8]} "
+                    f"died mid-request ({e}) and no fallback leg "
+                    "survives"
+                ) from e
+            # decode leg died mid-request: colocated re-run on the
+            # surviving prefill worker, token-identical by construction
+            node.flight.record(
+                "serving.disagg_fallback", "warn", stage="decode",
+                dead=h["result_peer"].node_id[:16],
+                reason=str(e)[:200],
+            )
+            node.metrics.incr("serving_disagg_fallback_total")
+            try:
+                fb_req = dict(h["req"])
+                if fb_req.get("deadline_s") is not None:
+                    # the deadline is end-to-end: the fallback leg gets
+                    # only what the dead legs have not already spent
+                    rem = fb_req["deadline_s"] - (
+                        time.perf_counter() - h["t0"]
+                    )
+                    if rem <= 0:
+                        from tensorlink_tpu.parallel.serving import (
+                            DeadlineExceededError,
+                        )
+
+                        raise DeadlineExceededError(
+                            f"deadline {fb_req['deadline_s']}s expired "
+                            "before the fallback leg could start"
+                        )
+                    fb_req["deadline_s"] = rem
+                fpeer = await self._peer(fb)
+                with node.tracer.span("serving.leg.fallback", remote=ctx):
+                    sub = self._check(
+                        await node.request(
+                            fpeer, {"type": "SERVE_SUBMIT", **fb_req}
+                        ),
+                        "SERVE_ACCEPTED",
+                    )
+            except BaseException:
+                self._terminal(rid, h)
+                raise
+            # the handle now points at the LIVE fallback stream: a
+            # later poll (soft timeout, transient blip) must drive it,
+            # not dial the dead decode peer again and pile up another
+            # duplicate colocated submit per attempt
+            h.update(
+                result_peer=fpeer, remote_rid=int(sub["rid"]),
+                fallback_info=None, colocated=True,
+            )
+            # re-enter: the colocated-result path applies the same
+            # typed-timeout / leg-death classification to the fallback
+            # stream (fallback_info is now None, so recursion is
+            # bounded at one level)
+            return await self.result(rid, timeout_s=timeout_s)
+        except BaseException:
+            self._terminal(rid, h)
+            raise
+        else:
+            # raised OUTSIDE the try above: a remote soft result()
+            # timeout means the stream is STILL RUNNING and collectable
+            # later. builtins TimeoutError subclasses OSError, so
+            # letting _check raise it inside the try would misread a
+            # healthy still-decoding leg as a dead one (duplicate
+            # colocated re-submit while the original stream keeps
+            # running). Handle and root span stay live for a later poll.
+            if (
+                raw.get("type") == "SERVE_FAILED"
+                and str(raw.get("error_type")) == "TimeoutError"
+            ):
+                from tensorlink_tpu.parallel.serving import (
+                    serve_error_from_wire,
+                )
+
+                raise serve_error_from_wire(raw)
+            try:
+                resp = self._check(raw, "SERVE_TOKENS")
+            except BaseException:
+                self._terminal(rid, h)
+                raise
+        node.tracer.finish_span(h["root"])
+        del self._handles[rid]
+        return np.asarray(resp["tokens"], np.int32)

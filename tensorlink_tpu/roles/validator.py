@@ -21,6 +21,91 @@ from tensorlink_tpu.roles.jobs import JobRecord, validate_job_request
 from tensorlink_tpu.roles.registry import Registry
 
 
+def roofline_score(cap: dict, leg: str) -> tuple[float, float]:
+    """Two-key roofline rank of one fleet capability record for one
+    serving leg. Prefill is compute-bound (one weight pass amortized
+    over the whole prompt), so its primary key is measured peak bf16
+    TFLOPs with HBM GB/s breaking ties; decode is bandwidth-bound
+    (every token re-reads the weights + cache), so the keys swap.
+    Missing measurements rank 0 — a worker that never published a
+    roofline loses to any measured one but stays placeable."""
+    t = float(cap.get("peak_tflops") or 0.0)
+    b = float(cap.get("hbm_gbps") or 0.0)
+    return (t, b) if leg == "prefill" else (b, t)
+
+
+def plan_serving(
+    fleet: dict[str, dict], *, need_blocks: int = 0, need_tokens: int = 0,
+) -> dict | None:
+    """Place one request's prefill and decode legs from a fleet
+    capability table (``{node_id: capability record}`` — the live view
+    heartbeat PONGs build on a validator).
+
+    Eligibility: the record must advertise a ``serving_mode`` and —
+    when it publishes KV headroom — have at least ``need_blocks`` free
+    blocks (the /metrics-backed gauge piggybacked on heartbeats).
+    ``need_tokens`` states the requirement in tokens (prompt + budget)
+    and converts per candidate through the ``kv_block_size`` its own
+    record advertises — block geometry is a worker property, so the
+    same request needs a different block count on each worker.
+    Prefill goes to the highest :func:`roofline_score` among
+    prefill/colocated workers, decode to the highest among
+    decode/colocated. When both legs would land on the SAME worker, or
+    only one worker is live, the plan degrades to colocated serving —
+    preferring colocated-mode workers but accepting a lone single-leg
+    worker too (the advertised mode is a placement PREFERENCE; every
+    attached engine can run both legs, and a one-worker fleet must
+    keep serving).
+
+    Returns ``{"colocated": True, "node": id}`` or ``{"colocated":
+    False, "prefill": id, "decode": id}``; None when nothing fits."""
+    def headroom_ok(c: dict) -> bool:
+        free = c.get("kv_blocks_free")
+        if free is None:
+            return True
+        need = need_blocks
+        bs = c.get("kv_block_size")
+        if need_tokens and bs:
+            need = max(need, -(-int(need_tokens) // int(bs)))
+        return int(free) >= need
+
+    serving = {
+        nid: c for nid, c in fleet.items()
+        if c.get("serving_mode") and headroom_ok(c)
+    }
+    pre = [
+        nid for nid, c in serving.items()
+        if c["serving_mode"] in ("prefill", "colocated")
+    ]
+    dec = [
+        nid for nid, c in serving.items()
+        if c["serving_mode"] in ("decode", "colocated")
+    ]
+    # node_id is the deterministic final tie-break (unmeasured fleets)
+    best_pre = max(
+        pre, key=lambda n: (*roofline_score(serving[n], "prefill"), n),
+        default=None,
+    )
+    best_dec = max(
+        dec, key=lambda n: (*roofline_score(serving[n], "decode"), n),
+        default=None,
+    )
+    if best_pre is not None and best_dec is not None and best_pre != best_dec:
+        return {"colocated": False, "prefill": best_pre, "decode": best_dec}
+    colo = [
+        nid for nid, c in serving.items()
+        if c["serving_mode"] == "colocated"
+    ] or list(serving)
+    if not colo:
+        return None
+    return {
+        "colocated": True,
+        # a lone colocated node serves both legs; rank by the decode
+        # roofline — steady-state serving time is decode-dominated
+        "node": max(colo, key=lambda n: (*roofline_score(serving[n], "decode"), n)),
+    }
+
+
 class ValidatorNode(Node):
     def __init__(
         self,
@@ -98,6 +183,7 @@ class ValidatorNode(Node):
         self.on("JOB_INFO", self._h_job_info)
         self.on("REPLACE_WORKER", self._h_replace_worker)
         self.on("JOB_REPLICATE", self._h_job_replicate)
+        self.on("SERVE_PLAN", self._h_serve_plan)
 
     def authorize_peer(self, node_id: str, role: str) -> bool:
         """Reputation gate (reference: smart_node.py:329-337)."""
@@ -160,12 +246,14 @@ class ValidatorNode(Node):
         def rank(kv):
             nid, s = kv
             # best-fit on memory first (smallest adequate slot), then —
-            # among equal-memory candidates — the FASTER chip by the
-            # measured peak TFLOPs its heartbeat capability record
-            # published (the fleet table's first placement consumer;
-            # ROADMAP item 1 extends this to full roofline placement)
+            # among equal-memory candidates — the FULL two-key roofline
+            # score from the heartbeat capability record: faster chip
+            # first, higher HBM bandwidth breaking residual ties (a
+            # training stage is compute-bound like a prefill leg, so
+            # the "prefill" ordering applies)
             cap = self.peer_capabilities.get(nid) or {}
-            return (s.get("memory", 0), -(cap.get("peak_tflops") or 0.0))
+            t, b = roofline_score(cap, "prefill")
+            return (s.get("memory", 0), -t, -b)
 
         candidates = sorted(
             (
@@ -431,6 +519,61 @@ class ValidatorNode(Node):
             # reattach/resume flows rebuild their failover list from this
             "validators": await self._job_replica_set(jid),
         }
+
+    async def _h_serve_plan(self, node, peer, msg) -> dict:
+        """Disaggregated-serving placement (ROADMAP item 1): place a
+        request's prefill and decode legs from the live fleet roofline
+        table this validator's heartbeats harvested — prefill on the
+        highest measured peak TFLOPs, decode on the highest HBM GB/s,
+        both gated on the KV-pool headroom each worker's capability
+        record publishes (the /metrics gauges, piggybacked on PONGs).
+        Degrades to a colocated placement when only one serving worker
+        is live. The reply carries full dial info (advertised address +
+        the address this validator actually reaches each worker at) so
+        the user and the prefill worker can reach both legs."""
+        need = int(msg.get("need_blocks", 0) or 0)
+        need_tokens = int(msg.get("need_tokens", 0) or 0)
+        fleet = {
+            nid: cap
+            for nid, cap in self.peer_capabilities.items()
+            if nid in self.peers and cap.get("role") == "worker"
+        }
+        plan = plan_serving(fleet, need_blocks=need, need_tokens=need_tokens)
+        if plan is None:
+            self.flight.record(
+                "serving.unplaceable", "warn", need_blocks=need,
+                need_tokens=need_tokens, fleet=len(fleet),
+            )
+            return {
+                "type": "SERVE_PLAN",
+                "error": "no serving-capable worker "
+                         f"(fleet of {len(fleet)}, need {need} blocks)",
+            }
+
+        def winfo(nid: str) -> dict:
+            # the validator's Peer.info host IS the address it reaches
+            # the worker at (dialed target for outbound, observed
+            # peername for inbound) — unlike recruitment there is no
+            # second self-advertised record to merge, so the wire info
+            # ships as-is; multi-candidate NAT dial info would need
+            # workers to publish their own PeerInfo on heartbeats
+            info = self.peers[nid].info.to_wire()
+            info["serving_mode"] = fleet[nid].get("serving_mode")
+            return info
+
+        out: dict = {"type": "SERVE_PLAN", "colocated": plan["colocated"]}
+        if plan["colocated"]:
+            out["node"] = winfo(plan["node"])
+        else:
+            out["prefill"] = winfo(plan["prefill"])
+            out["decode"] = winfo(plan["decode"])
+        self.flight.record(
+            "serving.placement",
+            colocated=plan["colocated"],
+            prefill=str(plan.get("prefill", plan.get("node", "")))[:16],
+            decode=str(plan.get("decode", plan.get("node", "")))[:16],
+        )
+        return out
 
     async def _h_replace_worker(self, node, peer, msg) -> dict:
         """Elastic re-recruitment after a stage failure (the reference's

@@ -554,6 +554,15 @@ class WorkerNode(Node):
         # leaked (review finding).
         self._reservations: dict[tuple[str, int], tuple[int, float, str]] = {}
         self.training = False
+        # disaggregated serving (ROADMAP item 1): a worker may host a
+        # continuous-batching scheduler and advertise a serving leg —
+        # "prefill" (compute-bound chunked prefill, blocks exported),
+        # "decode" (bandwidth-bound continuation of imported blocks),
+        # or "colocated" (both legs). Advertised on every heartbeat
+        # PONG via capability_record; the validator places legs from
+        # the resulting fleet roofline table.
+        self.serving = None
+        self.serving_mode: str | None = None
 
     # ------------------------------------------------------------ autotune
     def _autotune_key(self):
@@ -724,7 +733,292 @@ class WorkerNode(Node):
         self.on("PARAMS_REQUEST", self._h_params_request)
         self.on("POL_CHALLENGE", self._h_pol_challenge)
         self.on("UNLOAD", self._h_unload)
+        self.on("SERVE_SUBMIT", self._h_serve_submit)
+        self.on("SERVE_RESULT", self._h_serve_result)
+        self.on("SERVE_PREFILL", self._h_serve_prefill)
         self.register_stream_kind("module_spec", self._stream_module_spec)
+
+    # ------------------------------------------------ serving (disagg)
+    def serving_engine(
+        self, engine, *, paged: bool = False, mode: str = "colocated",
+        **kw,
+    ):
+        """Attach a continuous-batching scheduler to this WORKER and
+        advertise it as a serving leg. ``mode`` is what the heartbeat
+        capability record tells validators this worker WANTS to serve:
+
+        - ``"colocated"``: full requests (``SERVE_SUBMIT``/
+          ``SERVE_RESULT``) — also the fallback target when a
+          disaggregated leg dies;
+        - ``"prefill"``: the compute-bound leg — ``SERVE_PREFILL`` runs
+          chunked prefill locally and ships the filled KV blocks to the
+          decode worker named in the request;
+        - ``"decode"``: the bandwidth-bound leg — received ``KV_BLOCKS``
+          graft into the local pool and decode in the continuous-
+          batching engine as if prefilled here.
+
+        Disaggregated modes require ``paged=True``: the paged KV block
+        is the wire unit. Observability wiring is the shared
+        ``Node._build_serving`` (metrics/flight/tracer/compile cache/
+        autotune/capability), same as the user role's."""
+        if mode not in ("colocated", "prefill", "decode"):
+            raise ValueError(
+                f"serving mode must be colocated/prefill/decode, "
+                f"got {mode!r}"
+            )
+        if mode != "colocated" and not paged:
+            raise ValueError(
+                "disaggregated serving modes require paged=True — the "
+                "paged KV block is the wire unit"
+            )
+        self._build_serving(engine, paged=paged, **kw)
+        self.serving_mode = mode
+        self.flight.record("serving.attached", mode=mode, paged=paged)
+        return self.serving
+
+    def _serving_or_error(self, need_paged: bool = False):
+        serving = self.serving
+        if serving is None or (
+            need_paged and not hasattr(serving, "import_prefill")
+        ):
+            from tensorlink_tpu.parallel.serving import (
+                ServingError,
+                serve_error_to_wire,
+            )
+
+            return None, serve_error_to_wire(ServingError(
+                "no paged serving engine attached to this worker"
+                if need_paged else
+                "no serving engine attached to this worker"
+            ))
+        return serving, None
+
+    @staticmethod
+    def _serve_kwargs(msg: dict) -> dict:
+        out = {
+            "seed": int(msg.get("seed", 0)),
+            "priority": msg.get("priority", "standard"),
+        }
+        if msg.get("max_new") is not None:
+            out["max_new"] = int(msg["max_new"])
+        if msg.get("deadline_s") is not None:
+            out["deadline_s"] = float(msg["deadline_s"])
+        return out
+
+    async def _h_serve_submit(self, node, peer, msg) -> dict:
+        """Colocated admission: the full-request path (and the dead-leg
+        fallback target). Typed scheduler rejections — overload with
+        measured retry-after, unmeetable deadlines — cross the wire as
+        SERVE_FAILED and re-raise as the same type on the caller."""
+        from tensorlink_tpu.parallel.serving import serve_error_to_wire
+
+        serving, err = self._serving_or_error()
+        if err is not None:
+            return err
+        ids = np.asarray(msg["ids"], np.int32).reshape(-1)
+        try:
+            rid = await serving.asubmit(ids, **self._serve_kwargs(msg))
+        except Exception as e:  # noqa: BLE001 — typed across the wire
+            return serve_error_to_wire(e)
+        return {"type": "SERVE_ACCEPTED", "rid": rid}
+
+    async def _h_serve_result(self, node, peer, msg) -> dict:
+        from tensorlink_tpu.parallel.serving import serve_error_to_wire
+
+        serving, err = self._serving_or_error()
+        if err is not None:
+            return err
+        kw = {}
+        if msg.get("timeout_s") is not None:
+            kw["timeout_s"] = float(msg["timeout_s"])
+        if msg.get("deadline_s") is not None:
+            kw["deadline_s"] = float(msg["deadline_s"])
+        try:
+            tokens = await serving.aresult(int(msg["rid"]), **kw)
+        except Exception as e:  # noqa: BLE001 — typed across the wire
+            return serve_error_to_wire(e)
+        return {
+            "type": "SERVE_TOKENS",
+            "rid": int(msg["rid"]),
+            "tokens": [int(t) for t in np.asarray(tokens).reshape(-1)],
+        }
+
+    async def _h_serve_prefill(self, node, peer, msg) -> dict:
+        """The PREFILL leg: chunked-prefill the prompt into the local
+        pool, ship the filled blocks to the decode worker named in
+        ``msg["decode"]``, and answer with the decode-side rid the
+        caller fetches the stream from.
+
+        Failure semantics: when the decode leg is unreachable or
+        refuses the import, this worker FALLS BACK to colocated serving
+        — the prompt prefix it just prefilled is registered in its own
+        index, so the re-submit prefix-hits and pays only the tail —
+        and the reply says so (``fallback: "colocated"`` + local rid).
+        A ``serving.disagg_fallback`` flight event records the
+        downgrade either way."""
+        from tensorlink_tpu.parallel.kvwire import pack_kv_payload
+        from tensorlink_tpu.parallel.serving import serve_error_to_wire
+
+        serving, err = self._serving_or_error(need_paged=True)
+        if err is not None:
+            return err
+        ids = np.asarray(msg["ids"], np.int32).reshape(-1)
+        kw = self._serve_kwargs(msg)
+        t0 = time.perf_counter()
+        try:
+            with self.tracer.span(
+                "serving.prefill_leg", {"prompt_len": int(ids.size)}
+            ):
+                payload = await asyncio.to_thread(
+                    serving.prefill_export, ids, **kw
+                )
+        except Exception as e:  # noqa: BLE001 — typed across the wire
+            return serve_error_to_wire(e)
+        prefill_s = time.perf_counter() - t0
+        blob = await asyncio.to_thread(pack_kv_payload, payload)
+        dec = msg.get("decode") or {}
+        # the deadline is END-TO-END: the decode leg (and any local
+        # fallback) gets only what prefill + packing have not already
+        # spent — a re-anchored full budget would let a disagg request
+        # run to ~2x the SLO the caller asked for
+        if kw.get("deadline_s") is not None:
+            from tensorlink_tpu.parallel.serving import (
+                DeadlineExceededError,
+            )
+
+            rem = kw["deadline_s"] - (time.perf_counter() - t0)
+            if rem <= 0:
+                return serve_error_to_wire(DeadlineExceededError(
+                    f"deadline {kw['deadline_s']}s fully consumed by "
+                    "the prefill leg"
+                ))
+            kw["deadline_s"] = rem
+        meta = {
+            "priority": kw.get("priority", "standard"),
+            "deadline_s": kw.get("deadline_s"),
+            "origin": peer.node_id,
+        }
+        reason = None
+        t1 = time.perf_counter()
+        if kw.get("deadline_s") is not None:
+            # the decode leg re-anchors its budget at import ARRIVAL, so
+            # wire time would silently extend the end-to-end SLO: charge
+            # the measured transfer EWMA upfront (per-transfer wall time
+            # is unknowable across node clocks). An estimate that alone
+            # exhausts the budget skips the hop — colocated serving on
+            # the just-warmed prefix beats a transfer we cannot afford.
+            est = serving.disagg_wire_ewma_s()
+            if kw["deadline_s"] - est <= 0:
+                reason = (
+                    f"transfer EWMA {est:.3f}s exceeds remaining "
+                    f"deadline {kw['deadline_s']:.3f}s"
+                )
+            else:
+                meta["deadline_s"] = kw["deadline_s"] - est
+        if reason is None:
+            try:
+                with self.tracer.span(
+                    "serving.kv_transfer",
+                    {"bytes": len(blob),
+                     "to": str(dec.get("node_id", ""))[:8]},
+                ):
+                    dpeer = self.peers.get(dec.get("node_id"))
+                    if dpeer is None:
+                        dpeer = await self.connect_candidates(
+                            dec["host"], int(dec["port"]),
+                            tuple(dec.get("alt_hosts", ()) or ()),
+                            expect_id=dec.get("node_id"),
+                        )
+                    resp = await self.send_kv_blocks(dpeer, blob, meta)
+                if resp.get("type") == "KV_IMPORTED":
+                    wire_s = time.perf_counter() - t1
+                    serving.note_disagg_transfer(
+                        prefill_s=prefill_s, wire_s=wire_s,
+                        wire_bytes=len(blob),
+                    )
+                    return {
+                        "type": "SERVE_PREFILLED",
+                        "decode_rid": int(resp["rid"]),
+                        "decode_node": dec.get("node_id"),
+                        "wire_bytes": len(blob),
+                        "prefill_s": round(prefill_s, 6),
+                        "wire_s": round(wire_s, 6),
+                    }
+                reason = (
+                    f"{resp.get('error_type', resp.get('type'))}: "
+                    f"{resp.get('error', 'import refused')}"
+                )
+            except (ConnectionError, OSError, KeyError,
+                    asyncio.TimeoutError) as e:
+                reason = f"{type(e).__name__}: {e}"
+        # decode leg dead or refusing: serve the whole request HERE.
+        # The export left the prompt prefix registered locally, so this
+        # re-submit re-prefills only the tail (prefix hit), and the
+        # (seed, position) sampling keys keep it token-identical.
+        self.flight.record(
+            "serving.disagg_fallback", "warn",
+            decode=str(dec.get("node_id", ""))[:16], reason=reason[:200],
+        )
+        self.metrics.incr("serving_disagg_fallback_total")
+        serving.note_disagg_transfer(prefill_s=prefill_s, fallback=True)
+        if kw.get("deadline_s") is not None:
+            from tensorlink_tpu.parallel.serving import (
+                DeadlineExceededError,
+            )
+
+            # the remainder computed above predates the transfer
+            # attempt: a decode peer that accepts TCP but hangs burns
+            # up to KV_TRANSFER_TIMEOUT_S here, and the end-to-end
+            # deadline must charge that wait to this request too
+            rem = kw["deadline_s"] - (time.perf_counter() - t1)
+            if rem <= 0:
+                return serve_error_to_wire(DeadlineExceededError(
+                    f"deadline fully consumed by the failed KV "
+                    f"transfer to {str(dec.get('node_id', ''))[:8]}"
+                ))
+            kw["deadline_s"] = rem
+        try:
+            rid = await serving.asubmit(ids, **kw)
+        except Exception as e:  # noqa: BLE001 — typed across the wire
+            return serve_error_to_wire(e)
+        return {
+            "type": "SERVE_PREFILLED",
+            "fallback": "colocated",
+            "rid": rid,
+            "reason": reason[:200],
+            "wire_bytes": 0,
+            "prefill_s": round(prefill_s, 6),
+        }
+
+    async def handle_kv_blocks(self, peer: Peer, msg: dict) -> dict:
+        """The DECODE leg's import side: unpack the CRC-framed blob
+        (corruption raises before anything touches the pool), graft the
+        blocks into the local engine, and hand back the rid the user
+        front end will fetch. Overload is a typed SERVE_FAILED with a
+        measured retry-after — never a silent drop."""
+        from tensorlink_tpu.parallel.kvwire import unpack_kv_payload
+        from tensorlink_tpu.parallel.serving import serve_error_to_wire
+
+        serving, err = self._serving_or_error(need_paged=True)
+        if err is not None:
+            return err
+        meta = msg.get("meta") or {}
+        kw = {"priority": meta.get("priority", "standard")}
+        if meta.get("deadline_s") is not None:
+            kw["deadline_s"] = float(meta["deadline_s"])
+        try:
+            with self.tracer.span(
+                "serving.kv_import", {"bytes": len(msg["blob"])}
+            ):
+                payload = await asyncio.to_thread(
+                    unpack_kv_payload, bytes(msg["blob"])
+                )
+                rid = await asyncio.to_thread(
+                    serving.import_prefill, payload, **kw
+                )
+        except Exception as e:  # noqa: BLE001 — typed across the wire
+            return serve_error_to_wire(e)
+        return {"type": "KV_IMPORTED", "rid": rid}
 
     def _observe_stage(self, stage: int, kind: str, dt: float) -> None:
         """Per-stage local compute time: the stage{i}_fwd_s/_bwd_s series

@@ -199,6 +199,19 @@ class Tracer:
 
         return deco
 
+    def finish_span(self, s: Span, status: str = "ok") -> Span:
+        """Close and record a span obtained from :meth:`start_span`
+        without ever making it the ambient context — for spans held
+        open across awaits in different tasks (the disaggregated-
+        serving front end keeps one root span per request from submit
+        to result and parents each leg's RPC span onto it via
+        ``remote=s.context()``)."""
+        s.end_ns = time.time_ns()
+        s.status = status
+        with self._lock:
+            self._spans.append(s)
+        return s
+
     def record_span(
         self,
         name: str,

@@ -786,3 +786,76 @@ def test_bench_diff_devtime_key_directions():
     assert set(d["improvements"]) == {
         "decode_mbu", "serving_timing_overhead_frac",
     }
+
+
+def test_bench_diff_disagg_key_directions():
+    """Disaggregated-serving keys: the vs-colocated ratio is
+    higher-better, the per-leg TTFT decomposition is lower-better, and
+    the wire-byte keys are deliberately directionless (payload size is
+    a property of the workload, not a regression axis)."""
+    old = {"metric": "x", "serving_disagg_vs_colocated": 1.2,
+           "disagg_ttft_transfer_s": 0.010,
+           "disagg_ttft_prefill_s": 0.020,
+           "kv_wire_bytes_total": 1000, "kv_wire_bytes_per_token": 40.0}
+    new = {"metric": "x", "serving_disagg_vs_colocated": 0.8,
+           "disagg_ttft_transfer_s": 0.030,
+           "disagg_ttft_prefill_s": 0.018,
+           "kv_wire_bytes_total": 9000, "kv_wire_bytes_per_token": 360.0}
+    d = bench_diff(old, new)
+    assert "serving_disagg_vs_colocated" in d["regressions"]
+    assert "disagg_ttft_transfer_s" in d["regressions"]
+    assert "disagg_ttft_prefill_s" in d["improvements"]
+    for k in ("kv_wire_bytes_total", "kv_wire_bytes_per_token"):
+        assert d["keys"][k]["direction"] is None
+        assert k not in d["regressions"]
+
+
+def _disagg_scrape(serving, capability=None):
+    node_body = {
+        "role": "worker", "node_id": "w" * 64, "peers": {},
+        "serving": serving,
+    }
+    if capability is not None:
+        node_body["capability"] = capability
+    return {
+        "target": "w:1",
+        "routes": {
+            "/healthz": {"status": 200, "body": {"ok": True}},
+            "/node": {"status": 200, "body": node_body},
+        },
+    }
+
+
+def test_node_row_role_column_names_serving_leg():
+    """The cluster table's ROLE column appends the advertised serving
+    leg from the capability record: the fleet reads as a serving
+    topology (worker/prefill, worker/decode), not a process list."""
+    row = node_row(_disagg_scrape(
+        {}, capability={"serving_mode": "prefill"}
+    ))
+    assert row["role"] == "worker/prefill"
+    plain = node_row(_disagg_scrape({}))
+    assert plain["role"] == "worker"
+    table = render_table([row])
+    assert "worker/prefill" in table
+
+
+def test_node_row_flags_xfer_stalled():
+    """XFER-STALLED fires exactly when the wire-transfer EWMA exceeds
+    the prefill-compute EWMA — the prefill worker is bound by the DCN
+    hop, not its chip."""
+    stalled = node_row(_disagg_scrape({
+        "disagg": {"prefill_s_ewma": 0.010, "wire_s_ewma": 0.050,
+                   "exports": 3},
+    }, capability={"serving_mode": "prefill"}))
+    assert any(f.startswith("XFER-STALLED") for f in stalled["flags"])
+    healthy = node_row(_disagg_scrape({
+        "disagg": {"prefill_s_ewma": 0.050, "wire_s_ewma": 0.010,
+                   "exports": 3},
+    }, capability={"serving_mode": "prefill"}))
+    assert not any(f.startswith("XFER-STALLED") for f in healthy["flags"])
+    # a decode-only worker (no transfer EWMAs at all) never flags
+    silent = node_row(_disagg_scrape({
+        "disagg": {"imports": 5},
+    }, capability={"serving_mode": "decode"}))
+    assert not any(f.startswith("XFER-STALLED") for f in silent["flags"])

@@ -1,0 +1,613 @@
+"""Disaggregated prefill/decode serving (ISSUE 15).
+
+Pins the contract at every layer: the engine-level export/wire/import
+round trip is token-identical to colocated serving and never
+materializes a contiguous cache; the validator places legs by the
+two-key roofline score gated on KV headroom; the role path moves real
+blocks over real sockets with byte counters on both legs and one
+stitched trace; and a dead decode leg degrades to colocated serving in
+milliseconds (fail-fast p2p) with a ``serving.disagg_fallback`` flight
+event — never a hung request.
+"""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorlink_tpu.config import MeshConfig, NodeConfig
+from tensorlink_tpu.models.llama import Llama, LlamaConfig
+from tensorlink_tpu.parallel.inference import GenerationConfig, InferenceEngine
+from tensorlink_tpu.parallel.kvwire import (
+    pack_kv_payload,
+    unpack_kv_payload,
+)
+from tensorlink_tpu.parallel.serving import (
+    OverloadedError,
+    PagedContinuousBatchingEngine,
+    PoolOverloadedError,
+    ServingError,
+    SpecConfig,
+    serve_error_from_wire,
+    serve_error_to_wire,
+)
+from tensorlink_tpu.roles.validator import plan_serving, roofline_score
+from tensorlink_tpu.runtime.mesh import make_mesh
+
+KEY = jax.random.key(0)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny()
+    m = Llama(cfg)
+    p = m.init(KEY)
+    return cfg, m, p
+
+
+def _engine(tiny, max_len=32):
+    cfg, m, p = tiny
+    return InferenceEngine(
+        make_mesh(MeshConfig()), m, p, max_len=max_len,
+        cache_dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+
+
+def _paged(tiny, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("gen", GenerationConfig(max_new_tokens=6))
+    kw.setdefault("decode_chunk", 3)
+    kw.setdefault("block_size", 4)
+    return PagedContinuousBatchingEngine(_engine(tiny), **kw)
+
+
+def _prompts(cfg, lengths, seed=0):
+    r = np.random.default_rng(seed)
+    return [r.integers(0, cfg.vocab_size, (n,)) for n in lengths]
+
+
+# ------------------------------------------------- engine-level loopback
+
+
+def test_export_wire_import_token_identical(tiny):
+    """The acceptance bar: a request whose prefill ran on engine A and
+    whose decode ran on engine B — blocks crossing the packed wire
+    format in between — emits EXACTLY the colocated engine's tokens."""
+    cfg = tiny[0]
+    prompts = _prompts(cfg, (5, 9, 3, 12))
+    colo = _paged(tiny)
+    refs = [colo.result(colo.submit(p_)) for p_ in prompts]
+    A, B = _paged(tiny), _paged(tiny)
+    for p_, ref in zip(prompts, refs):
+        payload = A.prefill_export(p_)
+        blob = pack_kv_payload(payload)
+        assert len(blob) > 0
+        rid = B.import_prefill(unpack_kv_payload(blob))
+        np.testing.assert_array_equal(B.result(rid), ref)
+    assert A.disagg["exports"] == len(prompts)
+    assert B.disagg["imports"] == len(prompts)
+    assert A.stats()["disagg"]["export_tokens"] == sum(
+        len(p_) for p_ in prompts
+    )
+
+
+def test_transfer_never_materializes_contiguous_cache(tiny):
+    """The bandwidth-optimal pin: every wire payload is BLOCK-shaped
+    ([n_blocks, block_size, Hkv, D] per layer) and neither leg ever
+    builds a contiguous cache — the contiguous ``init_caches`` form
+    (what a gather-then-reshard transfer would materialize) is poisoned
+    for the whole round trip."""
+    cfg, m, p = tiny
+    prompt = _prompts(cfg, (9,))[0]
+    A, B = _paged(tiny), _paged(tiny)
+    ref = None
+    colo = _paged(tiny)
+    ref = colo.result(colo.submit(prompt))
+
+    def boom(*a, **kw):  # any contiguous-cache allocation fails the test
+        raise AssertionError("contiguous cache materialized on a leg")
+
+    orig = type(m).init_caches
+    type(m).init_caches = boom
+    try:
+        payload = A.prefill_export(prompt)
+        for layer in payload["layers"]:
+            for kv in ("k", "v"):
+                shape = np.asarray(layer[kv]).shape
+                assert shape[0] == -(-len(prompt) // A.block_size)
+                assert shape[1] == A.block_size
+        rid = B.import_prefill(
+            unpack_kv_payload(pack_kv_payload(payload))
+        )
+        out = B.result(rid)
+    finally:
+        type(m).init_caches = orig
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_import_registers_prefix_on_decode_leg(tiny):
+    """Digest preservation: remote blocks index into the DECODE side's
+    PrefixIndex under the same chained digests a local prefill would
+    have produced — a later local submit of the same prompt prefix on
+    the decode worker re-prefills only the tail."""
+    cfg = tiny[0]
+    prompt = _prompts(cfg, (9,))[0]
+    A, B = _paged(tiny), _paged(tiny)
+    rid = B.import_prefill(
+        unpack_kv_payload(pack_kv_payload(A.prefill_export(prompt)))
+    )
+    B.result(rid)
+    base_prefilled = B.prefilled_tokens
+    rid2 = B.submit(prompt)
+    B.result(rid2)
+    # of the 9 prompt tokens, the 2 resident full blocks (8 tokens,
+    # capped at len-1) never re-prefill
+    assert B.prefilled_tokens - base_prefilled < len(prompt)
+    assert B.prefix_hit_rate() > 0
+    # ... and the PREFILL side's cache stayed warm too: a repeat export
+    # of the same prompt prefix-hits locally
+    before = A.prefix_matched_tokens
+    A.prefill_export(prompt)
+    assert A.prefix_matched_tokens > before
+
+
+def test_export_import_with_ngram_speculation(tiny):
+    """Disagg composes with n-gram self-speculation: the prompt ids
+    buffer ships with the payload, so the decode leg's prompt-lookup
+    drafts from the same banked context — output stays token-identical
+    to the non-spec colocated engine (spec correctness guarantee)."""
+    cfg = tiny[0]
+    prompt = np.concatenate([_prompts(cfg, (6,))[0]] * 3)  # motif helps
+    colo = _paged(tiny)
+    ref = colo.result(colo.submit(prompt))
+    spec = dict(speculative=SpecConfig(k=2, rounds=1, adaptive=False))
+    A, B = _paged(tiny, **spec), _paged(tiny, **spec)
+    rid = B.import_prefill(
+        unpack_kv_payload(pack_kv_payload(A.prefill_export(prompt)))
+    )
+    np.testing.assert_array_equal(B.result(rid), ref)
+
+
+def test_import_typed_backpressure_and_validation(tiny):
+    cfg = tiny[0]
+    prompts = _prompts(cfg, (9, 9, 9))
+    A = _paged(tiny)
+    payload = A.prefill_export(prompts[0])
+    # geometry the importer must refuse
+    bad = dict(payload, block_size=8)
+    B = _paged(tiny)
+    with pytest.raises(ValueError, match="block_size"):
+        B.import_prefill(bad)
+    # digest mismatch: ids that do not correspond to the blocks
+    tampered = dict(payload)
+    tampered["prompt_ids"] = np.asarray(payload["prompt_ids"]).copy()
+    tampered["prompt_ids"][0] ^= 1
+    with pytest.raises(ValueError, match="digest"):
+        B.import_prefill(tampered)
+    # no free decode slot -> typed 429 with a measured retry-after
+    small = _paged(tiny, slots=1)
+    p1 = A.prefill_export(prompts[1])
+    p2 = A.prefill_export(prompts[2])
+    small.import_prefill(p1)
+    with pytest.raises(OverloadedError) as ei:
+        small.import_prefill(p2)
+    assert ei.value.retry_after_s is not None
+    # pool starved (slots free, blocks held by a live stream) ->
+    # PoolOverloadedError, catchable as either parent type
+    tight = _paged(tiny, slots=2, num_blocks=5)
+    tight.import_prefill(p1)  # 3 of 5 blocks now live
+    with pytest.raises(PoolOverloadedError) as ei2:
+        tight.import_prefill(p2)  # needs 4, only 2 remain
+    assert ei2.value.retry_after_s is not None
+
+
+def test_corrupt_wire_blob_rejected(tiny):
+    cfg = tiny[0]
+    A = _paged(tiny)
+    blob = bytearray(
+        pack_kv_payload(A.prefill_export(_prompts(cfg, (7,))[0]),
+                        codec="none")
+    )
+    blob[-3] ^= 0xFF
+    with pytest.raises(ValueError, match="CRC-32C"):
+        unpack_kv_payload(bytes(blob))
+
+
+def test_serve_error_wire_round_trip():
+    e = PoolOverloadedError("pool full", retry_after_s=1.25)
+    wire = serve_error_to_wire(e)
+    back = serve_error_from_wire(wire)
+    assert isinstance(back, PoolOverloadedError)
+    assert isinstance(back, OverloadedError)  # catchable either way
+    assert back.retry_after_s == 1.25
+    unknown = serve_error_from_wire(
+        {"error_type": "FutureError", "error": "?"}
+    )
+    assert type(unknown).__name__ == "ServingError"
+
+
+# --------------------------------------------------- validator placement
+
+
+def test_plan_serving_roofline_two_key_score():
+    """Synthetic fleet: prefill lands on the peak-TFLOPs worker, decode
+    on the peak-HBM one; ties break on the secondary key."""
+    fleet = {
+        "fast-chip": {
+            "serving_mode": "colocated", "peak_tflops": 900.0,
+            "hbm_gbps": 100.0, "kv_blocks_free": 50,
+        },
+        "fat-pipe": {
+            "serving_mode": "colocated", "peak_tflops": 100.0,
+            "hbm_gbps": 1200.0, "kv_blocks_free": 50,
+        },
+        "idle-cpu": {
+            "serving_mode": "colocated", "peak_tflops": 1.0,
+            "hbm_gbps": 1.0, "kv_blocks_free": 50,
+        },
+    }
+    plan = plan_serving(fleet)
+    assert plan == {
+        "colocated": False, "prefill": "fast-chip", "decode": "fat-pipe",
+    }
+    # dedicated modes constrain the pools: with fast-chip advertising
+    # decode-only, BOTH legs now rank fat-pipe first (prefill pool
+    # loses fast-chip; decode ranks HBM first) — same node on both
+    # legs degrades to colocated there rather than paying a wire hop
+    # for nothing
+    fleet["fast-chip"]["serving_mode"] = "decode"
+    assert plan_serving(fleet) == {"colocated": True, "node": "fat-pipe"}
+    # a dedicated prefill peer beside it splits the legs again
+    fleet["fast-chip"]["serving_mode"] = "prefill"
+    assert plan_serving(fleet) == {
+        "colocated": False, "prefill": "fast-chip", "decode": "fat-pipe",
+    }
+
+
+def test_plan_serving_modes_headroom_and_degradation():
+    # same node winning both legs degrades to colocated
+    one = {"w": {"serving_mode": "colocated", "peak_tflops": 5.0,
+                 "hbm_gbps": 5.0}}
+    assert plan_serving(one) == {"colocated": True, "node": "w"}
+    # headroom gate: a starved decode worker is ineligible
+    fleet = {
+        "pre": {"serving_mode": "prefill", "peak_tflops": 100.0,
+                "hbm_gbps": 10.0, "kv_blocks_free": 40},
+        "dec": {"serving_mode": "decode", "peak_tflops": 10.0,
+                "hbm_gbps": 500.0, "kv_blocks_free": 2},
+        "colo": {"serving_mode": "colocated", "peak_tflops": 1.0,
+                 "hbm_gbps": 1.0, "kv_blocks_free": 40},
+    }
+    split = plan_serving(fleet, need_blocks=4)
+    assert split == {"colocated": False, "prefill": "pre",
+                     "decode": "colo"}
+    # need_tokens converts per candidate through its OWN advertised
+    # block size: 20 tokens = 5 of dec's size-4 blocks (> 2 free ->
+    # ineligible) but only 2 of colo's size-16 blocks (eligible)
+    for nid, bs in (("pre", 4), ("dec", 4), ("colo", 16)):
+        fleet[nid]["kv_block_size"] = bs
+    assert plan_serving(fleet, need_tokens=20) == {
+        "colocated": False, "prefill": "pre", "decode": "colo",
+    }
+    # with headroom for everyone the split lands on the HBM worker
+    assert plan_serving(fleet, need_tokens=8) == {
+        "colocated": False, "prefill": "pre", "decode": "dec",
+    }
+    # nothing advertises serving at all -> unplaceable
+    assert plan_serving({"x": {"peak_tflops": 1.0}}) is None
+    # a lone single-leg worker still serves (mode is a preference)
+    assert plan_serving(
+        {"pre": {"serving_mode": "prefill"}}
+    ) == {"colocated": True, "node": "pre"}
+    # deterministic two-key orders
+    assert roofline_score({"peak_tflops": 2, "hbm_gbps": 3}, "prefill") \
+        == (2.0, 3.0)
+    assert roofline_score({"peak_tflops": 2, "hbm_gbps": 3}, "decode") \
+        == (3.0, 2.0)
+
+
+# ------------------------------------------------------- two-node roles
+
+
+def _cfg(role):
+    return NodeConfig(role=role, host="127.0.0.1", port=0)
+
+
+async def _fleet(tiny, gen):
+    """validator + prefill worker + decode worker + user, capabilities
+    harvested into the validator's fleet table."""
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    val = ValidatorNode(_cfg("validator"))
+    wp = WorkerNode(_cfg("worker"))
+    wd = WorkerNode(_cfg("worker"))
+    user = UserNode(_cfg("user"))
+    for n in (val, wp, wd, user):
+        await n.start()
+    kw = dict(slots=2, gen=gen, decode_chunk=3, block_size=4)
+    wp.serving_engine(_engine(tiny), paged=True, mode="prefill", **kw)
+    wd.serving_engine(_engine(tiny), paged=True, mode="decode", **kw)
+    wp.capability = {"peak_tflops": 400.0, "hbm_gbps": 50.0}
+    wd.capability = {"peak_tflops": 40.0, "hbm_gbps": 800.0}
+    for w in (wp, wd):
+        peer = await val.connect("127.0.0.1", w.port)
+        await val.ping(peer)  # harvest the capability record
+    vpeer = await user.connect("127.0.0.1", val.port)
+    return val, wp, wd, user, vpeer
+
+
+@pytest.mark.asyncio
+async def test_two_node_disagg_request_end_to_end(tiny):
+    """THE acceptance scenario: one user-facing request whose prefill
+    and decode demonstrably ran on different nodes — KV blocks crossed
+    the wire (kv_wire_bytes_total > 0 on BOTH legs), output is
+    token-identical to colocated serving, and the prefill -> transfer
+    -> decode spans stitch into one trace."""
+    cfg = tiny[0]
+    gen = GenerationConfig(max_new_tokens=6)
+    prompt = _prompts(cfg, (7,))[0]
+    colo = _paged(tiny)
+    ref = colo.result(colo.submit(prompt))
+    val, wp, wd, user, vpeer = await _fleet(tiny, gen)
+    try:
+        client = user.remote_serving(vpeer)
+        rid = await client.submit(prompt)
+        # a soft result() poll timeout is NOT leg death: the remote
+        # engine's typed TimeoutError (still running, collect later)
+        # re-raises as-is — it must not trip the dead-decode fallback
+        # into a duplicate colocated re-submit (TimeoutError subclasses
+        # OSError, exactly the transport-error clause's bait)
+        with pytest.raises(TimeoutError):
+            await client.result(rid, timeout_s=0.0)
+        assert not any(
+            e.get("kind") == "serving.disagg_fallback"
+            for e in user.flight.events()
+        )
+        out = await client.result(rid)
+        np.testing.assert_array_equal(out, ref)
+        # the roofline placement: prefill on the TFLOPs worker, decode
+        # on the HBM worker — and the blocks actually moved
+        assert wp.serving.disagg["exports"] == 1
+        assert wd.serving.disagg["imports"] == 1
+        for w in (wp, wd):
+            counters = w.metrics.snapshot()["counters"]
+            assert counters.get("kv_wire_bytes_total", 0) > 0
+            assert counters.get("kv_wire_transfers_total", 0) == 1
+        # one stitched trace across all three parties
+        tid = next(
+            s.trace_id for s in user.tracer.spans()
+            if s.name == "serving.disagg_request"
+        )
+        wp_names = {
+            s.name for s in wp.tracer.spans() if s.trace_id == tid
+        }
+        wd_names = {
+            s.name for s in wd.tracer.spans() if s.trace_id == tid
+        }
+        assert {"serving.prefill_leg", "serving.kv_transfer"} <= wp_names
+        assert "serving.kv_import" in wd_names
+        user_names = {
+            s.name for s in user.tracer.spans() if s.trace_id == tid
+        }
+        assert {"serving.leg.plan", "serving.leg.prefill",
+                "serving.leg.decode"} <= user_names
+        # served at /spans: the span buffer IS the HTTP payload source
+        assert any(
+            s.trace_id == tid for s in user.tracer.spans()
+        )
+        # the worker capability records advertised the legs
+        fleet = val.status()["fleet"]
+        assert {r["serving_mode"] for r in fleet.values()} == {
+            "prefill", "decode",
+        }
+        assert all("kv_blocks_free" in r for r in fleet.values())
+    finally:
+        for n in (user, val, wp, wd):
+            await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_dead_decode_leg_falls_back_colocated(tiny):
+    """Leg-failure semantics, both windows: (a) decode dies BEFORE the
+    transfer — the prefill worker detects it in ms (fail-fast p2p),
+    serves the request colocated on itself, and records
+    serving.disagg_fallback; (b) decode dies AFTER import, mid-request
+    — the user's result() fails over to the surviving prefill worker,
+    token-identical."""
+    cfg = tiny[0]
+    gen = GenerationConfig(max_new_tokens=6)
+    prompt = _prompts(cfg, (7,))[0]
+    colo = _paged(tiny)
+    ref = colo.result(colo.submit(prompt))
+    val, wp, wd, user, vpeer = await _fleet(tiny, gen)
+    try:
+        client = user.remote_serving(vpeer)
+        # (a) transfer-time death: point the prefill worker at a dead
+        # decode target directly (the validator would need a heartbeat
+        # round to notice; the leg must not wait for one)
+        wpeer = await user.connect("127.0.0.1", wp.port)
+        resp = await user.request(
+            wpeer,
+            {
+                "type": "SERVE_PREFILL",
+                "ids": [int(t) for t in prompt],
+                "seed": 0,
+                "priority": "standard",
+                "decode": {
+                    "node_id": "f" * 64, "host": "127.0.0.1",
+                    "port": 1,  # nothing listens here
+                },
+            },
+            timeout=30.0,
+        )
+        assert resp["type"] == "SERVE_PREFILLED"
+        assert resp["fallback"] == "colocated"
+        tok = await user.request(
+            wpeer,
+            {"type": "SERVE_RESULT", "rid": resp["rid"],
+             "timeout_s": 60.0},
+            timeout=90.0,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(tok["tokens"], np.int32), ref
+        )
+        assert any(
+            e.get("kind") == "serving.disagg_fallback"
+            for e in wp.flight.events()
+        )
+        assert wp.serving.disagg["fallbacks"] == 1
+        # (b) mid-request death: plan + import succeed, then the decode
+        # worker dies before result() — the user fails over
+        rid = await client.submit(prompt)
+        await wd.stop()
+        # a soft-timeout poll mid-failover: the dead leg triggers ONE
+        # colocated fallback submit; its still-running stream raises
+        # the typed TimeoutError and the handle must now point at the
+        # LIVE fallback stream — the re-poll below drives it instead
+        # of dialing the dead peer into a second duplicate submit
+        with pytest.raises(TimeoutError):
+            await client.result(rid, timeout_s=0.0)
+        out = await client.result(rid)
+        np.testing.assert_array_equal(out, ref)
+        assert any(
+            e.get("kind") == "serving.disagg_fallback"
+            for e in user.flight.events()
+        )
+        assert user.metrics.snapshot()["counters"][
+            "serving_disagg_fallback_total"
+        ] == 1
+    finally:
+        for n in (user, val, wp):
+            await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_unaffordable_transfer_estimate_skips_the_hop(tiny):
+    """End-to-end deadlines charge the wire: a prefill worker whose
+    measured transfer EWMA alone exhausts the remaining budget never
+    attempts the hop — it serves colocated on the just-warmed prefix
+    immediately, naming the estimate in the fallback reason."""
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    cfg = tiny[0]
+    gen = GenerationConfig(max_new_tokens=6)
+    prompt = _prompts(cfg, (7,))[0]
+    colo = _paged(tiny)
+    ref = colo.result(colo.submit(prompt))
+    w = WorkerNode(_cfg("worker"))
+    user = UserNode(_cfg("user"))
+    for n in (w, user):
+        await n.start()
+    try:
+        w.serving_engine(
+            _engine(tiny), paged=True, mode="prefill",
+            slots=2, gen=gen, decode_chunk=3, block_size=4,
+        )
+        w.serving.note_disagg_transfer(wire_s=3600.0)  # measured, huge
+        peer = await user.connect("127.0.0.1", w.port)
+        resp = await user.request(
+            peer,
+            {
+                "type": "SERVE_PREFILL",
+                "ids": [int(t) for t in prompt],
+                "seed": 0, "priority": "standard", "deadline_s": 60.0,
+                # a live-looking target it must NOT even dial
+                "decode": {"node_id": "f" * 64, "host": "127.0.0.1",
+                           "port": 1},
+            },
+            timeout=90.0,
+        )
+        assert resp["type"] == "SERVE_PREFILLED"
+        assert resp["fallback"] == "colocated"
+        assert "transfer EWMA" in resp["reason"]
+        tok = await user.request(
+            peer,
+            {"type": "SERVE_RESULT", "rid": resp["rid"],
+             "timeout_s": 60.0},
+            timeout=90.0,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(tok["tokens"], np.int32), ref
+        )
+    finally:
+        for n in (user, w):
+            await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_failed_kv_send_not_counted():
+    """kv_wire_* answer 'did the payload cross' (the acceptance
+    criterion reads them on both legs): a send that dies on a dead
+    decode peer must not inflate the sender-leg counters."""
+    from tensorlink_tpu.p2p.node import Node
+
+    a = Node(_cfg("worker"))
+    b = Node(_cfg("worker"))
+    await a.start()
+    await b.start()
+    try:
+        peer = await a.connect("127.0.0.1", b.port)
+        await b.stop()
+        with pytest.raises(
+            (ConnectionError, OSError, asyncio.TimeoutError)
+        ):
+            await a.send_kv_blocks(peer, b"x" * 64, {}, timeout=2.0)
+        counters = a.metrics.snapshot()["counters"]
+        assert counters.get("kv_wire_bytes_total", 0) == 0
+        assert counters.get("kv_wire_transfers_total", 0) == 0
+    finally:
+        await a.stop()
+
+
+@pytest.mark.asyncio
+async def test_single_worker_fleet_plans_colocated(tiny):
+    """Only one serving worker live -> the validator plans colocated
+    there and the request still completes through the same client."""
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    cfg = tiny[0]
+    gen = GenerationConfig(max_new_tokens=6)
+    prompt = _prompts(cfg, (7,))[0]
+    colo = _paged(tiny)
+    ref = colo.result(colo.submit(prompt))
+    val = ValidatorNode(_cfg("validator"))
+    w = WorkerNode(_cfg("worker"))
+    user = UserNode(_cfg("user"))
+    live = [user, val, w]
+    for n in (val, w, user):
+        await n.start()
+    try:
+        w.serving_engine(
+            _engine(tiny), paged=True, mode="colocated",
+            slots=2, gen=gen, decode_chunk=3, block_size=4,
+        )
+        peer = await val.connect("127.0.0.1", w.port)
+        await val.ping(peer)
+        client = user.remote_serving(
+            await user.connect("127.0.0.1", val.port)
+        )
+        rid = await client.submit(prompt)
+        out = await client.result(rid)
+        np.testing.assert_array_equal(out, ref)
+        assert w.serving.disagg["exports"] == 0  # nothing crossed a wire
+        # terminal failure drops the handle: a colocated placement has
+        # no fallback leg, so a dead worker fails the request for good
+        # and a re-poll raises KeyError instead of re-dialing the dead
+        # peer (the handle must not leak on a long-lived client)
+        rid2 = await client.submit(prompt)
+        await w.stop()
+        live.remove(w)
+        with pytest.raises(ServingError):
+            await client.result(rid2)
+        with pytest.raises(KeyError):
+            await client.result(rid2)
+    finally:
+        for n in live:
+            await n.stop()

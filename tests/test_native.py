@@ -98,3 +98,122 @@ async def test_framed_stream_integrity_roundtrip_and_corruption():
     srv.close()
     server.close()
     await server.wait_closed()
+
+
+def test_gather_odd_lengths_and_zero_length_buffers():
+    """KV-block payload shapes are rarely 8-byte aligned: int8 blocks
+    with odd byte counts and empty tail buffers must gather and
+    checksum exactly like a straight concat."""
+    r = np.random.default_rng(3)
+    arrs = [
+        r.integers(-128, 127, (3, 7), np.int8),     # 21 bytes (odd)
+        np.zeros((0, 16, 2, 4), np.int8),           # zero-length tail
+        r.integers(-128, 127, (1,), np.int8),       # single byte
+        r.normal(size=(2, 16, 2, 4)).astype(np.float32),
+    ]
+    blob, crc = native.gather(arrs)
+    ref = b"".join(np.ascontiguousarray(a).tobytes() for a in arrs)
+    assert bytes(blob) == ref
+    assert crc == native.crc32c(ref)
+    # empty gather: zero bytes, CRC of the empty string
+    blob0, crc0 = native.gather([])
+    assert bytes(blob0) == b"" and crc0 == native.crc32c(b"")
+
+
+def _block_payload(dtype, nblk=3, bs=4, hkv=2, d=5):
+    """A KV-wire-shaped payload: per-layer [n_blocks, bs, Hkv, D]
+    block stacks (d=5 makes bf16 rows 10 bytes — never 8-aligned)."""
+    import ml_dtypes
+
+    r = np.random.default_rng(7)
+    dt = np.dtype(ml_dtypes.bfloat16) if dtype == "bfloat16" else np.dtype(dtype)
+    def blk(shape):
+        return r.normal(size=shape).astype(np.float32).astype(dt) \
+            if dtype == "bfloat16" else \
+            r.integers(-100, 100, shape).astype(dt)
+    return {
+        "prompt_ids": r.integers(0, 1000, (nblk * bs - 1,)).astype(np.int32),
+        "n_valid": nblk * bs - 1,
+        "tok0": 17,
+        "seed": 5,
+        "remaining": 9,
+        "block_size": bs,
+        "layers": [
+            {"k": blk((nblk, bs, hkv, d)), "v": blk((nblk, bs, hkv, d))}
+            for _ in range(2)
+        ],
+    }
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "int8"])
+def test_kv_block_payload_roundtrip_through_crc_framed_gather(dtype):
+    """bf16 and int8 KV-block stacks survive the pack (native gather +
+    CRC + zstd) byte-identically: dtype by NAME, odd row sizes, and the
+    scalar metadata all covered by the one checksum."""
+    from tensorlink_tpu.parallel.kvwire import (
+        pack_kv_payload,
+        unpack_kv_payload,
+    )
+
+    payload = _block_payload(dtype)
+    got = unpack_kv_payload(pack_kv_payload(payload))
+    assert got["n_valid"] == payload["n_valid"]
+    assert got["tok0"] == 17 and got["seed"] == 5 and got["remaining"] == 9
+    assert got["block_size"] == payload["block_size"]
+    np.testing.assert_array_equal(got["prompt_ids"], payload["prompt_ids"])
+    assert len(got["layers"]) == 2
+    for a, b in zip(got["layers"], payload["layers"]):
+        for kv in ("k", "v"):
+            assert a[kv].dtype == b[kv].dtype
+            np.testing.assert_array_equal(
+                a[kv].view(np.uint8), b[kv].view(np.uint8)
+            )
+
+
+def test_kv_block_payload_zero_length_tail_block():
+    """A payload whose last layer carries a zero-row block stack (the
+    degenerate empty-tail case) round-trips instead of corrupting
+    offsets for the tensors after it."""
+    from tensorlink_tpu.parallel.kvwire import (
+        pack_kv_payload,
+        unpack_kv_payload,
+    )
+
+    payload = _block_payload("int8")
+    payload["layers"].append({
+        "k": np.zeros((0, 4, 2, 5), np.int8),
+        "v": np.zeros((0, 4, 2, 5), np.int8),
+    })
+    got = unpack_kv_payload(pack_kv_payload(payload))
+    assert got["layers"][-1]["k"].shape == (0, 4, 2, 5)
+    np.testing.assert_array_equal(
+        got["layers"][0]["k"], payload["layers"][0]["k"]
+    )
+
+
+def test_kv_block_payload_corrupted_crc_rejected():
+    """A flipped byte anywhere in the framed blob must raise before the
+    receiver grafts anything into its pool."""
+    from tensorlink_tpu.parallel.kvwire import (
+        pack_kv_payload,
+        unpack_kv_payload,
+    )
+
+    blob = bytearray(pack_kv_payload(_block_payload("int8"), codec="none"))
+    blob[-5] ^= 0x40
+    with pytest.raises(ValueError, match="CRC-32C"):
+        unpack_kv_payload(bytes(blob))
+
+
+def test_kv_wire_schema_gate():
+    """An incompatible schema stamp is a typed rejection, not a
+    misread payload."""
+    from tensorlink_tpu.parallel.kvwire import (
+        flatten_kv_payload,
+        unflatten_kv_payload,
+    )
+
+    flat = flatten_kv_payload(_block_payload("int8"))
+    flat["schema"] = np.asarray(99, np.int64)
+    with pytest.raises(ValueError, match="schema"):
+        unflatten_kv_payload(flat)
