@@ -790,6 +790,147 @@ def serving_under_load_round() -> dict:
     return o
 
 
+def observability_round() -> dict:
+    """Telemetry cost round (ISSUE 16): the same loaded serving
+    traffic pumped with the FULL observability stack on (metrics +
+    ring-buffer sampler + SLO alert evaluation at 10 Hz — ten times
+    the production 1 Hz cadence, so the reported fraction is an upper
+    bound) vs metrics-only, plus the wall cost of one validator
+    ``GET /fleet`` poll over a populated 3-node fleet table. Both keys
+    are lower-better (``tldiag bench-diff`` classifies them from the
+    ``overhead_frac`` / ``_s`` suffixes)."""
+    import asyncio
+    import threading
+    from types import SimpleNamespace
+
+    from tensorlink_tpu.config import MeshConfig
+    from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config
+    from tensorlink_tpu.parallel.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from tensorlink_tpu.parallel.serving import (
+        PagedContinuousBatchingEngine,
+    )
+    from tensorlink_tpu.runtime.alerts import AlertEngine, default_rules
+    from tensorlink_tpu.runtime.http_status import StatusServer
+    from tensorlink_tpu.runtime.mesh import make_mesh
+    from tensorlink_tpu.runtime.metrics import Metrics
+    from tensorlink_tpu.runtime.timeseries import (
+        FleetStore,
+        TimeSeriesStore,
+    )
+
+    P_, N_, SLOTS, NREQ, REPS = 32, 32, 8, 24, 3
+    SAMPLE_S = 0.1  # 10x the production timeseries_interval_s default
+    ocfg = GPT2Config(qkv_fused=True)
+    omodel = GPT2(ocfg)
+    oeng = InferenceEngine(
+        make_mesh(MeshConfig()), omodel, omodel.init(jax.random.key(0)),
+        max_len=256,
+    )
+    gen = GenerationConfig(max_new_tokens=N_)
+    prompts = np.random.default_rng(11).integers(
+        0, ocfg.vocab_size, (NREQ, P_)
+    )
+
+    def run_once(with_ts: bool) -> float:
+        m = Metrics()
+        sch = PagedContinuousBatchingEngine(
+            oeng, slots=SLOTS, gen=gen, decode_chunk=8, block_size=16,
+            prefill_chunk=32, max_queue=NREQ, prefix_cache=True,
+            metrics=m, warm_buckets=True,
+        )
+        stop = threading.Event()
+        sampler = None
+        if with_ts:
+            ts = TimeSeriesStore()
+            alert_eng = AlertEngine(default_rules(), metrics=m)
+
+            def loop() -> None:
+                while not stop.wait(SAMPLE_S):
+                    ts.sample_metrics(m)
+                    sch.kv_stats_summary()
+                    alert_eng.evaluate(ts)
+
+            sampler = threading.Thread(target=loop, daemon=True)
+            sampler.start()
+        t0 = time.perf_counter()
+        rids = [sch.submit(p_) for p_ in prompts]
+        sch.run_until_idle()
+        ntok = sum(len(sch.result(r_)) for r_ in rids)
+        dt = time.perf_counter() - t0
+        stop.set()
+        if sampler is not None:
+            sampler.join(timeout=2.0)
+        return ntok / dt
+
+    run_once(False)  # warm the buckets once for both arms
+    # interleave the arms so drift (thermal, page cache) hits both
+    tps_on = max(run_once(True) for _ in range(REPS))
+    tps_off = max(run_once(False) for _ in range(REPS))
+    o: dict = {
+        "observability_overhead_frac": round(
+            max(1.0 - tps_on / tps_off, 0.0), 4
+        ),
+    }
+
+    # one validator /fleet poll over a 3-node fleet table populated to
+    # the heartbeat-delta clamps (the realistic steady-state size)
+    fs = FleetStore()
+    base_t = time.time() - 600.0
+    names = [
+        "serving_ttft_s.p99", "serving_tpot_s.p99", "serving_ttft_s.count",
+        "kv_pool_utilization", "kv_blocks_in_use", "serving_requests_total",
+        "serving_shed_total", "host_gap_frac",
+    ]
+    for nid in ("node-a", "node-b", "node-c"):
+        for lo in range(0, 600, 20):  # <= 160 points per delta (clamp)
+            delta = {
+                "t": base_t + lo,
+                "series": {
+                    name: {
+                        "kind": "counter" if name.endswith("_total")
+                        or name.endswith(".count") else "gauge",
+                        "points": [
+                            [base_t + lo + k, float((lo + k) % 97)]
+                            for k in range(20)
+                        ],
+                    }
+                    for name in names
+                },
+            }
+            fs.ingest(nid, delta, kv={"occupancy": 0.5, "chains": 4})
+
+    async def poll() -> float:
+        from tensorlink_tpu.diag import http_get
+
+        server = StatusServer(
+            SimpleNamespace(fleet_series=fs), "127.0.0.1", 0
+        )
+        await server.start()
+        try:
+            port = server.bound_port
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                status, body = await http_get("127.0.0.1", port, "/fleet")
+                dt = time.perf_counter() - t0
+                assert status == 200 and body
+                best = min(best, dt)
+            return best
+        finally:
+            await server.stop()
+
+    o["fleet_scrape_s"] = round(asyncio.run(poll()), 5)
+    o["observability_config"] = (
+        f"GPT-2 small bf16 paged, {NREQ} reqs (P{P_} N{N_}) over "
+        f"{SLOTS} slots; sampler+alerts at {SAMPLE_S}s vs off, best of "
+        f"{REPS}; /fleet poll over 3 nodes x {len(names)} series x 600s"
+    )
+    return o
+
+
 def main() -> None:
     devices = backend_with_retry()
     device_kind = devices[0].device_kind
@@ -1486,6 +1627,15 @@ def main() -> None:
             out.update(serving_disagg_round())
         except Exception as e:  # noqa: BLE001 — must not sink the headline
             out["serving_disagg_error"] = str(e)[:200]
+
+    # -- observability cost (ISSUE 16): what the always-on ring
+    # sampler + alert evaluation charges a loaded serving run, and the
+    # cost of one validator /fleet poll over a 3-node fleet table.
+    if os.environ.get("BENCH_OBS", "1") == "1" and _BERT == "base":
+        try:
+            out.update(observability_round())
+        except Exception as e:  # noqa: BLE001 — must not sink the headline
+            out["observability_error"] = str(e)[:200]
 
     # -- int8 end-to-end quality (VERDICT #8): logit KL between bf16 and
     # int8 weight-only GPT-2 small on a fixed eval batch. The number the

@@ -103,6 +103,14 @@ def main() -> None:
                     help="persistent tuning store (runtime/autotune.py)"
                          ": flash blocks, prefill buckets, and the "
                          "learned K prior reload here across restarts")
+    ap.add_argument("--slo", default=None, metavar="SLO_JSON",
+                    help="SLO rule file (see examples/slo.json): the "
+                         "run samples its own metrics into ring-buffer "
+                         "time-series and prints the LIVE burn-rate "
+                         "per rule (observed vs target) plus any "
+                         "firing alerts after every finished request — "
+                         "the same rules `tldiag check`/a node's "
+                         "alert engine evaluate")
     ap.add_argument("--profile-dir", default=None,
                     help="capture the whole serving run under "
                          "jax.profiler into this directory (open with "
@@ -205,6 +213,46 @@ def main() -> None:
         spec_kw["autotune_dir"] = args.autotune_dir
     if args.max_queue is not None:
         spec_kw["max_queue"] = args.max_queue
+
+    slo_mon = None
+    if args.slo:
+        from tensorlink_tpu.runtime.alerts import (
+            AlertEngine,
+            evaluate_rule,
+            load_rules,
+        )
+        from tensorlink_tpu.runtime.metrics import Metrics
+        from tensorlink_tpu.runtime.timeseries import TimeSeriesStore
+
+        slo_rules = load_rules(args.slo)
+        slo_store = TimeSeriesStore()
+        slo_engine = AlertEngine(slo_rules)
+        spec_kw["metrics"] = slo_metrics = Metrics()
+
+        def slo_mon(sch):
+            """One sampler tick + live burn-rate line: what a node's
+            _timeseries_loop does every second, printed inline."""
+            slo_store.sample_metrics(slo_metrics)
+            slo_engine.evaluate(slo_store)
+            parts = []
+            for r in slo_rules:
+                if r.kind not in ("latency", "budget_burn"):
+                    continue
+                v = evaluate_rule(r, slo_store).value
+                if v is None:
+                    continue  # no traffic in this class yet
+                tgt = (
+                    r.target if r.kind == "latency"
+                    else r.budget_frac * r.burn_factor
+                )
+                parts.append(f"{r.name}={v:.4g}/{tgt:g}")
+            firing = ",".join(
+                a["name"] for a in slo_engine.active()
+            ) or "none"
+            print(
+                f"  slo burn (observed/target): "
+                f"{' '.join(parts) or '(no data yet)'}  firing={firing}"
+            )
 
     def submit_all(sch, prompt_list):
         """Submit with the chosen SLO class/deadline; a shed request
@@ -336,6 +384,8 @@ def main() -> None:
         ktraj = []
         for rid in rids:
             print_result(sch, rid)
+            if slo_mon is not None:
+                slo_mon(sch)
             sp = sch.stats().get("spec") or {}
             if sp.get("adaptive"):
                 ktraj.append(sp["k_prior"]["k"])
@@ -369,6 +419,8 @@ def main() -> None:
         ktraj = []
         for rid in rids:
             print_result(sch, rid)
+            if slo_mon is not None:
+                slo_mon(sch)
             sp = sch.stats().get("spec") or {}
             if sp.get("adaptive"):
                 ktraj.append(sp["k_prior"]["k"])

@@ -233,6 +233,17 @@ class NodeConfig:
     # retained jax.profiler captures from GET /profile (None = parsed
     # and discarded per request)
     profile_dir: str | None = None
+    # on-node ring-buffer time-series (runtime/timeseries.py): every
+    # metric sampled at this cadence into the fixed-memory retention
+    # tiers behind GET /history, heartbeat deltas, and GET /fleet.
+    # False turns the sampler (and the heartbeat delta it feeds) off —
+    # the toggle the observability-overhead bench flips.
+    timeseries_enabled: bool = True
+    timeseries_interval_s: float = 1.0
+    # SLO alert rules (runtime/alerts.py): path to an slo.json rule
+    # file; None = the default rule set (host-bound / kv-pressure /
+    # heartbeat-stale, no latency targets)
+    slo_path: str | None = None
 
     def __post_init__(self):
         # wire serialization (msgpack/json) round-trips tuples as lists;

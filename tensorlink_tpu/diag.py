@@ -36,9 +36,10 @@ import time
 from typing import Any
 
 # every node serves these (http_status.py); /jobs exists only on
-# validators and is fetched opportunistically
+# validators, /kv only on paged serving nodes, /history and /fleet only
+# when the time-series sampler is on — all fetched opportunistically
 ROUTES = ("/healthz", "/metrics", "/metrics?format=prom", "/spans",
-          "/events", "/node", "/jobs")
+          "/events", "/node", "/jobs", "/history", "/kv", "/fleet")
 
 
 # ------------------------------------------------------------- scraping
@@ -265,6 +266,16 @@ def node_row(
         row["bubble_pct"] = round(float(gap) * 100, 1)
         if float(gap) > 0.3:
             row["flags"].append(f"HOST-BOUND({float(gap):.2f})")
+    alerts = node.get("alerts") or {}
+    firing = (alerts.get("own") or []) + (alerts.get("fleet") or [])
+    if firing:
+        # SLO burn-rate alerting (runtime/alerts.py): the node itself
+        # says which budgets are burning — name the worst offender
+        worst = max(
+            firing,
+            key=lambda a: (a.get("severity") == "error", a.get("name", "")),
+        )
+        row["flags"].append(f"ALERTS({len(firing)}:{worst.get('name')})")
     metrics = _route_body(scrape, "/metrics") or {}
     counters = metrics.get("counters") or {}
     row["anomalies"] = {
@@ -713,6 +724,284 @@ def render_profile(rec: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# ----------------------------------------------- fleet watch / history
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: list[float], width: int = 32) -> str:
+    """Unicode sparkline over the LAST ``width`` points, scaled to the
+    visible min/max (a flat series renders as a flat low bar)."""
+    vs = [float(v) for v in values][-width:]
+    if not vs:
+        return ""
+    lo, hi = min(vs), max(vs)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vs)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1, int((v - lo) / span * len(_SPARK)))]
+        for v in vs
+    )
+
+
+# the dashboard's default panel: one sparkline per series per frame
+WATCH_SERIES = (
+    "serving_ttft_s.p99", "serving_tpot_s.p99",
+    "kv_pool_utilization", "serving_requests_total",
+)
+
+
+async def fetch_fleet_frame(
+    target: str,
+    series: tuple[str, ...] = WATCH_SERIES,
+    window_s: float = 120.0,
+    timeout: float = 5.0,
+) -> dict[str, Any]:
+    """One dashboard frame: the /fleet summary plus a rolled query per
+    watched series (only those the fleet has actually seen)."""
+    host, port = parse_target(target)
+    frame: dict[str, Any] = {"target": target, "t": time.time()}
+    status, body = await http_get(host, port, "/fleet", timeout)
+    if status != 200:
+        raise ConnectionError(f"/fleet -> HTTP {status}")
+    summary = json.loads(body)
+    frame["summary"] = summary
+    known = set(summary.get("series") or [])
+    since = time.time() - window_s
+    frame["queries"] = {}
+    for name in series:
+        if name not in known:
+            continue
+        _, qbody = await http_get(
+            host, port, f"/fleet?series={name}&since={since}", timeout
+        )
+        try:
+            frame["queries"][name] = json.loads(qbody)
+        except ValueError:
+            continue
+    return frame
+
+
+def render_watch(frame: dict[str, Any]) -> str:
+    """One ANSI-free dashboard frame (the caller adds clear-screen):
+    fleet sparklines, per-node last values + KV residency, active
+    alerts."""
+    summary = frame.get("summary") or {}
+    nodes = summary.get("nodes") or {}
+    when = time.strftime("%H:%M:%S", time.localtime(frame.get("t")))
+    lines = [
+        f"tldiag watch {frame.get('target')}  {when}  "
+        f"{len(nodes)} node(s) reporting"
+    ]
+    queries = frame.get("queries") or {}
+    if queries:
+        lines.append("")
+        namew = max(len(n) for n in queries)
+        for name, q in queries.items():
+            pts = q.get("fleet") or []
+            vals = [p[1] for p in pts]
+            last = f"{vals[-1]:g}" if vals else "-"
+            lines.append(
+                f"  {name.ljust(namew)}  {sparkline(vals):32s}  {last}"
+            )
+    if nodes:
+        lines.append("")
+        lines.append(
+            "  NODE              AGE-S   KV-OCC  FRAG    CHAINS  SERIES"
+        )
+        for nid, rec in sorted(nodes.items()):
+            kv = rec.get("kv") or {}
+            age = rec.get("last_seen_age_s")
+            lines.append(
+                "  {:<16s}  {:<6s}  {:<6s}  {:<6s}  {:<6s}  {}".format(
+                    nid[:16],
+                    "-" if age is None else f"{age:.1f}",
+                    "-" if "occupancy" not in kv
+                    else f"{kv['occupancy']:.2f}",
+                    "-" if "fragmentation" not in kv
+                    else f"{kv['fragmentation']:.2f}",
+                    "-" if "chains" not in kv else str(kv["chains"]),
+                    len(rec.get("series") or []),
+                )
+            )
+    alerts = summary.get("alerts") or {}
+    firing = (alerts.get("own") or []) + (alerts.get("fleet") or [])
+    lines.append("")
+    if firing:
+        lines.append(f"  ACTIVE ALERTS ({len(firing)}):")
+        for a in firing:
+            lines.append(
+                f"    [{a.get('severity', '?'):5s}] {a.get('name')}: "
+                f"{a.get('detail', '')}"
+            )
+    else:
+        lines.append("  no active alerts")
+    return "\n".join(lines)
+
+
+async def watch_loop(
+    target: str,
+    interval: float = 2.0,
+    iterations: int | None = None,
+    series: tuple[str, ...] = WATCH_SERIES,
+    out=None,
+) -> int:
+    """Poll /fleet and redraw. A TTY gets an ANSI clear per frame; a
+    pipe (or --once) gets plain frames, newline-separated — the same
+    renderer, so tests and terminals see identical content."""
+    out = out or sys.stdout
+    live = iterations is None and out.isatty()
+    n = 0
+    while True:
+        try:
+            frame = await fetch_fleet_frame(target, series)
+            text = render_watch(frame)
+        except (OSError, ConnectionError, asyncio.TimeoutError, ValueError) as e:
+            text = f"tldiag watch {target}: {type(e).__name__}: {e}"
+        if live:
+            out.write("\x1b[2J\x1b[H" + text + "\n")
+        else:
+            out.write(text + "\n")
+        out.flush()
+        n += 1
+        if iterations is not None and n >= iterations:
+            return 0
+        await asyncio.sleep(interval)
+
+
+async def fetch_history(
+    target: str,
+    series: str | None = None,
+    since: float | None = None,
+    step: float | None = None,
+    timeout: float = 5.0,
+) -> dict[str, Any]:
+    """GET /history from one node: the series catalog when ``series``
+    is None, else that series' ring contents."""
+    host, port = parse_target(target)
+    path = "/history"
+    if series:
+        path += f"?series={series}"
+        if since is not None:
+            path += f"&since={since}"
+        if step is not None:
+            path += f"&step={step}"
+    status, body = await http_get(host, port, path, timeout)
+    payload = json.loads(body) if body else {}
+    if status != 200:
+        raise ConnectionError(
+            f"/history -> HTTP {status}: {payload.get('error', '?')}"
+        )
+    return payload
+
+
+def render_history(payload: dict[str, Any]) -> str:
+    if "points" not in payload:  # catalog form
+        tiers = ", ".join(
+            f"{s:g}s x {n}" for s, n in payload.get("tiers") or []
+        )
+        lines = [f"retention tiers: {tiers}"]
+        lines += [f"  {name}" for name in payload.get("series") or []]
+        return "\n".join(lines)
+    pts = payload.get("points") or []
+    lines = [
+        f"{payload.get('series')} ({payload.get('kind')}, "
+        f"step {payload.get('step'):g}s, {len(pts)} point(s))"
+    ]
+    vals = [p[1] for p in pts]
+    if vals:
+        lines.append(f"  {sparkline(vals, width=64)}")
+    for t, v in pts:
+        when = time.strftime("%H:%M:%S", time.localtime(t))
+        lines.append(f"  {when}  {v:g}")
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------- SLO gate (CI)
+async def check_nodes(
+    targets: list[str],
+    slo: dict | str | None = None,
+    timeout: float = 5.0,
+) -> dict[str, Any]:
+    """Evaluate the SLO rule set against each node's served /history
+    rings — the CI gate behind ``tldiag check``. A node is judged on
+    ITS OWN recorded telemetry (scraped, rebuilt into a local store,
+    evaluated at the node's newest sample time so operator/node clock
+    skew cannot fake or mask a burn). Unreachable nodes and nodes
+    without /history FAIL — a gate that cannot see is not passing."""
+    from tensorlink_tpu.runtime.alerts import (
+        AlertEngine, default_rules, load_rules,
+    )
+    from tensorlink_tpu.runtime.timeseries import TimeSeriesStore
+
+    rules = load_rules(slo) if slo else default_rules()
+    needed = set()
+    for r in rules:
+        for name in (r.series, r.numerator, r.denominator):
+            if name:
+                needed.add(name)
+    out: dict[str, Any] = {"targets": list(targets), "nodes": {}, "firing": []}
+    for target in targets:
+        rec: dict[str, Any] = {"alerts": [], "error": None}
+        out["nodes"][target] = rec
+        try:
+            catalog = await fetch_history(target, timeout=timeout)
+            store = TimeSeriesStore()
+            newest = None
+            for name in sorted(needed & set(catalog.get("series") or [])):
+                q = await fetch_history(target, series=name, timeout=timeout)
+                kind = q.get("kind") or "gauge"
+                for t, v in q.get("points") or []:
+                    store.record(name, float(v), kind, now=float(t))
+                    if newest is None or t > newest:
+                        newest = t
+            engine = AlertEngine(rules)
+            alerts = engine.evaluate(store, now=newest)
+            rec["alerts"] = alerts
+            for a in alerts:
+                out["firing"].append({**a, "target": target})
+        except (OSError, ConnectionError, asyncio.TimeoutError, ValueError) as e:
+            rec["error"] = f"{type(e).__name__}: {e}"
+            out["firing"].append({
+                "name": f"unreachable@{target}", "target": target,
+                "severity": "error", "detail": rec["error"],
+            })
+    out["ok"] = not out["firing"]
+    return out
+
+
+def render_check(result: dict[str, Any], fmt: str = "text") -> str:
+    """``--format github`` emits workflow-command annotations — one
+    ``::error``/``::warning`` line per firing alert, which the Actions
+    runner turns into PR annotations; plain text otherwise."""
+    lines = []
+    if fmt == "github":
+        for a in result["firing"]:
+            level = "error" if a.get("severity") == "error" else "warning"
+            detail = str(a.get("detail", "")).replace("\n", " ")
+            lines.append(
+                f"::{level} title=SLO {a.get('name')} "
+                f"({a.get('target')})::{detail}"
+            )
+        if result["ok"]:
+            lines.append("::notice title=SLO check::all targets within SLO")
+        return "\n".join(lines)
+    for target, rec in result["nodes"].items():
+        if rec.get("error"):
+            lines.append(f"{target}: UNREACHABLE ({rec['error']})")
+        elif rec["alerts"]:
+            lines.append(f"{target}: {len(rec['alerts'])} alert(s) firing")
+            for a in rec["alerts"]:
+                lines.append(
+                    f"  [{a.get('severity', '?'):5s}] {a.get('name')}: "
+                    f"{a.get('detail', '')}"
+                )
+        else:
+            lines.append(f"{target}: ok")
+    lines.append("SLO check: " + ("PASS" if result["ok"] else "FAIL"))
+    return "\n".join(lines)
+
+
 # ------------------------------------------------------------------ CLI
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
@@ -766,6 +1055,46 @@ def main(argv: list[str] | None = None) -> int:
                          "measurement regresses (default 5%%)")
     md.add_argument("--json", action="store_true", dest="as_json",
                     help="print the full diff as JSON")
+    wa = sub.add_parser(
+        "watch",
+        help="live fleet dashboard: poll a validator's /fleet and "
+             "redraw sparklines, KV residency, and active alerts",
+    )
+    wa.add_argument("target", metavar="HOST:PORT",
+                    help="a node running the fleet rollup (validator)")
+    wa.add_argument("--interval", type=float, default=2.0)
+    wa.add_argument("--once", action="store_true",
+                    help="print one frame and exit (CI / pipes)")
+    wa.add_argument("--series", action="append", default=None,
+                    metavar="NAME",
+                    help="series to sparkline (repeatable; default: "
+                         "TTFT/TPOT p99, KV utilization, request rate)")
+    hi = sub.add_parser(
+        "history",
+        help="one node's on-board ring buffers (GET /history): the "
+             "series catalog, or one series' retained points",
+    )
+    hi.add_argument("target", metavar="HOST:PORT")
+    hi.add_argument("--series", default=None, metavar="NAME")
+    hi.add_argument("--since", type=float, default=None,
+                    help="unix time lower bound (default: whole ring)")
+    hi.add_argument("--step", type=float, default=None,
+                    help="preferred bucket seconds (picks the tier)")
+    hi.add_argument("--json", action="store_true", dest="as_json")
+    ck = sub.add_parser(
+        "check",
+        help="SLO gate: evaluate alert rules against each node's "
+             "/history rings; exit 1 if any alert fires",
+    )
+    ck.add_argument("targets", nargs="+", metavar="HOST:PORT")
+    ck.add_argument("--slo", default=None,
+                    help="SLO rule file (runtime/alerts.py compact or "
+                         "explicit form); default rule set if omitted")
+    ck.add_argument("--format", choices=("text", "github"),
+                    default="text",
+                    help="github: ::error/::warning workflow-command "
+                         "annotations for Actions")
+    ck.add_argument("--timeout", type=float, default=5.0)
     args = ap.parse_args(argv)
 
     if args.cmd == "scrape":
@@ -812,6 +1141,32 @@ def main(argv: list[str] | None = None) -> int:
             else render_manifest_diff(diff)
         )
         return 0
+    if args.cmd == "watch":
+        series = tuple(args.series) if args.series else WATCH_SERIES
+        try:
+            return asyncio.run(watch_loop(
+                args.target, args.interval,
+                iterations=1 if args.once else None, series=series,
+            ))
+        except KeyboardInterrupt:
+            return 0
+    if args.cmd == "history":
+        try:
+            payload = asyncio.run(fetch_history(
+                args.target, args.series, args.since, args.step,
+            ))
+        except (OSError, ConnectionError, asyncio.TimeoutError) as e:
+            print(f"{args.target}: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(payload) if args.as_json
+              else render_history(payload))
+        return 0
+    if args.cmd == "check":
+        result = asyncio.run(check_nodes(
+            args.targets, args.slo, timeout=args.timeout,
+        ))
+        print(render_check(result, args.format))
+        return 0 if result["ok"] else 1
     return 2  # pragma: no cover — argparse enforces the subcommands
 
 

@@ -122,6 +122,39 @@ class Node:
         # serves — the placement input ROADMAP item 1 consumes
         self.capability: dict | None = None
         self.peer_capabilities: dict[str, dict] = {}
+        from tensorlink_tpu.runtime.alerts import (
+            AlertEngine,
+            default_rules,
+            load_rules,
+        )
+        from tensorlink_tpu.runtime.timeseries import (
+            FleetStore,
+            TimeSeriesStore,
+        )
+
+        # bounded ring-buffer history of every metric (GET /history,
+        # postmortem rings, the heartbeat-delta source); None = off
+        # (the observability-overhead bench flips this)
+        self.timeseries = (
+            TimeSeriesStore() if cfg.timeseries_enabled else None
+        )
+        # per-peer rings rolled up from heartbeat-PONG metric deltas —
+        # populated on whichever node runs start_heartbeat (the
+        # validator in practice) and served at GET /fleet
+        self.fleet_series = FleetStore()
+        _rules = (
+            load_rules(cfg.slo_path) if cfg.slo_path else default_rules()
+        )
+        # own-SLO engine: firing alerts become health conditions (503);
+        # the fleet engine watches PEERS — their burn must not mark
+        # this node unready, so no health hookup there
+        self.alerts = AlertEngine(
+            _rules, recorder=self.flight, health=self.health,
+            metrics=self.metrics,
+        )
+        self.fleet_alerts = AlertEngine(
+            _rules, recorder=self.flight, metrics=self.metrics
+        )
         self.register_handlers()
 
     # ------------------------------------------------------------ lifecycle
@@ -174,6 +207,8 @@ class Node:
             self._restore_dht_snapshot()
             self._spawn(self._dht_snapshot_loop())
         self._spawn(self._health_loop())
+        if self.timeseries is not None:
+            self._spawn(self._timeseries_loop())
         self.started.set()
         self.flight.record(
             "node_started", host=self.cfg.host, port=self.port,
@@ -197,6 +232,34 @@ class Node:
             self.metrics.observe("event_loop_lag_s", self.health.loop_lag_s)
             self.health.check_watchdogs()
             sample_memory_watermarks(self.metrics)
+
+    async def _timeseries_loop(self) -> None:
+        """Ring sampler tick: fold every metric into the retention
+        tiers, refresh the KV residency gauges (a quiescent engine's
+        occupancy must not flatline at its last step's value), and
+        evaluate the SLO rules — own metrics into health conditions,
+        harvested peer rings into the fleet alert table."""
+        interval = self.cfg.timeseries_interval_s
+        while not self._stopping:
+            await asyncio.sleep(interval)
+            try:
+                self.timeseries.sample_metrics(self.metrics)
+                serving = getattr(self, "serving", None)
+                if serving is not None and hasattr(
+                    serving, "kv_stats_summary"
+                ):
+                    kv = serving.kv_stats_summary()
+                    for k in ("occupancy", "fragmentation", "chains"):
+                        if k in kv:
+                            self.timeseries.record(
+                                f"kv_{k}", kv[k], "gauge"
+                            )
+                self.alerts.evaluate(self.timeseries)
+                if self.fleet_series.nodes():
+                    self.fleet_alerts.evaluate_fleet(self.fleet_series)
+            except Exception as e:  # noqa: BLE001 — telemetry must
+                # never kill the node; one bad tick is one lost sample
+                self.log.warning("timeseries tick failed: %s", e)
 
     # ------------------------------------------------------ NAT traversal
     # (reference: miniupnpc IGD mapping at node start, smart_node.py:787-816)
@@ -1102,12 +1165,22 @@ class Node:
 
     async def ping(self, peer: Peer) -> float:
         t0 = time.perf_counter()
-        resp = await self.request(peer, {"type": "PING"})
+        # ts_since opts into the metric-delta piggyback: the responder
+        # stays stateless (cursor lives HERE, per-peer, in FleetStore),
+        # so a missed beat just widens the next ask and the gap
+        # backfills from the responder's own rings — never interpolated
+        ping = {"type": "PING", "ts_since": self.fleet_series.cursor(peer.node_id)}
+        resp = await self.request(peer, ping)
         peer.ping_ms = (time.perf_counter() - t0) * 1e3
         # heartbeat piggyback: every PONG from a capability-publishing
         # peer refreshes this node's fleet table — a validator running
         # start_heartbeat holds a LIVE capability view with no extra RPC
         self._note_peer_capability(peer, resp.get("capability"))
+        delta = resp.get("timeseries_delta")
+        if isinstance(delta, dict):
+            self.fleet_series.ingest(
+                peer.node_id, delta, kv=resp.get("kv")
+            )
         return peer.ping_ms
 
     # ------------------------------------------------------- failure detection
@@ -1230,6 +1303,20 @@ class Node:
         cap = self.capability_record()
         if cap is not None:
             out["capability"] = cap
+        # metric-delta piggyback is requester opt-in (old nodes send a
+        # bare PING and get a bare PONG); sizes are bounded on BOTH
+        # sides — delta() clamps here, sanitize_delta clamps on ingest
+        if "ts_since" in msg and self.timeseries is not None:
+            since = msg.get("ts_since")
+            if since is not None and not isinstance(since, (int, float)):
+                since = None
+            out["timeseries_delta"] = self.timeseries.delta(since)
+            serving = getattr(self, "serving", None)
+            if serving is not None and hasattr(serving, "kv_stats_summary"):
+                try:
+                    out["kv"] = serving.kv_stats_summary()
+                except Exception:  # noqa: BLE001
+                    pass
         return out
 
     def _build_serving(self, engine, *, paged: bool = False, **kw):
@@ -1369,6 +1456,10 @@ class Node:
                 nid[:16]: rec
                 for nid, rec in self.peer_capabilities.items()
             }
+        own = self.alerts.active()
+        fleet = self.fleet_alerts.active()
+        if own or fleet:
+            out["alerts"] = {"own": own, "fleet": fleet}
         return out
 
     def _straggler_report(self) -> dict:
@@ -1385,4 +1476,5 @@ class Node:
         return write_postmortem(
             path, reason, recorder=self.flight, tracer=self.tracer,
             metrics=self.metrics, config=self.cfg,
+            timeseries=self.timeseries,
         )

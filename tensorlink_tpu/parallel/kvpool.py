@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import collections
 import hashlib
+import time
 
 import numpy as np
 
@@ -232,13 +233,24 @@ class BlockPool:
         self._event("kvpool.evict", block=bid)
 
     def stats(self) -> dict:
+        # "fragmentation" for a paged pool: the fraction of the
+        # allocatable headroom that is REUSABLE rather than clean-free
+        # — an alloc under pressure must evict (and forget prefix
+        # entries) for that fraction of its blocks, so high
+        # fragmentation means allocation is about to start costing
+        # cache hits
+        avail = len(self._free) + len(self._reusable)
         return {
             "num_blocks": self.num_blocks,
             "block_size": self.block_size,
             "blocks_in_use": self.in_use,
             "blocks_free": len(self._free),
             "blocks_reusable": len(self._reusable),
+            "blocks_cached": len(self._cached),
             "utilization": round(self.in_use / self.num_blocks, 4),
+            "fragmentation": round(
+                len(self._reusable) / avail, 4
+            ) if avail else 0.0,
         }
 
 
@@ -262,6 +274,13 @@ class PrefixIndex:
         # parent digest -> {fill: (digest over fill tokens, block id)}
         self._partial: dict[bytes, dict[int, tuple[bytes, int]]] = {}
         self._by_block: dict[int, list[tuple]] = {}  # bid -> entry keys
+        # residency metadata (the /kv introspection surface): parent
+        # chain link per full entry (child digest -> parent digest, so
+        # a leaf walks back to the root), and last-hit wall time per
+        # entry key (full: digest; partial: ("p", parent, fill)) —
+        # stamped at register and refreshed by every match() walk
+        self._parent: dict[bytes, bytes] = {}
+        self._last_hit: dict[object, float] = {}
 
     @staticmethod
     def _digest(parent: bytes, tokens: np.ndarray) -> bytes:
@@ -289,6 +308,7 @@ class PrefixIndex:
         blocks: list[int] = []
         key = b""
         n = 0
+        hit_t = time.time()
         while n + bs <= cap:
             nxt = self._digest(key, ids[n:n + bs])
             bid = self._full.get(nxt)
@@ -296,6 +316,7 @@ class PrefixIndex:
                 break
             blocks.append(bid)
             key = nxt
+            self._last_hit[key] = hit_t
             n += bs
         tail = None
         fills = self._partial.get(key)
@@ -306,6 +327,7 @@ class PrefixIndex:
                 digest, bid = fills[fill]
                 if self._digest(key, ids[n:n + fill]) == digest:
                     tail = (bid, fill)
+                    self._last_hit[("p", key, fill)] = hit_t
                     n += fill
                     break
         return blocks, n, tail
@@ -321,11 +343,14 @@ class PrefixIndex:
         newly: list[int] = []
         key = b""
         n = 0
+        reg_t = time.time()
         for bid in blocks:
             if n + bs <= len(ids):
                 nxt = self._digest(key, ids[n:n + bs])
                 if nxt not in self._full:
                     self._full[nxt] = bid
+                    self._parent[nxt] = key
+                    self._last_hit[nxt] = reg_t
                     self._by_block.setdefault(bid, []).append(("f", nxt))
                     newly.append(bid)
                 key = nxt
@@ -337,6 +362,7 @@ class PrefixIndex:
                 fills = self._partial.setdefault(key, {})
                 if fill not in fills:
                     fills[fill] = (self._digest(key, ids[n:n + fill]), bid)
+                    self._last_hit[("p", key, fill)] = reg_t
                     self._by_block.setdefault(bid, []).append(
                         ("p", key, fill)
                     )
@@ -368,14 +394,143 @@ class PrefixIndex:
         for entry in self._by_block.pop(bid, []):
             if entry[0] == "f":
                 self._full.pop(entry[1], None)
+                self._parent.pop(entry[1], None)
+                self._last_hit.pop(entry[1], None)
             else:
                 fills = self._partial.get(entry[1])
                 if fills is not None:
                     fills.pop(entry[2], None)
                     if not fills:
                         del self._partial[entry[1]]
+                self._last_hit.pop(("p", entry[1], entry[2]), None)
 
     def __len__(self) -> int:
         return len(self._full) + sum(
             len(f) for f in self._partial.values()
         )
+
+    # -------------------------------------------------- residency surface
+    def chains(self) -> list[dict]:
+        """Every MAXIMAL resident prefix chain: a leaf full-block
+        digest (no full child) walked back to the root via the parent
+        links, plus every partial-tail entry as its own chain record.
+        Block ids are listed root-first — exactly the prefix a future
+        prompt would map. Caller holds whatever lock guards the index
+        (the engine's scheduler lock)."""
+        has_child = set(self._parent.values())
+        out: list[dict] = []
+
+        def walk(leaf: bytes) -> list[int]:
+            bids: list[int] = []
+            key = leaf
+            while key:
+                bid = self._full.get(key)
+                if bid is None:
+                    # an INTERIOR ancestor was evicted out from under
+                    # this chain (forget_block drops one digest, not
+                    # its descendants' parent links): report only the
+                    # resident suffix — those blocks are unreachable
+                    # garbage awaiting LRU eviction, not a mappable
+                    # prefix, but they DO occupy pool blocks
+                    break
+                bids.append(bid)
+                key = self._parent.get(key, b"")
+            bids.reverse()
+            return bids
+
+        for leaf in self._full:
+            if leaf in has_child or leaf in self._partial:
+                continue  # interior node: a longer chain covers it
+            bids = walk(leaf)
+            out.append({
+                "digest": leaf.hex()[:16],
+                "blocks": len(bids),
+                "tokens": len(bids) * self.block_size,
+                "block_ids": bids,
+                "last_hit": self._last_hit.get(leaf),
+            })
+        for parent, fills in self._partial.items():
+            base = walk(parent) if parent else []
+            for fill, (_, bid) in fills.items():
+                out.append({
+                    "digest": (parent.hex()[:16] or "root")
+                    + f"+{fill}",
+                    "blocks": len(base) + 1,
+                    "tokens": len(base) * self.block_size + fill,
+                    "tail_fill": fill,
+                    "block_ids": base + [bid],
+                    "last_hit": self._last_hit.get(("p", parent, fill)),
+                })
+        return out
+
+
+def kv_residency(
+    pool: BlockPool | None,
+    index: PrefixIndex | None,
+    now: float | None = None,
+    limit: int = 64,
+) -> dict:
+    """The ``GET /kv`` body: pool occupancy/fragmentation plus the
+    resident prefix chains annotated with the pool's view of each
+    chain's blocks — refcounts, eviction priority class (min over the
+    chain: its most protected consumer), last-hit age. MUST be called
+    under the lock that serializes pool/index mutation (the serving
+    engine's scheduler lock) — the point is an exact snapshot, not a
+    torn one."""
+    t = time.time() if now is None else now
+    out: dict = {
+        "pool": pool.stats() if pool is not None else None,
+        "chains": [],
+        "total_chains": 0,
+        "prefix_entries": len(index) if index is not None else 0,
+    }
+    if index is None:
+        return out
+    chains = index.chains()
+    out["total_chains"] = len(chains)
+    # the hottest prefixes first; bound the body (a node with thousands
+    # of resident chains still answers in one small page)
+    chains.sort(key=lambda c: c.get("last_hit") or 0.0, reverse=True)
+    for c in chains[:limit]:
+        rec = dict(c)
+        hit = rec.pop("last_hit", None)
+        rec["last_hit_age_s"] = (
+            round(max(0.0, t - hit), 3) if hit else None
+        )
+        if pool is not None:
+            bids = rec["block_ids"]
+            rec["refs"] = sum(pool.refcount(b) for b in bids)
+            rec["priority"] = min(
+                (pool._cached_prio.get(b, 2) for b in bids), default=2
+            )
+        out["chains"].append(rec)
+    if len(chains) > limit:
+        out["truncated"] = len(chains) - limit
+    return out
+
+
+def kv_summary(
+    pool: BlockPool | None, index: PrefixIndex | None
+) -> dict:
+    """Compact scalar form of :func:`kv_residency` — what rides the
+    heartbeat delta to the validator's fleet table (the published-
+    residency groundwork for prefix-affinity routing). Same locking
+    contract as :func:`kv_residency`."""
+    out: dict = {}
+    if pool is not None:
+        st = pool.stats()
+        out.update({
+            "num_blocks": st["num_blocks"],
+            "used": st["blocks_in_use"],
+            "free": st["blocks_free"],
+            "reusable": st["blocks_reusable"],
+            "cached": st["blocks_cached"],
+            "occupancy": st["utilization"],
+            "fragmentation": st["fragmentation"],
+        })
+    if index is not None:
+        out["prefix_blocks"] = len(index._by_block)
+        out["chains"] = sum(
+            1 for _ in index.chains()
+        )
+    return out

@@ -77,6 +77,8 @@ from tensorlink_tpu.parallel.kvpool import (
     BlockPool,
     PoolExhaustedError,
     PrefixIndex,
+    kv_residency,
+    kv_summary,
 )
 from tensorlink_tpu.parallel.speculative import (
     AdaptiveKController,
@@ -3495,3 +3497,17 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 # the tldiag XFER-STALLED flag
                 out["disagg"] = {**self.disagg, **self._disagg_ewma}
         return out
+
+    def kv_stats(self, limit: int = 64) -> dict:
+        """Locked KV/prefix residency snapshot — the ``GET /kv`` body.
+        The scheduler lock serializes against admission/eviction, so
+        the chains, refcounts and pool counters are one consistent
+        instant, never a table torn mid-admission (tlint TL601)."""
+        with self._lock:
+            return kv_residency(self.pool, self.index, limit=limit)
+
+    def kv_stats_summary(self) -> dict:
+        """Scalar residency summary for the heartbeat delta (same lock
+        contract as :meth:`kv_stats`)."""
+        with self._lock:
+            return kv_summary(self.pool, self.index)

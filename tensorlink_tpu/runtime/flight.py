@@ -40,11 +40,17 @@ from typing import Any, Iterable
 class Event:
     """One recorded occurrence. ``seq`` is a process-wide monotonic id so
     consumers (``/events?since=``, tldiag merges) can order and dedupe
-    events across scrapes without trusting wall clocks."""
+    events across scrapes without trusting wall clocks. ``ts`` is the
+    wall clock; ``mono`` is ``time.monotonic()`` at record time, so a
+    consumer holding one (wall, mono) pair from ANY event can place
+    every other event, span, and monotonic-stamped timeline (device
+    time, alert windows) on a single shared axis — wall clocks alone
+    can step backwards under NTP and misorder a timeline."""
 
     kind: str
     severity: str = "info"  # info | warn | error
     ts: float = 0.0
+    mono: float = 0.0
     seq: int = 0
     attrs: dict[str, Any] = field(default_factory=dict)
 
@@ -53,6 +59,7 @@ class Event:
             "kind": self.kind,
             "severity": self.severity,
             "ts": self.ts,
+            "mono": self.mono,
             "seq": self.seq,
             "attrs": self.attrs,
         }
@@ -81,6 +88,7 @@ class FlightRecorder:
             kind=kind,
             severity=severity,
             ts=time.time(),
+            mono=time.monotonic(),
             seq=next(_seq),
             # default=str at read time would lose structure; stringify
             # non-JSON values NOW so a poisoned attr can never make the
@@ -406,15 +414,21 @@ def write_postmortem(
     config: Any = None,
     exc: BaseException | None = None,
     max_spans: int = 256,
+    timeseries: Any = None,
+    timeseries_last_s: float | None = 600.0,
 ) -> str:
     """Dump the black box to ``path`` (atomic write): events + last
-    spans + metrics snapshot + config + versions. Every section is
-    best-effort — a half-written bundle from a dying process beats an
-    exception in the crash handler. Returns the path written."""
+    spans + metrics snapshot + the last minutes of the time-series
+    rings + config + versions. Every section is best-effort — a
+    half-written bundle from a dying process beats an exception in the
+    crash handler. Returns the path written."""
     recorder = recorder or default_recorder()
     bundle: dict[str, Any] = {
         "reason": reason,
         "at": time.time(),
+        # the (wall, mono) anchor pair: maps every Event.mono in this
+        # bundle onto the wall-clock axis the time-series rings use
+        "at_mono": time.monotonic(),
         "pid": os.getpid(),
         "service": recorder.service,
         "versions": versions(),
@@ -438,6 +452,14 @@ def write_postmortem(
             bundle["metrics"] = metrics.snapshot()
         except Exception as e:  # noqa: BLE001
             bundle["metrics_error"] = str(e)
+    if timeseries is not None:
+        try:
+            # the minutes BEFORE the crash — what a snapshot can't show
+            bundle["timeseries"] = timeseries.snapshot(
+                last_s=timeseries_last_s
+            )
+        except Exception as e:  # noqa: BLE001
+            bundle["timeseries_error"] = str(e)
     if config is not None:
         try:
             cfg = config.to_dict() if hasattr(config, "to_dict") else config
@@ -466,6 +488,7 @@ def install_crash_handler(
     metrics: Any = None,
     config: Any = None,
     signals: tuple[int, ...] | None = None,
+    timeseries: Any = None,
 ):
     """Arm the post-mortem dump: an unhandled exception (sys.excepthook)
     or a termination signal (SIGTERM by default; pass ``signals=()`` to
@@ -487,6 +510,7 @@ def install_crash_handler(
             write_postmortem(
                 path, reason, recorder=recorder, tracer=tracer,
                 metrics=metrics, config=config, exc=exc,
+                timeseries=timeseries,
             )
             print(f"post-mortem bundle written: {path}", file=sys.stderr)  # noqa: T201
         except Exception:  # noqa: BLE001 — the crash path must not crash

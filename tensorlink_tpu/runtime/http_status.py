@@ -131,6 +131,65 @@ class StatusServer:
         tracer = getattr(node, "tracer", None)
         if tracer is not None:
             routes["/spans"] = lambda q: tracer.to_chrome_trace()
+        ts = getattr(node, "timeseries", None)
+        if ts is not None:
+
+            def history_route(q: dict):
+                # GET /history?series=NAME&since=T&step=S — one named
+                # ring; without ?series= list what's recorded so a
+                # dashboard can discover before it queries
+                name = q.get("series")
+                if not name:
+                    return {"tiers": list(ts.tiers), "series": ts.names()}
+                if ts.kind(name) is None:
+                    return Response(
+                        "404 Not Found", {"error": f"no series {name}"}
+                    )
+                return ts.query(
+                    name,
+                    since=float(q["since"]) if "since" in q else None,
+                    step=float(q["step"]) if "step" in q else None,
+                )
+
+            routes["/history"] = history_route
+        serving = getattr(node, "serving", None)
+        if serving is not None and hasattr(serving, "kv_stats"):
+
+            def kv_route(q: dict):
+                # locked residency snapshot: pool occupancy/fragmentation
+                # plus the resident prefix chains (digest, blocks, refs,
+                # priority class, last-hit age) — ROADMAP-1(a) groundwork
+                limit = int(q.get("limit", 64))
+                return serving.kv_stats(limit=limit)
+
+            routes["/kv"] = kv_route
+        fleet_series = getattr(node, "fleet_series", None)
+        if fleet_series is not None:
+
+            def fleet_route(q: dict):
+                # ?series=NAME rolls one metric fleet-wide (sum for
+                # counters, mean for gauges) beside the per-node points;
+                # the bare call is the dashboard summary: per-node last
+                # values + KV summaries + active alerts (own and fleet)
+                name = q.get("series")
+                if name:
+                    return fleet_series.query(
+                        name,
+                        since=float(q["since"]) if "since" in q else None,
+                        step=float(q["step"]) if "step" in q else None,
+                    )
+                out = fleet_series.summary()
+                alerts = getattr(node, "fleet_alerts", None)
+                own = getattr(node, "alerts", None)
+                out["alerts"] = {
+                    "own": own.active() if own is not None else [],
+                    "fleet": (
+                        alerts.active() if alerts is not None else []
+                    ),
+                }
+                return out
+
+            routes["/fleet"] = fleet_route
         if hasattr(node, "jobs"):
             routes["/jobs"] = lambda q: {
                 jid: {

@@ -196,33 +196,42 @@ class Metrics:
         ``_total`` counters, rolling series as gauges (last value; the
         window mean/min/max stay JSON-side), histograms as cumulative
         ``_bucket{le=...}`` + ``_sum`` + ``_count`` series. Exactly one
-        ``# TYPE`` line per metric; name collisions after sanitization
+        ``# HELP`` + ``# TYPE`` pair per metric family (exposition
+        format 0.0.4 conformance — promtool and client_golang's parser
+        both want HELP before TYPE); name collisions after sanitization
         keep the first metric and drop later ones (never two TYPEs)."""
         lines: list[str] = []
         seen: set[str] = set()
 
-        def emit(name: str, kind: str) -> bool:
+        def emit(name: str, kind: str, raw: str) -> bool:
             if name in seen:
                 return False
             seen.add(name)
+            # HELP text is the source metric name (pre-sanitization) +
+            # kind — escaped per the format spec (\\ and \n only)
+            help_text = (
+                f"tensorlink {kind} {raw}"
+                .replace("\\", r"\\").replace("\n", r"\n")
+            )
+            lines.append(f"# HELP {name} {help_text}")
             lines.append(f"# TYPE {name} {kind}")
             return True
 
         for name in sorted(self.counters):
             p = f"{prefix}_{_prom_name(name)}_total"
-            if emit(p, "counter"):
+            if emit(p, "counter", name):
                 lines.append(f"{p} {self.counters[name]}")
         for name in sorted(self.series):
             q = self.series[name]
             if not q:
                 continue
             p = f"{prefix}_{_prom_name(name)}"
-            if emit(p, "gauge"):
+            if emit(p, "gauge", name):
                 lines.append(f"{p} {q[-1]}")
         for name in sorted(self.histograms):
             h = self.histograms[name]
             p = f"{prefix}_{_prom_name(name)}"
-            if not emit(p, "histogram"):
+            if not emit(p, "histogram", name):
                 continue
             cum = 0
             for bound, c in zip(h.buckets, h.counts):

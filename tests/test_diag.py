@@ -251,6 +251,59 @@ def test_bench_diff_serving_load_key_directions():
     assert d["keys"]["serving_load_retry_after_honesty"]["direction"] is None
 
 
+def test_bench_diff_observability_key_directions():
+    """ISSUE-16 observability keys: the telemetry tax
+    (observability_overhead_frac) and the validator /fleet scrape
+    latency (fleet_scrape_s) are both lower-better — a 'more overhead'
+    improvement verdict would bless the sampler eating the serving
+    budget it is supposed to watch."""
+    old = {
+        "observability_overhead_frac": 0.004,
+        "fleet_scrape_s": 0.010,
+    }
+    new = {
+        "observability_overhead_frac": 0.020,  # worse
+        "fleet_scrape_s": 0.005,               # better
+    }
+    d = bench_diff(old, new, threshold=0.05)
+    assert set(d["regressions"]) == {"observability_overhead_frac"}
+    assert set(d["improvements"]) == {"fleet_scrape_s"}
+    assert d["keys"]["observability_overhead_frac"]["direction"] == "lower"
+    assert d["keys"]["fleet_scrape_s"]["direction"] == "lower"
+
+
+def test_sparkline_and_check_render():
+    """tldiag watch/check primitives: sparklines scale into the 8-step
+    block ramp, and render_check emits GitHub workflow commands with
+    one ::error per firing SLO alert."""
+    from tensorlink_tpu.diag import render_check, sparkline
+
+    s = sparkline([0.0, 1.0], width=32)
+    assert s[0] == "▁" and s[-1] == "█"
+    assert sparkline([], width=8) == ""
+    assert len(sparkline(list(range(100)), width=16)) == 16
+
+    alert = {
+        "name": "ttft-burn:interactive", "severity": "error",
+        "rule": "ttft-burn:interactive", "detail": "0.9 > 0.1",
+    }
+    result = {
+        "targets": ["h:1"],
+        "nodes": {"h:1": {"alerts": [alert]}},
+        "firing": [{**alert, "target": "h:1"}],
+        "ok": False,
+    }
+    gh = render_check(result, "github")
+    assert "::error" in gh and "ttft-burn:interactive" in gh
+    txt = render_check(result, "text")
+    assert "FAIL" in txt
+    ok = render_check(
+        {"targets": ["h:1"], "nodes": {}, "firing": [], "ok": True},
+        "github",
+    )
+    assert "::notice" in ok and "::error" not in ok
+
+
 def test_node_row_flags_shedding():
     """A node whose serving admission stats show a RECENT shed renders
     SHEDDING(total); an old shed total with no recent activity is

@@ -366,14 +366,24 @@ def test_histogram_quantiles_and_snapshot():
 
 
 def _parse_prom(text: str) -> dict:
-    """Tiny Prometheus text-format parser: name -> {type, samples}."""
+    """Tiny Prometheus text-format parser: name -> {type, help, samples}."""
     metrics: dict = {}
     current = None
+    pending_help: tuple[str, str] | None = None
     for line in text.strip().splitlines():
-        if line.startswith("# TYPE"):
+        if line.startswith("# HELP"):
+            _, _, name, doc = line.split(None, 3)
+            pending_help = (name, doc)
+        elif line.startswith("# TYPE"):
             _, _, name, kind = line.split()
             assert name not in metrics, f"duplicate TYPE for {name}"
-            current = metrics.setdefault(name, {"type": kind, "samples": {}})
+            assert pending_help is not None and pending_help[0] == name, (
+                f"TYPE for {name} not preceded by its HELP"
+            )
+            current = metrics.setdefault(
+                name, {"type": kind, "help": pending_help[1], "samples": {}}
+            )
+            pending_help = None
         else:
             assert current is not None, f"sample before TYPE: {line}"
             key, val = line.rsplit(" ", 1)

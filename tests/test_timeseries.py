@@ -1,0 +1,729 @@
+"""Fleet telemetry (ISSUE 16): ring-buffer time-series, heartbeat
+metric deltas, SLO burn-rate alerting, KV residency introspection.
+
+Pins the contracts the observability stack rides on: fixed-memory
+rings that wrap without losing recent data, counter-delta conservation
+across downsampling tiers, pagination that stays stable under a live
+writer, heartbeat-delta merges that leave missed-beat gaps VISIBLE
+(never interpolated), hostile-peer delta sanitation, edge-triggered
+alert transitions wired into health conditions and the flight
+recorder, the locked /kv snapshot staying exact under concurrent
+admission/eviction, and the 3-node validator rollup + chaos-stall
+alerting acceptance scenario.
+"""
+
+import asyncio
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from tensorlink_tpu.runtime.alerts import (
+    AlertEngine,
+    AlertRule,
+    default_rules,
+    evaluate_rule,
+    load_rules,
+)
+from tensorlink_tpu.runtime.metrics import Metrics
+from tensorlink_tpu.runtime.timeseries import (
+    FleetStore,
+    TimeSeriesStore,
+    sanitize_delta,
+)
+
+T0 = 1_700_000_000.0  # fixed synthetic epoch: these tests never sleep
+
+
+# ------------------------------------------------------------ ring core
+def test_ring_wraparound_keeps_only_newest():
+    ts = TimeSeriesStore(tiers=((1.0, 10),))
+    for i in range(25):
+        ts.record("g", float(i), "gauge", now=T0 + i)
+    pts = ts.query("g", now=T0 + 24.5)["points"]
+    # 10 slots: buckets 15..24 survive, 0..14 were overwritten in place
+    assert len(pts) == 10
+    assert pts[0][0] == pytest.approx(T0 + 15)
+    assert pts[-1][0] == pytest.approx(T0 + 24)
+    assert [v for _, v in pts] == [float(i) for i in range(15, 25)]
+
+
+def test_counter_conserved_across_downsample_boundary():
+    """Counters are stored CUMULATIVE, so a coarse bucket's value is
+    the last fine sample inside it and any delta split across a
+    downsample boundary is conserved exactly — no increments are lost
+    or double-counted when a query falls back to the coarse tier."""
+    ts = TimeSeriesStore(tiers=((1.0, 600), (15.0, 480)))
+    total = 0.0
+    for i in range(120):
+        total += i % 7  # lumpy increments
+        ts.record("c", total, "counter", now=T0 + i)
+    now = T0 + 119.5
+    fine = ts.query("c", step=1.0, now=now)["points"]
+    coarse = ts.query("c", step=15.0, now=now)["points"]
+    assert ts.query("c", step=15.0, now=now)["step"] == 15.0
+    assert fine[-1][1] == coarse[-1][1] == total
+    fine_by_t = dict((t, v) for t, v in fine)
+
+    def fine_at_end(t):  # fine-tier value at the end of coarse bucket t
+        return fine_by_t[max(ft for ft in fine_by_t if t <= ft < t + 15.0)]
+
+    for t, v in coarse:
+        assert v == fine_at_end(t)
+    # consequence: per-coarse-bucket deltas sum to the full-span delta
+    deltas = [b[1] - a[1] for a, b in zip(coarse, coarse[1:])]
+    assert sum(deltas) == coarse[-1][1] - coarse[0][1]
+
+
+def test_gauge_downsample_is_mean():
+    ts = TimeSeriesStore(tiers=((1.0, 600), (15.0, 480)))
+    for i in range(60):
+        ts.record("g", float(i % 13), "gauge", now=T0 + i)
+    now = T0 + 59.5
+    fine = ts.query("g", step=1.0, now=now)["points"]
+    coarse = ts.query("g", step=15.0, now=now)["points"]
+    for t, v in coarse:
+        vals = [fv for ft, fv in fine if t <= ft < t + 15.0]
+        assert v == pytest.approx(sum(vals) / len(vals))
+
+
+def test_since_pagination_stable_under_live_writer():
+    """A dashboard cursors with since=: already-fetched pages must not
+    change as the writer keeps appending, and consecutive pages must
+    tile without overlap or holes."""
+    ts = TimeSeriesStore(tiers=((1.0, 200),))
+    for i in range(50):
+        ts.record("g", float(i), "gauge", now=T0 + i)
+    page1 = ts.query("g", now=T0 + 49.5)["points"]
+    cursor = page1[-1][0]
+    for i in range(50, 90):  # live writer keeps going
+        ts.record("g", float(i), "gauge", now=T0 + i)
+    again = ts.query("g", since=page1[0][0], now=T0 + 89.5)["points"]
+    assert again[: len(page1)] == page1  # retained history is stable
+    page2 = ts.query("g", since=cursor + 0.5, now=T0 + 89.5)["points"]
+    assert page2[0][0] == pytest.approx(cursor + 1.0)  # no overlap
+    assert [t for t, _ in page1 + page2] == [
+        pytest.approx(T0 + i) for i in range(90)
+    ]  # no holes
+
+
+def test_kind_is_fixed_nan_dropped_cardinality_capped():
+    ts = TimeSeriesStore(tiers=((1.0, 10),), max_series=3)
+    ts.record("a", 1.0, "counter", now=T0)
+    ts.record("a", 2.0, "gauge", now=T0 + 1)  # kind pinned at creation
+    assert ts.kind("a") == "counter"
+    ts.record("a", float("nan"), "counter", now=T0 + 2)
+    assert len(ts.query("a", now=T0 + 3)["points"]) == 2
+    ts.record("b", 1.0, "gauge", now=T0)
+    ts.record("c", 1.0, "gauge", now=T0)
+    ts.record("overflow", 1.0, "gauge", now=T0)
+    assert ts.kind("overflow") is None
+    assert ts.dropped_series >= 1
+
+
+def test_sample_metrics_shapes():
+    m = Metrics()
+    m.incr("reqs_total", 3)
+    m.observe("util", 0.5)
+    for v in (0.1, 0.2, 0.9):
+        m.observe_hist("lat_s", v)
+    ts = TimeSeriesStore()
+    ts.sample_metrics(m, now=T0)
+    assert ts.kind("reqs_total") == "counter"
+    assert ts.kind("util") == "gauge"
+    assert ts.kind("lat_s.p99") == "gauge"
+    assert ts.kind("lat_s.count") == "counter"
+    assert ts.query("lat_s.count", now=T0 + 1)["points"][-1][1] == 3.0
+
+
+# ------------------------------------------------- delta + sanitation
+def test_delta_roundtrip_and_missed_beat_gap():
+    """The heartbeat protocol: cursor-based deltas into a FleetStore.
+    A missed stretch of beats widens the next ask; the refill comes
+    from the responder's rings, and the un-sampled stretch stays a
+    VISIBLE hole in the fleet view — never interpolated."""
+    worker = TimeSeriesStore()
+    fleet = FleetStore()
+    for i in range(10):
+        worker.record("g", float(i), "gauge", now=T0 + i)
+    d1 = worker.delta(fleet.cursor("w"), patterns=("g",), now=T0 + 9.5)
+    assert fleet.ingest("w", d1, now=T0 + 9.5) == 10
+    cur = fleet.cursor("w")
+    assert cur > T0 + 9  # advanced past the newest shipped bucket
+
+    # the worker goes dark for [10, 20), then resumes sampling
+    for i in range(20, 30):
+        worker.record("g", float(i), "gauge", now=T0 + i)
+    # beats were MISSED — the next ask still starts at the old cursor,
+    # so the whole resumed stretch backfills in one delta, with no
+    # re-send of the bucket already shipped
+    d2 = worker.delta(cur, patterns=("g",), now=T0 + 29.5)
+    assert fleet.ingest("w", d2, now=T0 + 29.5) == 10
+    pts = fleet.query("g", now=T0 + 29.5)["nodes"]["w"]["points"]
+    assert len(pts) == 20
+    times = [t for t, _ in pts]
+    gaps = [b - a for a, b in zip(times, times[1:])]
+    # the dark stretch is a visible hole, not an interpolated line
+    assert max(gaps) == pytest.approx(11.0)
+    assert all(g == pytest.approx(1.0) for g in gaps if g < 5)
+
+
+def test_sanitize_delta_bounds_hostile_peer():
+    long_name = "x" * 500
+    hostile = {
+        "t": "nope",
+        "series": {
+            long_name: {"kind": "gauge", "points": [[T0, 1.0]]},
+            "inf": {"kind": "gauge", "points": [[T0, float("inf")]]},
+            "bad_kind": {"kind": "exploit", "points": [[T0, 1.0]]},
+            "flood": {
+                "kind": "counter",
+                "points": [[T0 + i, float(i)] for i in range(100000)],
+            },
+            "not_points": {"kind": "gauge", "points": "boom"},
+            "ok": {"kind": "gauge", "points": [[T0, 2.0], ["x", 3.0]]},
+        },
+    }
+    clean = sanitize_delta(hostile)
+    names = set(clean["series"])
+    assert long_name not in names  # name length clamp
+    assert "inf" not in names  # non-finite values dropped
+    assert "not_points" not in names  # malformed body dropped
+    assert clean["series"]["bad_kind"]["kind"] == "gauge"  # coerced
+    assert clean["series"]["ok"]["points"] == [[T0, 2.0]]
+    assert len(clean["series"]["flood"]["points"]) <= 160
+    assert "t" not in clean  # non-numeric timestamp dropped
+    assert sanitize_delta("garbage") is None
+    assert sanitize_delta({"series": "garbage"}) is None
+
+
+def test_fleet_rollup_counters_sum_gauges_mean():
+    fleet = FleetStore()
+    for nid, base in (("a", 0.0), ("b", 100.0)):
+        fleet.ingest(nid, {
+            "t": T0,
+            "series": {
+                "reqs": {
+                    "kind": "counter",
+                    "points": [[T0 + i, base + i] for i in range(5)],
+                },
+                "util": {
+                    "kind": "gauge",
+                    "points": [[T0 + i, 0.2 if nid == "a" else 0.6]
+                               for i in range(5)],
+                },
+            },
+        }, now=T0 + 5)
+    q = fleet.query("reqs", now=T0 + 5)
+    assert q["kind"] == "counter"
+    assert len(q["nodes"]) == 2
+    assert q["fleet"][-1][1] == pytest.approx(4 + 104)  # summed
+    q = fleet.query("util", now=T0 + 5)
+    assert all(v == pytest.approx(0.4) for _, v in q["fleet"])  # mean
+    summ = fleet.summary(now=T0 + 6)
+    assert set(summ["nodes"]) == {"a", "b"}
+    assert len(summ["tiers"]) >= 2
+    assert summ["nodes"]["a"]["last_seen_age_s"] == pytest.approx(1.0)
+    assert "reqs" in summ["series"] and "util" in summ["series"]
+
+
+def test_fleet_ingest_sanitizes_kv_summary():
+    fleet = FleetStore()
+    fleet.ingest("w", {"t": T0, "series": {}}, now=T0, kv={
+        "occupancy": 0.5, "chains": 3, "num_blocks": 64,
+        "evil": "x" * 10000, "used": float("inf"), "cached": True,
+    })
+    kv = fleet.summary(now=T0)["nodes"]["w"]["kv"]
+    assert kv == {"occupancy": 0.5, "chains": 3, "num_blocks": 64}
+
+
+# ------------------------------------------------------------- alerts
+def _feed(store, name, value, t_from, t_to, kind="gauge"):
+    t = t_from
+    while t < t_to:
+        store.record(name, value, kind, now=t)
+        t += 1.0
+
+
+def test_latency_burn_fires_and_clears_with_health_and_flight():
+    from tensorlink_tpu.runtime.flight import FlightRecorder, HealthState
+
+    rule = AlertRule(
+        name="ttft-burn", kind="latency", series="ttft.p99",
+        target=0.1, windows_s=(5.0, 15.0), severity="error",
+    )
+    fr, hs, m = FlightRecorder("t"), HealthState(), Metrics()
+    eng = AlertEngine([rule], recorder=fr, health=hs, metrics=m)
+    ts = TimeSeriesStore()
+    _feed(ts, "ttft.p99", 0.02, T0, T0 + 20)
+    assert eng.evaluate(ts, now=T0 + 20) == []
+    assert hs.report()["ok"]
+
+    _feed(ts, "ttft.p99", 0.9, T0 + 20, T0 + 40)
+    active = eng.evaluate(ts, now=T0 + 40)
+    assert [a["name"] for a in active] == ["ttft-burn"]
+    assert active[0]["severity"] == "error"
+    assert active[0]["value"] == pytest.approx(0.9)
+    rep = hs.report()
+    # a burning SLO flips readiness: /healthz goes 503 for the LB
+    assert not rep["ok"]
+    assert "condition:alert:ttft-burn" in rep["reasons"]
+    assert m.counters.get("alerts_fired_total") == 1
+    fired = fr.events(kind="alert_fired")
+    assert len(fired) == 1
+    # satellite 5: alert transitions carry BOTH wall + monotonic stamps
+    assert fired[0]["ts"] > 1e9 and 0 < fired[0]["mono"] < 1e9
+
+    _feed(ts, "ttft.p99", 0.02, T0 + 40, T0 + 80)
+    assert eng.evaluate(ts, now=T0 + 80) == []
+    assert hs.report()["ok"]
+    cleared = fr.events(kind="alert_cleared")
+    assert len(cleared) == 1 and cleared[0]["mono"] > 0
+    # edge-triggered: re-evaluating while clear emits nothing new
+    eng.evaluate(ts, now=T0 + 81)
+    assert len(fr.events(kind="alert_cleared")) == 1
+
+
+def test_burn_requires_all_windows():
+    """Multi-window burn semantics: a short spike exceeds the fast
+    window but not the slow one -> no alert (flap suppression)."""
+    rule = AlertRule(
+        name="burn", kind="latency", series="s", target=0.1,
+        windows_s=(3.0, 30.0),
+    )
+    ts = TimeSeriesStore()
+    _feed(ts, "s", 0.01, T0, T0 + 28)
+    _feed(ts, "s", 0.5, T0 + 28, T0 + 30)  # 2 s spike
+    assert not evaluate_rule(rule, ts, now=T0 + 30).firing
+    _feed(ts, "s", 0.5, T0 + 30, T0 + 58)  # sustained
+    assert evaluate_rule(rule, ts, now=T0 + 58).firing
+
+
+def test_no_data_abstains():
+    rule = AlertRule(
+        name="burn", kind="latency", series="absent", target=0.1,
+        windows_s=(5.0,),
+    )
+    res = evaluate_rule(rule, TimeSeriesStore(), now=T0)
+    assert not res.firing and "no data" in res.detail
+
+
+def test_budget_burn_rate():
+    rule = AlertRule(
+        name="shed-burn", kind="budget_burn", numerator="shed",
+        denominator="reqs", budget_frac=0.01, burn_factor=10.0,
+        windows_s=(5.0, 10.0),
+    )
+    ts = TimeSeriesStore()
+    reqs = shed = 0.0
+    for i in range(20):  # 5% shed: under the 10x-burn limit of 10%
+        reqs += 10.0
+        shed += 0.5
+        ts.record("reqs", reqs, "counter", now=T0 + i)
+        ts.record("shed", shed, "counter", now=T0 + i)
+    assert not evaluate_rule(rule, ts, now=T0 + 20).firing
+    for i in range(20, 40):  # 50% shed: burning 5x faster than allowed
+        reqs += 10.0
+        shed += 5.0
+        ts.record("reqs", reqs, "counter", now=T0 + i)
+        ts.record("shed", shed, "counter", now=T0 + i)
+    res = evaluate_rule(rule, ts, now=T0 + 40)
+    assert res.firing and res.value == pytest.approx(0.5, abs=0.05)
+
+
+def test_staleness_via_fleet_and_name_suffix():
+    fleet = FleetStore()
+    beat = {"t": T0, "series": {"g": {"kind": "gauge",
+                                      "points": [[T0, 1.0]]}}}
+    fleet.ingest("w1", beat, now=T0)
+    eng = AlertEngine([AlertRule(
+        name="heartbeat-stale", kind="staleness", stale_after_s=10.0,
+        severity="error",
+    )])
+    assert eng.evaluate_fleet(fleet, now=T0 + 5) == []
+    active = eng.evaluate_fleet(fleet, now=T0 + 30)
+    assert [a["name"] for a in active] == ["heartbeat-stale@w1"]
+    fleet.ingest("w1", dict(beat), now=T0 + 31)  # peer comes back
+    assert eng.evaluate_fleet(fleet, now=T0 + 32) == []
+
+
+def test_default_rules_and_slo_file_roundtrip(tmp_path):
+    slo = {
+        "ttft_p99_s": {"interactive": 0.5},
+        "tpot_p99_s": 0.2,
+        "shed_budget_frac": 0.01,
+        "windows_s": [10, 60],
+    }
+    rules = default_rules(slo)
+    names = {r.name for r in rules}
+    assert {"ttft-burn:interactive", "tpot-burn", "shed-burn",
+            "host-bound", "kv-pressure", "heartbeat-stale"} <= names
+    ttft = next(r for r in rules if r.name == "ttft-burn:interactive")
+    assert ttft.series == "serving_ttft_s:interactive.p99"
+    assert ttft.windows_s == (10.0, 60.0)
+    shed = next(r for r in rules if r.name == "shed-burn")
+    assert shed.numerator == "serving_shed_total"
+    assert shed.denominator == "serving_requests_total"
+
+    p = tmp_path / "slo.json"
+    p.write_text(json.dumps({
+        **slo,
+        "rules": [{"name": "custom", "kind": "threshold",
+                   "series": "x", "target": 1.0}],
+    }))
+    loaded = load_rules(str(p))
+    assert {r.name for r in loaded} == names | {"custom"}
+    assert AlertRule.from_dict(ttft.to_dict()) == ttft
+
+
+# ------------------------------------------ flight + postmortem ties
+def test_event_monotonic_and_postmortem_timeseries(tmp_path):
+    from tensorlink_tpu.runtime.flight import (
+        FlightRecorder,
+        write_postmortem,
+    )
+
+    fr = FlightRecorder("t")
+    fr.record("something", "info")
+    ev = fr.events()[0]
+    assert ev["ts"] > 1e9 and 0 < ev["mono"] < 1e9  # wall + monotonic
+
+    ts = TimeSeriesStore()
+    now = time.time()  # snapshot() reads the wall clock internally
+    _feed(ts, "g", 1.0, now - 30, now)
+    path = str(tmp_path / "pm.json")
+    write_postmortem(path, "test", recorder=fr, timeseries=ts)
+    bundle = json.loads(open(path).read())
+    assert bundle["at"] > 1e9 and bundle["at_mono"] > 0
+    g = bundle["timeseries"]["series"]["g"]
+    assert g["tiers"][0]["points"]  # the rings rode into the crash dump
+
+
+# --------------------------------------------- prometheus conformance
+def _parse_prom(text: str) -> dict:
+    """Strict exposition-format (0.0.4) parser: HELP then TYPE per
+    family, every sample attributed to a declared family."""
+    fams: dict = {}
+    cur = None
+    for line in text.rstrip("\n").split("\n"):
+        if line.startswith("# HELP "):
+            _, _, name, help_text = line.split(" ", 3)
+            assert name not in fams, f"duplicate HELP {name}"
+            fams[name] = {"help": help_text, "type": None, "samples": {}}
+            cur = name
+        elif line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert name == cur, "TYPE must follow its own HELP"
+            assert fams[name]["type"] is None, f"duplicate TYPE {name}"
+            fams[name]["type"] = kind
+        else:
+            assert not line.startswith("#"), f"unknown comment: {line}"
+            key, val = line.rsplit(" ", 1)
+            base = key.partition("{")[0]
+            fam = base
+            for suffix in ("_bucket", "_sum", "_count"):
+                if base.endswith(suffix) and base[: -len(suffix)] in fams:
+                    fam = base[: -len(suffix)]
+            assert fam in fams, f"sample {key} has no family"
+            fams[fam]["samples"][key] = float(val)
+    return fams
+
+
+def test_prometheus_exposition_roundtrip():
+    m = Metrics()
+    m.incr("reqs_total", 7)
+    m.incr("msg:PING", 2)  # colons are legal in prom metric names
+    m.observe("util", 0.25)
+    for v in (0.05, 0.3, 0.3, 2.0):
+        m.observe_hist("lat_s", v)
+    fams = _parse_prom(m.to_prometheus())
+    for fam in fams.values():  # every family: HELP + exactly one TYPE
+        assert fam["help"]
+        assert fam["type"] in ("counter", "gauge", "histogram")
+    c = fams["tensorlink_reqs_total_total"]
+    assert c["type"] == "counter"
+    assert c["samples"]["tensorlink_reqs_total_total"] == 7.0
+    assert fams["tensorlink_msg:PING_total"]["samples"][
+        "tensorlink_msg:PING_total"] == 2.0
+    assert fams["tensorlink_util"]["type"] == "gauge"
+    h = fams["tensorlink_lat_s"]
+    assert h["type"] == "histogram"
+    buckets = [v for k, v in h["samples"].items() if "_bucket{" in k]
+    assert buckets == sorted(buckets)  # cumulative, non-decreasing
+    inf = next(v for k, v in h["samples"].items() if 'le="+Inf"' in k)
+    assert inf == h["samples"]["tensorlink_lat_s_count"] == 4.0
+    assert h["samples"]["tensorlink_lat_s_sum"] == pytest.approx(2.65)
+
+
+# ------------------------------------------------ /kv locked snapshot
+def test_kv_snapshot_exact_under_concurrent_admission_eviction():
+    """GET /kv must be an atomic view: pool accounting adds up and
+    every resident chain's blocks are live, while a writer thread
+    admits/evicts as fast as it can. A torn (unlocked) snapshot breaks
+    the block-conservation identity almost immediately."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorlink_tpu.config import MeshConfig
+    from tensorlink_tpu.models.llama import Llama, LlamaConfig
+    from tensorlink_tpu.parallel.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from tensorlink_tpu.parallel.serving import (
+        PagedContinuousBatchingEngine,
+    )
+    from tensorlink_tpu.runtime.mesh import make_mesh
+
+    cfg = LlamaConfig.tiny()
+    model = Llama(cfg)
+    eng = InferenceEngine(
+        make_mesh(MeshConfig()), model,
+        model.init(jax.random.PRNGKey(0)), max_len=32,
+        cache_dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    # a pool small enough that shared-prefix traffic must evict
+    sch = PagedContinuousBatchingEngine(
+        eng, slots=2, gen=GenerationConfig(max_new_tokens=4),
+        block_size=4, num_blocks=12, prefix_cache=True,
+    )
+    r = np.random.default_rng(0)
+    system = r.integers(0, cfg.vocab_size, (6,))
+    prompts = [
+        np.concatenate([system, r.integers(0, cfg.vocab_size, (n,))])
+        for n in (3, 5, 7, 2, 6, 4, 8, 3)
+    ]
+    failures: list = []
+    done = threading.Event()
+
+    def writer():
+        try:
+            for _ in range(3):
+                for rid in [sch.submit(p) for p in prompts]:
+                    sch.result(rid)
+        finally:
+            done.set()
+
+    wt = threading.Thread(target=writer)
+    wt.start()
+    snaps = 0
+    while not done.is_set() or snaps == 0:
+        snap = sch.kv_stats(limit=256)
+        snaps += 1
+        pool = snap["pool"]
+        # conservation: every block is exactly one of in-use / free /
+        # reusable — only an ATOMIC read of all three sets adds up
+        total = (pool["blocks_in_use"] + pool["blocks_free"]
+                 + pool["blocks_reusable"])
+        if total != pool["num_blocks"]:
+            failures.append(f"block conservation broke: {pool}")
+            break
+        for c in snap["chains"]:
+            if len(c["block_ids"]) != c["blocks"]:
+                failures.append(f"chain shape torn: {c}")
+            if any(not 0 <= b < pool["num_blocks"]
+                   for b in c["block_ids"]):
+                failures.append(f"chain points at bogus block: {c}")
+            if c["refs"] < 0 or c["priority"] not in (0, 1, 2):
+                failures.append(f"bad refs/priority: {c}")
+    wt.join()
+    assert not failures, failures[:3]
+    assert snaps > 20  # the reader really raced the writer
+    # quiescent cross-check: summary scalars agree with the full view
+    snap = sch.kv_stats(limit=256)
+    summ = sch.kv_stats_summary()
+    assert summ["num_blocks"] == snap["pool"]["num_blocks"]
+    assert summ["used"] == snap["pool"]["blocks_in_use"]
+    assert summ["chains"] == snap["total_chains"]
+    assert summ["prefix_blocks"] > 0  # the shared prefix is resident
+
+
+# ------------------------------------- 3-node rollup + chaos scenario
+async def _wait_for(cond, timeout=15.0, interval=0.05, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+async def _http_json(port, path):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    raw = await asyncio.wait_for(reader.read(1 << 22), timeout=5.0)
+    writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    return int(head.split()[1]), json.loads(body)
+
+
+@pytest.mark.asyncio
+async def test_three_node_fleet_rollup_and_chaos_stall_alerts(tmp_path):
+    """The ISSUE 16 acceptance scenario: validator + 2 workers on
+    localhost; /fleet serves per-node AND fleet-rolled series with
+    both retention tiers plus per-worker KV occupancy; degraded TTFT
+    on one worker fires ttft-burn on the validator; a chaos-injected
+    stall of that worker (dropped PONGs + dark sampler) fires
+    heartbeat-stale; both clear after recovery; and the stall is a
+    visible gap in the worker's own /history."""
+    from tensorlink_tpu.config import NodeConfig
+    from tensorlink_tpu.p2p.node import Node
+    from tensorlink_tpu.runtime import chaos
+
+    slo = tmp_path / "slo.json"
+    slo.write_text(json.dumps({
+        "ttft_p99_s": {"interactive": 0.1},
+        "windows_s": [1.0, 2.0],
+        "heartbeat_stale_s": 0.8,
+    }))
+
+    def ncfg(role, **kw):
+        return NodeConfig(
+            role=role, host="127.0.0.1", port=0,
+            timeseries_interval_s=0.05, **kw,
+        )
+
+    val = Node(ncfg("validator", slo_path=str(slo), http_status_port=0))
+    w1 = Node(ncfg("worker", http_status_port=0))
+    w2 = Node(ncfg("worker"))
+    # stand-in paged engine: only the locked summary surface matters
+    for w in (w1, w2):
+        w.serving = SimpleNamespace(kv_stats_summary=lambda: {
+            "num_blocks": 64, "used": 24, "free": 30, "reusable": 10,
+            "cached": 20, "occupancy": 0.375, "fragmentation": 0.25,
+            "chains": 3, "prefix_blocks": 12,
+        })
+    await val.start()
+    await w1.start()
+    await w2.start()
+    ttft = {w1.node_id: 0.02, w2.node_id: 0.02}
+
+    async def feed():
+        while True:
+            for w in (w1, w2):
+                w.metrics.observe(
+                    "serving_ttft_s:interactive.p99", ttft[w.node_id]
+                )
+                w.metrics.incr("serving_requests_total")
+            await asyncio.sleep(0.02)
+
+    feeder = asyncio.ensure_future(feed())
+    saved_chaos = []
+    try:
+        for w in (w1, w2):
+            await val.connect("127.0.0.1", w.port)
+        val.start_heartbeat(
+            interval_s=0.15, timeout_s=0.4, max_misses=10_000
+        )
+
+        # ---- phase A: healthy rollup over the heartbeat piggyback
+        def rolled_up():
+            q = val.fleet_series.query("serving_ttft_s:interactive.p99")
+            return len(q["nodes"]) == 2 and len(q["fleet"]) >= 2
+
+        await _wait_for(rolled_up, msg="fleet rollup of both workers")
+        st, fleet = await _http_json(val._http.bound_port, "/fleet")
+        assert st == 200 and len(fleet["tiers"]) >= 2
+        assert set(fleet["nodes"]) == {w1.node_id, w2.node_id}
+        for rec in fleet["nodes"].values():
+            assert rec["kv"]["occupancy"] == pytest.approx(0.375)
+            assert rec["last_seen_age_s"] < 2.0
+        assert "serving_ttft_s:interactive.p99" in fleet["series"]
+        st, q = await _http_json(
+            val._http.bound_port,
+            "/fleet?series=serving_ttft_s:interactive.p99",
+        )
+        assert st == 200 and len(q["nodes"]) == 2 and q["fleet"]
+        # counters roll up as a SUM across the two workers
+        st, q = await _http_json(
+            val._http.bound_port, "/fleet?series=serving_requests_total"
+        )
+        assert q["kind"] == "counter" and len(q["nodes"]) == 2
+        assert not val.fleet_alerts.active()
+
+        # ---- phase B: w1's TTFT degrades -> ttft-burn@w1 fires on
+        # the validator (w2, still healthy, stays clear)
+        ttft[w1.node_id] = 0.9
+        burn = f"ttft-burn:interactive@{w1.node_id}"
+        await _wait_for(
+            lambda: burn in
+            {a["name"] for a in val.fleet_alerts.active()},
+            msg="ttft-burn on the validator",
+        )
+        assert not any(
+            a["name"].endswith(f"@{w2.node_id}")
+            for a in val.fleet_alerts.active()
+        )
+
+        # ---- phase C: w1 stalls. Chaos drops its PONGs (the p2p leg)
+        # and its sampler goes dark (the telemetry leg).
+        stall_t0 = time.monotonic()
+        plan = chaos.ChaosPlan(seed=0)
+        plan.fault("p2p.send", "drop", every=1, match={"type": "PONG"})
+        chaos.arm(plan, metrics=w1.metrics)
+        # scope the process-global harness to w1 only
+        saved_chaos = [(n, n._chaos) for n in (val, w2)]
+        for n, _ in saved_chaos:
+            n._chaos = SimpleNamespace(ACTIVE=None)
+        real_sample = w1.timeseries.sample_metrics
+        w1.timeseries.sample_metrics = lambda *a, **k: None
+
+        stale = f"heartbeat-stale@{w1.node_id}"
+        await _wait_for(
+            lambda: stale in
+            {a["name"] for a in val.fleet_alerts.active()},
+            msg="heartbeat-stale on the validator",
+        )
+        # keep the sampler dark long enough to span whole ring buckets
+        await asyncio.sleep(1.6)
+        # firing alerts ride /fleet and /node for operators
+        st, fleet = await _http_json(val._http.bound_port, "/fleet")
+        assert stale in {a["name"] for a in fleet["alerts"]["fleet"]}
+        assert "alerts" in val.status()
+        stall_s = time.monotonic() - stall_t0
+
+        # ---- phase D: recovery clears both alerts
+        chaos.disarm()
+        for n, h in saved_chaos:
+            n._chaos = h
+        saved_chaos = []
+        w1.timeseries.sample_metrics = real_sample
+        ttft[w1.node_id] = 0.02
+        await _wait_for(
+            lambda: not val.fleet_alerts.active(),
+            msg="alerts clearing after recovery",
+        )
+
+        # ---- the stall is visible in w1's OWN /history: a hole, not
+        # an interpolated line
+        st, hist = await _http_json(
+            w1._http.bound_port,
+            "/history?series=serving_ttft_s:interactive.p99",
+        )
+        assert st == 200
+        times = [t for t, _ in hist["points"]]
+        gaps = [b - a for a, b in zip(times, times[1:])]
+        assert max(gaps) >= 2.0, (
+            f"stall of {stall_s:.1f}s left no gap: gaps={gaps}"
+        )
+        # normal 1 s cadence exists on both sides of the hole
+        assert sum(1 for g in gaps if g == pytest.approx(1.0)) >= 1
+        # catalog form lists the series; unknown series is a 404
+        st, cat = await _http_json(w1._http.bound_port, "/history")
+        assert "serving_ttft_s:interactive.p99" in cat["series"]
+        st, _ = await _http_json(
+            w1._http.bound_port, "/history?series=nope"
+        )
+        assert st == 404
+    finally:
+        feeder.cancel()
+        chaos.disarm()
+        for n, h in saved_chaos:
+            n._chaos = h
+        await val.stop()
+        await w1.stop()
+        await w2.stop()
