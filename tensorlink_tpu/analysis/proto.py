@@ -1,0 +1,1012 @@
+"""tlproto — wire-protocol auditor (the third leg of the stool).
+
+tlint audits source, tlhlo audits compiled programs; tlproto audits the
+*protocol*: it extracts the field-level wire schema from the AST (see
+:mod:`tensorlink_tpu.analysis.wire_schema`) and runs four rule families
+over it —
+
+- **TLP1xx field agreement**: a handler bare-indexing a field some
+  sender omits is a peer-triggerable crash (TLP101); a sender field no
+  handler reads is dead wire weight (TLP102); one field name carrying
+  conflicting value kinds across sites is a latent decode bug (TLP103).
+- **TLP2xx hostile-ingest taint**: peer-controlled fields reaching
+  pool/store/filesystem/exec-adjacent sinks without a registered
+  sanitizer (TLP201); per-frame container growth with no size clamp
+  (TLP202). Taint is intraprocedural — one function at a time, with
+  peer-response assignments (``resp = await self.request(...)``) as
+  additional sources.
+- **TLP3xx reply discipline**: handler return paths that can leak a
+  non-``{"type": ...}`` reply (TLP301); typed serving errors built on
+  the wire outside ``serve_error_to_wire`` (TLP302).
+- **TLP4xx manifest compatibility** against the committed
+  ``proto.manifest.json``: frames/fields are *pinned*; a removed frame,
+  removed field, or changed kind is a rolling-upgrade break that fails
+  CI until suppressed with ``{fingerprint, reason}``; a new frame needs
+  a pin update; a new **required** field is flagged because old peers
+  won't send it. Additive-optional is the only silent evolution.
+
+CLI mirrors tlint/tlhlo: ``tlproto [paths] --manifest --baseline
+--format text|json|github --write-manifest --write-baseline --explain
+--list-rules --list-frames``; per-line ``# tlproto: disable=TLPxxx``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+
+from tensorlink_tpu.analysis.core import (
+    Finding,
+    PackageIndex,
+    github_annotation,
+    load_baseline_reasons,
+    register_rules,
+)
+from tensorlink_tpu.analysis.wire_schema import (
+    ENVELOPE_FIELDS,
+    WireSchema,
+    collect_proto_disables,
+    extract,
+    kinds_compatible,
+)
+
+MANIFEST_NAME = "proto.manifest.json"
+BASELINE_NAME = "tlproto.baseline.json"
+PROTO_SCHEMA = 1  # manifest file format version
+
+TLP_RULES = {
+    "TLP101": (
+        "Handler bare-indexes a field some sender omits.\n\n"
+        "msg[\"x\"] on a field that at least one closed send site does "
+        "not always include raises KeyError when that sender (or any "
+        "hostile peer) omits it — a remote crash of the handler task. "
+        "Guard with msg.get / a membership check / @wire_guard, or make "
+        "every sender include the field unconditionally."
+    ),
+    "TLP102": (
+        "Sender field no handler ever reads — dead wire weight.\n\n"
+        "Every byte on a frame is paid for at every hop. A field no "
+        "handler of that frame reads (directly or via a forwarded "
+        "helper) is either vestigial (delete it) or a handler is "
+        "missing a read (bug). Frames whose handlers consume the whole "
+        "dict (iteration, dict(msg), re-send) are exempt."
+    ),
+    "TLP103": (
+        "Same field name with conflicting value kinds across sites.\n\n"
+        "One site sends \"n\" as int, another as str: whichever the "
+        "handler expects, the other is a latent decode bug — and a "
+        "mixed-version fleet will hit both. Numeric kinds "
+        "(int/float/bool) are mutually compatible; everything else "
+        "must agree."
+    ),
+    "TLP201": (
+        "Peer-controlled field reaches a sink without a sanitizer.\n\n"
+        "A field from a wire frame (or from a peer's response) flows "
+        "into DHT storage, engine submission, stream assembly, the "
+        "filesystem, or exec-adjacent calls with no registered "
+        "sanitizer on the path (sanitize_delta, kvwire schema gate, "
+        "_cap_value, validate_job_request, PeerInfo.from_wire, explicit "
+        "int()/float()/str() coercion, or an isinstance() check). "
+        "Hostile bytes must be clamped/typed before they touch shared "
+        "state."
+    ),
+    "TLP202": (
+        "Unbounded peer-fed growth — container extended per frame with "
+        "no size clamp.\n\n"
+        "A self-attached list/dict grows on every received frame with "
+        "no len() bound or comparison gate in the function: any peer "
+        "can OOM the node by looping the frame. Mirror the "
+        "sanitize_delta clamp-and-count pattern (reject + "
+        "*_rejected_total counter), or bound the container."
+    ),
+    "TLP301": (
+        "Handler return path can leak a non-typed reply.\n\n"
+        "The dispatch layer replies with whatever dict a handler "
+        "returns; a return value that is not provably None or a "
+        "{\"type\": ...} dict (dict literal with a \"type\" key, a "
+        "helper that always returns one, e.g. serve_error_to_wire) can "
+        "put an untyped frame on the wire that no peer dispatches."
+    ),
+    "TLP302": (
+        "Typed serving error built outside serve_error_to_wire.\n\n"
+        "SERVE_FAILED envelopes are hand-assembled at this site instead "
+        "of going through serving.serve_error_to_wire — the single "
+        "place that truncates messages, maps the exception taxonomy to "
+        "error_type, and attaches retry_after_s. Hand-rolled copies "
+        "drift (and already have)."
+    ),
+    "TLP401": (
+        "Frame removed — rolling-upgrade break.\n\n"
+        "A frame pinned in proto.manifest.json is no longer sent or "
+        "handled anywhere. Peers one release behind still send it "
+        "(handler removed) or still expect it (sender removed). "
+        "Suppress in the manifest with {fingerprint, reason} only after "
+        "confirming the whole fleet is past the version that used it."
+    ),
+    "TLP402": (
+        "New frame not pinned in the manifest.\n\n"
+        "A frame type appeared that proto.manifest.json does not know. "
+        "Additive, so not a break — but the manifest is the review "
+        "surface for protocol evolution: regenerate with "
+        "--write-manifest, review the diff (tldiag proto-diff), commit."
+    ),
+    "TLP403": (
+        "Pinned field removed or its kind changed — rolling-upgrade "
+        "break.\n\n"
+        "Old peers still send the field (kind change: with the old "
+        "kind) or still read it (removal). Either way a mixed-version "
+        "fleet misbehaves mid-rolling-upgrade. Suppress with a reason "
+        "in the manifest only with an explicit compatibility story "
+        "(dual-read window, version gate)."
+    ),
+    "TLP404": (
+        "New required field — old peers won't send it.\n\n"
+        "A field was added that every local sender includes and/or a "
+        "handler bare-reads, but the committed manifest predates it: "
+        "frames from peers one release behind will not carry it. Make "
+        "the handler tolerate absence (guarded read + default) until "
+        "the fleet catches up, then pin."
+    ),
+    "TLP405": (
+        "Wire schema-version pin mismatch.\n\n"
+        "A module-level *_SCHEMA integer (kvwire payload version, "
+        "timeseries delta version, capability record version) differs "
+        "from — or is missing from — the manifest's versions table. "
+        "Bumping one is a protocol event: regenerate the manifest and "
+        "review the ingest-side reject path for the old version."
+    ),
+}
+
+register_rules(TLP_RULES)
+
+
+# ===================================================================
+# TLP1xx — field agreement
+# ===================================================================
+def check_field_agreement(schema: WireSchema) -> list[Finding]:
+    out: list[Finding] = []
+    # reply frames are consumed at the REQUESTER's `resp.get(...)` site,
+    # which read analysis does not model — a send site inside a
+    # registered handler is a reply path, so its fields are exempt from
+    # dead-weight reporting (TLP102)
+    handler_fns = {
+        h.func for hs in schema.handlers.values() for h in hs
+    }
+    for frame in schema.frames():
+        sites = schema.sends.get(frame, [])
+        handlers = schema.handlers.get(frame, [])
+        closed = [s for s in sites if not s.open]
+
+        # TLP101: bare handler read vs a closed site that omits it
+        for h in handlers:
+            for fname, read in sorted(h.reads.items()):
+                if not read.bare or not closed:
+                    continue
+                omitting = [
+                    s for s in closed
+                    if fname not in s.fields
+                    or s.fields[fname].conditional
+                ]
+                if omitting:
+                    w = omitting[0]
+                    out.append(Finding(
+                        "TLP101", h.path, read.line,
+                        f"handler {h.func} bare-indexes "
+                        f"msg[{fname!r}] of {frame}, but the sender at "
+                        f"{w.path}:{w.line} does not always include it "
+                        f"— a peer omitting the field kills the "
+                        f"handler with KeyError",
+                        symbol=f"{frame}.{fname}",
+                    ))
+
+        # TLP102: sender field nobody reads
+        if handlers and not any(h.reads_all for h in handlers):
+            read_fields = set()
+            for h in handlers:
+                read_fields |= set(h.reads)
+            for s in sites:
+                if s.func.split(".")[-1] in handler_fns:
+                    continue  # reply path — consumed at request sites
+                for fname in sorted(set(s.fields) - read_fields):
+                    out.append(Finding(
+                        "TLP102", s.path, s.line,
+                        f"field {fname!r} of {frame} is sent here but "
+                        f"no handler of the frame ever reads it — dead "
+                        f"wire weight",
+                        symbol=f"{frame}.{fname}",
+                    ))
+
+        # TLP103: conflicting kinds for one field name within a frame
+        by_field: dict[str, list] = {}
+        for s in sites:
+            for fname, spec in s.fields.items():
+                by_field.setdefault(fname, []).append((s, spec.kind))
+        for fname, pairs in sorted(by_field.items()):
+            concrete = [(s, k) for s, k in pairs
+                        if k not in ("any", "none")]
+            for i, (s1, k1) in enumerate(concrete):
+                clash = next(
+                    ((s2, k2) for s2, k2 in concrete[i + 1:]
+                     if not kinds_compatible(k1, k2)), None,
+                )
+                if clash:
+                    s2, k2 = clash
+                    out.append(Finding(
+                        "TLP103", s1.path, s1.line,
+                        f"field {fname!r} of {frame} is {k1} here but "
+                        f"{k2} at {s2.path}:{s2.line} — handlers "
+                        f"cannot type it consistently",
+                        symbol=f"{frame}.{fname}",
+                    ))
+                    break
+    return out
+
+
+# ===================================================================
+# TLP2xx — hostile-ingest taint (intraprocedural)
+# ===================================================================
+_TAINT_SINKS = {
+    "put_local", "feed", "open", "exec", "eval", "loads", "system",
+    "popen", "import_prefill", "asubmit", "submit", "makedirs",
+    "unlink", "remove", "rmtree", "write_text", "write_bytes",
+}
+_TAINT_SANITIZERS = {
+    "sanitize_delta", "_sanitize_kv_summary", "unpack_kv_payload",
+    "unflatten_kv_payload", "_note_peer_capability", "_cap_value",
+    "validate_job_request", "from_wire", "int", "float", "bool",
+    "str", "len", "min", "max", "round", "unpack_arrays",
+    "_clamp_dht_value", "_serve_ids", "_serve_kwargs",
+}
+_GROWTH_METHODS = {"append", "add", "extend", "insert", "setdefault"}
+# (receiver-leaf, method) pairs whose mutation is internally bounded
+_BOUNDED_MUTATORS = {("table", "add")}
+
+
+def _leaf_name(fn: ast.AST) -> str | None:
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {
+        n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+    }
+
+
+def _calls_in(node: ast.AST) -> set[str]:
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            leaf = _leaf_name(n.func)
+            if leaf:
+                out.add(leaf)
+    return out
+
+
+def _self_rooted(node: ast.AST) -> bool:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+def _own_nodes(fn: ast.AST):
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _taint_function(
+    mod, fn: ast.AST, msg_param: str | None, frame: str | None,
+) -> list[Finding]:
+    """Intraprocedural taint: sources are the handler's msg param and
+    any ``await self.request(...)`` response; a sanitizer call anywhere
+    in an assignment's RHS (or an isinstance() check on the name)
+    clears taint; sinks and unclamped growth report."""
+    tainted: set[str] = {msg_param} if msg_param else set()
+    validated: set[str] = set()
+    has_len = False
+    compares_tainted = False
+
+    own = list(_own_nodes(fn))
+    for node in own:
+        if isinstance(node, ast.Call) and _leaf_name(node.func) == \
+                "isinstance" and node.args and \
+                isinstance(node.args[0], ast.Name):
+            validated.add(node.args[0].id)
+        if isinstance(node, ast.Call) and _leaf_name(node.func) == "len":
+            has_len = True
+
+    # fixed point over assignments + loop targets
+    for _ in range(4):
+        changed = False
+        for node in own:
+            if isinstance(node, ast.Assign):
+                refs = _names_in(node.value)
+                calls = _calls_in(node.value)
+                src = bool(refs & tainted) or any(
+                    isinstance(n, ast.Await)
+                    and isinstance(n.value, ast.Call)
+                    and _leaf_name(n.value.func) in (
+                        "request", "request_idempotent",
+                    )
+                    for n in ast.walk(node.value)
+                )
+                clean = bool(calls & _TAINT_SANITIZERS)
+                for t in node.targets:
+                    names = (
+                        [t.id] if isinstance(t, ast.Name)
+                        else [e.id for e in t.elts
+                              if isinstance(e, ast.Name)]
+                        if isinstance(t, ast.Tuple) else []
+                    )
+                    for name in names:
+                        if src and not clean and name not in tainted:
+                            tainted.add(name)
+                            changed = True
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                if _names_in(node.iter) & tainted and \
+                        isinstance(node.target, ast.Name) and \
+                        node.target.id not in tainted:
+                    tainted.add(node.target.id)
+                    changed = True
+        if not changed:
+            break
+    tainted -= validated
+
+    for node in own:
+        if isinstance(node, ast.Compare) and _names_in(node) & tainted:
+            compares_tainted = True
+
+    out: list[Finding] = []
+    ctx = f" of {frame}" if frame else ""
+    for node in own:
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = _leaf_name(node.func)
+        sink = leaf if leaf in _TAINT_SINKS else None
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        if leaf == "to_thread":
+            # await asyncio.to_thread(x.feed, a, b): the real callee is
+            # the first argument
+            for a in node.args[:1]:
+                if isinstance(a, ast.Attribute) and \
+                        a.attr in _TAINT_SINKS:
+                    sink = a.attr
+            args = list(node.args[1:])
+        if sink:
+            hot = [
+                a for a in args
+                if _names_in(a) & tainted
+                and not (_calls_in(a) & _TAINT_SANITIZERS)
+            ]
+            if hot:
+                out.append(Finding(
+                    "TLP201", mod.path, node.lineno,
+                    f"peer-controlled value{ctx} reaches sink "
+                    f"{sink}() in {fn.name} with no sanitizer on the "
+                    f"path — clamp/type it first",
+                    symbol=f"{fn.name}.{sink}",
+                ))
+        if leaf in _GROWTH_METHODS and \
+                isinstance(node.func, ast.Attribute) and \
+                _self_rooted(node.func.value):
+            recv_leaf = None
+            v = node.func.value
+            if isinstance(v, ast.Attribute):
+                recv_leaf = v.attr
+            if (recv_leaf, leaf) in _BOUNDED_MUTATORS:
+                continue
+            if any(_names_in(a) & tainted for a in args) and \
+                    not has_len and not compares_tainted:
+                out.append(Finding(
+                    "TLP202", mod.path, node.lineno,
+                    f"{fn.name} grows a self-attached container via "
+                    f".{leaf}() with peer-controlled input{ctx} and no "
+                    f"size clamp in scope — any peer can loop the "
+                    f"frame until OOM",
+                    symbol=f"{fn.name}.{leaf}",
+                ))
+    # subscript-assign growth: self.x[tainted_key] = ...
+    for node in own:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Subscript) and \
+                _self_rooted(node.targets[0].value):
+            key = node.targets[0].slice
+            if _names_in(key) & tainted and not has_len and \
+                    not compares_tainted:
+                out.append(Finding(
+                    "TLP202", mod.path, node.lineno,
+                    f"{fn.name} inserts into a self-attached mapping "
+                    f"under a peer-controlled key{ctx} with no size "
+                    f"clamp in scope",
+                    symbol=f"{fn.name}.setitem",
+                ))
+    return out
+
+
+def check_taint(index: PackageIndex, schema: WireSchema) -> list[Finding]:
+    handler_at: dict[tuple[str, str], str] = {}
+    for frame, hs in schema.handlers.items():
+        for h in hs:
+            handler_at.setdefault((h.path, h.func), frame)
+    out: list[Finding] = []
+    seen: set[tuple] = set()
+    for mod in index.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            frame = handler_at.get((mod.path, node.name))
+            msg_param = None
+            if frame is not None:
+                args = [a.arg for a in node.args.args]
+                if args and args[0] == "self":
+                    args = args[1:]
+                msg_param = args[-1] if args else None
+            elif not any(
+                isinstance(n, ast.Await)
+                and isinstance(n.value, ast.Call)
+                and _leaf_name(n.value.func) in (
+                    "request", "request_idempotent",
+                )
+                for n in _own_nodes(node)
+            ):
+                continue  # no wire-facing taint source in this fn
+            for f in _taint_function(mod, node, msg_param, frame):
+                key = (f.rule, f.path, f.symbol)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(f)
+    return out
+
+
+# ===================================================================
+# TLP3xx — reply discipline
+# ===================================================================
+# helpers that by construction return a typed reply (or coerce one):
+# serving's single error-envelope factory, and the node's runtime
+# coercion shim for dynamic reply values (stream finishers, union
+# helpers) — route unprovable returns through node._typed_reply
+_TYPED_HELPERS_SEED = {"serve_error_to_wire", "_typed_reply"}
+
+
+def _typed_dict_literal(node: ast.AST) -> bool:
+    return isinstance(node, ast.Dict) and any(
+        isinstance(k, ast.Constant) and k.value == "type"
+        for k in node.keys
+    )
+
+
+def _tuple_return_elements(
+    fn: ast.AST, idx: int,
+) -> list[ast.AST] | None:
+    """Element ``idx`` of every return, when every return is a tuple
+    literal of sufficient arity — else None (unresolvable)."""
+    out = []
+    for node in _own_nodes(fn):
+        if not isinstance(node, ast.Return):
+            continue
+        v = node.value
+        if isinstance(v, ast.Tuple) and len(v.elts) > idx:
+            out.append(v.elts[idx])
+        else:
+            return None
+    return out or None
+
+
+def _offending_returns(
+    fn: ast.AST, typed: set[str],
+    fn_defs: dict[tuple[str, str], ast.AST], path: str,
+) -> list[ast.Return]:
+    """Return statements of ``fn`` not provably None or a typed dict.
+
+    Resolves simple name bindings (including ``x, err = helper()``
+    tuple unpacking against a same-module helper whose returns are all
+    tuple literals) and calls to functions in ``typed``."""
+    nested = {
+        n.name for n in ast.walk(fn)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n is not fn and n.name in typed
+    }
+    binds: dict[str, list[ast.AST]] = {}
+    for node in _own_nodes(fn):
+        if isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and \
+                node.value is not None:
+            binds.setdefault(node.target.id, []).append(node.value)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name):
+                binds.setdefault(t.id, []).append(node.value)
+            elif isinstance(t, ast.Tuple) and \
+                    isinstance(node.value, ast.Call):
+                callee = fn_defs.get(
+                    (path, _leaf_name(node.value.func) or "")
+                )
+                for i, e in enumerate(t.elts):
+                    if not isinstance(e, ast.Name):
+                        continue
+                    elems = (
+                        _tuple_return_elements(callee, i)
+                        if callee is not None else None
+                    )
+                    binds.setdefault(e.id, []).extend(
+                        elems if elems is not None else [node.value]
+                    )
+
+    def expr_typed(v, depth=0) -> bool:
+        if v is None or (isinstance(v, ast.Constant)
+                         and v.value is None):
+            return True
+        if isinstance(v, ast.Await):
+            return expr_typed(v.value, depth)
+        if _typed_dict_literal(v):
+            return True
+        if isinstance(v, ast.Call):
+            leaf = _leaf_name(v.func)
+            return leaf in typed or leaf in nested
+        if isinstance(v, ast.Name) and depth < 3:
+            exprs = binds.get(v.id)
+            return bool(exprs) and all(
+                expr_typed(e, depth + 1) for e in exprs
+            )
+        if isinstance(v, ast.IfExp):
+            return expr_typed(v.body, depth) and \
+                expr_typed(v.orelse, depth)
+        return False
+
+    return [
+        node for node in _own_nodes(fn)
+        if isinstance(node, ast.Return) and not expr_typed(node.value)
+    ]
+
+
+def _all_typed_functions(
+    index: PackageIndex, fn_defs: dict[tuple[str, str], ast.AST],
+) -> set[str]:
+    """Names of package functions every one of whose returns is None or
+    a typed dict (directly, via bindings, or via another all-typed
+    function) — a function with no return statement always replies
+    None, which the dispatch layer treats as "no reply" (safe)."""
+    fns: dict[str, list[tuple[str, ast.AST]]] = {}
+    for mod in index.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fns.setdefault(node.name, []).append((mod.path, node))
+    typed = set(_TYPED_HELPERS_SEED)
+    for _ in range(6):
+        grew = False
+        for name, defs in fns.items():
+            if name in typed:
+                continue
+            if all(
+                not _offending_returns(fn, typed, fn_defs, path)
+                for path, fn in defs
+            ):
+                typed.add(name)
+                grew = True
+        if not grew:
+            break
+    return typed
+
+
+def check_reply_discipline(
+    index: PackageIndex, schema: WireSchema,
+) -> list[Finding]:
+    fn_defs: dict[tuple[str, str], ast.AST] = {}
+    for mod in index.modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fn_defs.setdefault((mod.path, node.name), node)
+    typed = _all_typed_functions(index, fn_defs)
+
+    out: list[Finding] = []
+    for frame in schema.frames():
+        for h in schema.handlers.get(frame, []):
+            fn = fn_defs.get((h.path, h.func))
+            if fn is None:
+                continue
+            for node in _offending_returns(fn, typed, fn_defs, h.path):
+                out.append(Finding(
+                    "TLP301", h.path, node.lineno,
+                    f"handler {h.func} ({frame}) returns a value "
+                    f"not provably None or a typed "
+                    f"{{\"type\": ...}} dict — an untyped reply "
+                    f"no peer can dispatch may reach the wire",
+                    symbol=f"{frame}.{h.func}",
+                ))
+    return out
+
+
+def check_error_envelopes(schema: WireSchema) -> list[Finding]:
+    out = []
+    for site in schema.sends.get("SERVE_FAILED", []):
+        if site.path.endswith("parallel/serving.py"):
+            continue
+        out.append(Finding(
+            "TLP302", site.path, site.line,
+            "SERVE_FAILED envelope hand-assembled here — route it "
+            "through serving.serve_error_to_wire so truncation, "
+            "error_type taxonomy, and retry_after_s cannot drift",
+            symbol=f"SERVE_FAILED.{site.func or '<module>'}",
+        ))
+    return out
+
+
+# ===================================================================
+# TLP4xx — manifest compatibility
+# ===================================================================
+def schema_record(schema: WireSchema) -> dict:
+    frames = {}
+    for frame in schema.frames():
+        frames[frame] = {
+            "fields": schema.field_schema(frame),
+            "senders": len(schema.sends.get(frame, [])),
+            "handlers": len(schema.handlers.get(frame, [])),
+        }
+    return {
+        "schema": PROTO_SCHEMA,
+        "frames": frames,
+        "versions": dict(sorted(schema.versions.items())),
+    }
+
+
+def check_manifest(
+    schema: WireSchema, manifest: dict, manifest_path: str,
+) -> list[Finding]:
+    out: list[Finding] = []
+    live = schema_record(schema)
+    pinned = manifest.get("frames", {})
+
+    for frame in sorted(set(pinned) - set(live["frames"])):
+        out.append(Finding(
+            "TLP401", manifest_path, 1,
+            f"frame {frame} is pinned in the manifest but no longer "
+            f"sent or handled — peers one release behind still use it "
+            f"(rolling-upgrade break)",
+            symbol=frame,
+        ))
+    for frame in sorted(set(live["frames"]) - set(pinned)):
+        sites = schema.sends.get(frame, [])
+        where = sites[0] if sites else None
+        out.append(Finding(
+            "TLP402", where.path if where else manifest_path,
+            where.line if where else 1,
+            f"frame {frame} is not pinned in {MANIFEST_NAME} — "
+            f"regenerate with --write-manifest and review the diff",
+            symbol=frame,
+        ))
+
+    for frame in sorted(set(pinned) & set(live["frames"])):
+        pf = pinned[frame].get("fields", {})
+        lf = live["frames"][frame]["fields"]
+        handlers = schema.handlers.get(frame, [])
+        bare_read = set()
+        for h in handlers:
+            bare_read |= {k for k, r in h.reads.items() if r.bare}
+        for fname in sorted(set(pf) - set(lf)):
+            out.append(Finding(
+                "TLP403", manifest_path, 1,
+                f"field {fname!r} of {frame} was removed — old peers "
+                f"still send or read it (rolling-upgrade break)",
+                symbol=f"{frame}.{fname}",
+            ))
+        for fname in sorted(set(pf) & set(lf)):
+            pk, lk = pf[fname].get("kind", "any"), lf[fname]["kind"]
+            if pk != lk and "any" not in (pk, lk) and \
+                    not kinds_compatible(pk, lk):
+                out.append(Finding(
+                    "TLP403", manifest_path, 1,
+                    f"field {fname!r} of {frame} changed kind "
+                    f"{pk} -> {lk} — old peers still send {pk} "
+                    f"(rolling-upgrade break)",
+                    symbol=f"{frame}.{fname}:kind",
+                ))
+        for fname in sorted(set(lf) - set(pf)):
+            if lf[fname]["required"] or fname in bare_read:
+                out.append(Finding(
+                    "TLP404", manifest_path, 1,
+                    f"new field {fname!r} of {frame} is required (or "
+                    f"bare-read by a handler) but absent from the "
+                    f"manifest — peers one release behind won't send "
+                    f"it; guard the read until the fleet catches up, "
+                    f"then re-pin",
+                    symbol=f"{frame}.{fname}",
+                ))
+
+    pv = manifest.get("versions", {})
+    for name in sorted(set(pv) | set(live["versions"])):
+        a, b = pv.get(name), live["versions"].get(name)
+        if a != b:
+            out.append(Finding(
+                "TLP405", manifest_path, 1,
+                f"wire version {name}: manifest pins {a!r}, live code "
+                f"has {b!r} — a version bump is a protocol event; "
+                f"regenerate the manifest and review the ingest-side "
+                f"reject path",
+                symbol=name,
+            ))
+    return out
+
+
+# ------------------------------------------------------------ manifest io
+def load_manifest(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if not isinstance(data, dict) or "frames" not in data:
+        raise ValueError(f"{path}: not a tlproto manifest (no 'frames')")
+    return data
+
+
+def write_manifest(path: str, schema: WireSchema) -> None:
+    """Pin the live wire schema, preserving suppress reasons."""
+    reasons: dict[str, str] = {}
+    if os.path.exists(path):
+        try:
+            reasons = load_baseline_reasons(path)
+        except (OSError, ValueError, json.JSONDecodeError):
+            reasons = {}
+    data = {
+        "comment": (
+            "Wire-protocol manifest; `tlproto` fails on drift from "
+            "these pins (removed frame/field or kind change = "
+            "rolling-upgrade break; new frame = pin update; new "
+            "required field = old peers won't send it). Regenerate "
+            "with --write-manifest, review with `tldiag proto-diff`, "
+            "and commit; accepted breaks go in 'suppress' with a "
+            "one-line reason."
+        ),
+        **schema_record(schema),
+        "suppress": [
+            {"fingerprint": fp, "reason": reasons[fp]}
+            for fp in sorted(reasons)
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2)
+        fh.write("\n")
+
+
+def _find_up(name: str, start: str = ".") -> str | None:
+    cur = os.path.abspath(start)
+    if not os.path.isdir(cur):
+        cur = os.path.dirname(cur) or "."
+    while True:
+        cand = os.path.join(cur, name)
+        if os.path.exists(cand):
+            return cand
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            return None
+        cur = nxt
+
+
+# ===================================================================
+# driver
+# ===================================================================
+def run_proto(
+    index: PackageIndex,
+    manifest: dict | None = None,
+    manifest_path: str = MANIFEST_NAME,
+) -> tuple[WireSchema, list[Finding]]:
+    schema = extract(index)
+    findings: list[Finding] = []
+    findings += check_field_agreement(schema)
+    findings += check_taint(index, schema)
+    findings += check_reply_discipline(index, schema)
+    findings += check_error_envelopes(schema)
+    if manifest is not None:
+        findings += check_manifest(schema, manifest, manifest_path)
+
+    # per-line `# tlproto: disable=` suppression
+    disables = {
+        mod.path: collect_proto_disables(mod) for mod in index.modules
+    }
+    kept = []
+    for f in findings:
+        rules = disables.get(f.path, {}).get(f.line)
+        if rules is not None and (not rules or f.rule in rules):
+            continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.symbol))
+    return schema, kept
+
+
+# ------------------------------------------------------------------ CLI
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tlproto",
+        description=(
+            "Audit the wire protocol: field-level sender/handler "
+            "agreement, hostile-ingest taint, reply discipline, and "
+            f"rolling-upgrade compatibility pinned by {MANIFEST_NAME}."
+        ),
+    )
+    p.add_argument(
+        "paths", nargs="*", default=["tensorlink_tpu"],
+        help="files or package directories to audit "
+             "(default: tensorlink_tpu)",
+    )
+    p.add_argument(
+        "--manifest", metavar="FILE", default=None,
+        help=(
+            f"manifest file (default: nearest {MANIFEST_NAME} above "
+            "the CWD; 'none' skips TLP4xx compatibility checks)"
+        ),
+    )
+    p.add_argument(
+        "--write-manifest", action="store_true",
+        help="pin the current wire schema as the manifest and exit 0 "
+             "(suppress reasons preserved)",
+    )
+    p.add_argument(
+        "--baseline", metavar="FILE", default=None,
+        help=(
+            f"baseline file (default: nearest {BASELINE_NAME} above "
+            "the CWD; 'none' reports everything)"
+        ),
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="accept the current findings as the baseline and exit 0 "
+             "(existing justifications preserved)",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json", "github"), default="text",
+    )
+    p.add_argument(
+        "--list-frames", action="store_true",
+        help="dump the extracted frame table (no rules) and exit",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="list the TLP rule ids with one-line summaries and exit",
+    )
+    p.add_argument(
+        "--explain", metavar="RULE",
+        help="print the full explanation for a rule id and exit",
+    )
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        for rule in sorted(TLP_RULES):
+            print(f"{rule}  {TLP_RULES[rule].strip().splitlines()[0]}")
+        return 0
+    if args.explain:
+        doc = TLP_RULES.get(args.explain)
+        if not doc:
+            print(f"unknown rule {args.explain}", file=sys.stderr)
+            return 2
+        print(f"{args.explain}: {doc}")
+        return 0
+
+    try:
+        index = PackageIndex.from_paths(args.paths)
+    except (OSError, SyntaxError) as e:
+        print(f"tlproto: {e}", file=sys.stderr)
+        return 2
+    if not index.modules:
+        print("tlproto: no python files found", file=sys.stderr)
+        return 2
+
+    if args.list_frames:
+        schema = extract(index)
+        for frame in schema.frames():
+            rec = schema_record(schema)["frames"][frame]
+            fields = ", ".join(
+                f"{n}:{s['kind']}{'' if s['required'] else '?'}"
+                for n, s in rec["fields"].items()
+            )
+            print(
+                f"{frame}  senders={rec['senders']} "
+                f"handlers={rec['handlers']}  [{fields}]"
+            )
+        for name, v in sorted(schema.versions.items()):
+            print(f"version {name} = {v}")
+        return 0
+
+    manifest_path = args.manifest
+    if manifest_path is None:
+        manifest_path = _find_up(MANIFEST_NAME)
+    elif manifest_path == "none":
+        manifest_path = None
+
+    if args.write_manifest:
+        schema = extract(index)
+        path = manifest_path or MANIFEST_NAME
+        write_manifest(path, schema)
+        print(
+            f"tlproto: pinned {len(schema.frames())} frame(s) and "
+            f"{len(schema.versions)} wire version(s) to {path}"
+        )
+        return 0
+
+    manifest = None
+    if manifest_path is not None:
+        try:
+            manifest = load_manifest(manifest_path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"tlproto: bad manifest: {e}", file=sys.stderr)
+            return 2
+
+    schema, findings = run_proto(
+        index, manifest, manifest_path or MANIFEST_NAME,
+    )
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        baseline_path = _find_up(BASELINE_NAME)
+    elif baseline_path == "none":
+        baseline_path = None
+
+    if args.write_baseline:
+        from tensorlink_tpu.analysis.core import write_baseline
+        path = baseline_path or BASELINE_NAME
+        write_baseline(path, findings)
+        print(
+            f"tlproto: accepted {len(findings)} finding(s) into {path}"
+        )
+        return 0
+
+    suppressed: dict[str, str] = {}
+    if baseline_path is not None:
+        try:
+            suppressed.update(load_baseline_reasons(baseline_path))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"tlproto: bad baseline: {e}", file=sys.stderr)
+            return 2
+    if manifest is not None:
+        for e in manifest.get("suppress", []):
+            if isinstance(e, dict) and "fingerprint" in e:
+                suppressed[e["fingerprint"]] = e.get("reason", "")
+            elif isinstance(e, str):
+                suppressed[e] = ""
+
+    fresh = [f for f in findings if f.fingerprint not in suppressed]
+    known = len(findings) - len(fresh)
+    unexplained = sorted(
+        fp for fp, why in suppressed.items() if not why.strip()
+    )
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_json() for f in fresh],
+            "frames": len(schema.frames()),
+            "suppressed": known,
+            "unexplained_suppressions": unexplained,
+        }, indent=2))
+    else:
+        for f in fresh:
+            if args.format == "github":
+                print(github_annotation(f, tool="tlproto"))
+            else:
+                print(f)
+        for fp in unexplained:
+            print(
+                f"tlproto: warning: suppression without a reason: {fp}",
+                file=sys.stderr,
+            )
+        tail = f" ({known} suppressed)" if known else ""
+        print(
+            f"tlproto: {len(fresh)} finding(s) over "
+            f"{len(schema.frames())} frame(s){tail}"
+        )
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

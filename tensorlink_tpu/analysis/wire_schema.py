@@ -1,0 +1,802 @@
+"""Wire-protocol schema extraction (tlproto's eyes).
+
+The p2p layer speaks hand-rolled msgpack dicts: a frame is a dict with a
+literal UPPERCASE ``"type"`` dispatched to a handler registered via
+``self.on("TYPE", fn)``; replies are dicts returned from handlers. This
+module recovers the *field-level* contract from the AST — which fields
+every send site constructs (required vs conditionally-present, inferred
+value kind) and which fields every handler reads (bare ``msg["x"]``
+index vs guarded ``.get``/membership/``wire_guard`` access, including
+fields forwarded into helpers one call deep) — so
+:mod:`tensorlink_tpu.analysis.proto` can run agreement/taint/manifest
+rules over it.
+
+Explicit limits (documented in the README rule catalog):
+
+- senders are **dict literals** with a literal ``"type"`` key — a frame
+  assembled field-by-field from an empty dict, or forwarded verbatim
+  from another peer, is invisible (mark such sites with
+  ``# tlproto: disable=...`` at the handler instead);
+- taint and read analysis are **intraprocedural** plus ONE level of
+  helper forwarding (``self._helper(msg)``);
+- a dict splat (``{**base, ...}``) or a frame dict passed to a non-send
+  helper marks the site *open*: its field set is a lower bound, so
+  field-agreement rules never conclude "omitted" from it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+
+from tensorlink_tpu.analysis.core import ModuleInfo, PackageIndex
+from tensorlink_tpu.analysis.dataflow import class_units
+
+# transport-level fields injected/consumed by the dispatch layer itself,
+# never part of a frame's application schema
+ENVELOPE_FIELDS = {"type", "id", "re", "_trace"}
+
+# frame types are SHOUTY_SNAKE by convention; lowercase "type" dicts
+# (flight events, config records) are not wire frames
+_FRAME_RE = re.compile(r"^[A-Z][A-Z0-9_]{2,40}$")
+
+# methods that put a dict on the wire as-is (the dict stays closed)
+_SEND_METHODS = {"send", "request", "request_idempotent"}
+
+# value kinds: msgpack-level type of a field. "any" = not statically
+# known; "none" = None literal (an absent-marker, compatible with all).
+_NUMERIC = {"int", "float", "bool"}
+
+_CALL_KINDS = {
+    "int": "int", "float": "float", "bool": "bool", "str": "str",
+    "bytes": "bytes", "len": "int", "round": "float", "abs": "any",
+    "list": "list", "sorted": "list", "tuple": "list", "set": "list",
+    "dict": "dict", "pack_arrays": "bytes", "pack_kv_payload": "bytes",
+    "time": "float", "perf_counter": "float", "monotonic": "float",
+    "to_wire": "dict",
+}
+
+
+def kinds_compatible(a: str, b: str) -> bool:
+    if a in ("any", "none") or b in ("any", "none"):
+        return True
+    if a == b:
+        return True
+    return a in _NUMERIC and b in _NUMERIC
+
+
+def merge_kinds(kinds) -> str:
+    """Canonical kind for a field seen with several inferred kinds."""
+    concrete = {k for k in kinds if k not in ("any", "none")}
+    if not concrete:
+        return "any"
+    if len(concrete) == 1:
+        return next(iter(concrete))
+    if concrete <= _NUMERIC:
+        return "number"
+    return "any"  # conflicting — TLP103's business, not the manifest's
+
+
+def infer_kind(node: ast.AST) -> str:
+    """msgpack-level kind of a field value expression, best effort."""
+    if isinstance(node, ast.Constant):
+        v = node.value
+        if isinstance(v, bool):
+            return "bool"
+        if isinstance(v, int):
+            return "int"
+        if isinstance(v, float):
+            return "float"
+        if isinstance(v, str):
+            return "str"
+        if isinstance(v, (bytes, bytearray)):
+            return "bytes"
+        if v is None:
+            return "none"
+        return "any"
+    if isinstance(node, ast.JoinedStr):
+        return "str"
+    if isinstance(node, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(node, (ast.List, ast.Tuple, ast.ListComp, ast.Set,
+                         ast.SetComp, ast.GeneratorExp)):
+        return "list"
+    if isinstance(node, ast.UnaryOp):
+        if isinstance(node.op, ast.Not):
+            return "bool"
+        return infer_kind(node.operand)
+    if isinstance(node, ast.Compare):
+        return "bool"
+    if isinstance(node, ast.IfExp):
+        a, b = infer_kind(node.body), infer_kind(node.orelse)
+        return a if kinds_compatible(a, b) and a not in ("any", "none") \
+            else (b if a in ("any", "none") else "any")
+    if isinstance(node, ast.Call):
+        fn = node.func
+        leaf = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if leaf in _CALL_KINDS:
+            return _CALL_KINDS[leaf]
+        return "any"
+    if isinstance(node, ast.Subscript):
+        # str(...)[:300]-style truncation keeps the str kind
+        if infer_kind(node.value) == "str":
+            return "str"
+        return "any"
+    if isinstance(node, ast.BinOp):
+        a, b = infer_kind(node.left), infer_kind(node.right)
+        if a == "str" or b == "str":
+            return "str"
+        if a in _NUMERIC and b in _NUMERIC:
+            return "float" if "float" in (a, b) else "int"
+        return "any"
+    return "any"
+
+
+# ===================================================================
+# data model
+# ===================================================================
+@dataclass
+class FieldSpec:
+    kind: str
+    conditional: bool = False
+
+
+@dataclass
+class SendSite:
+    frame: str
+    path: str       # module path (Finding-compatible)
+    func: str       # enclosing function qualname ("" at module level)
+    line: int
+    fields: dict[str, FieldSpec] = field(default_factory=dict)
+    # True when the literal field set is only a LOWER bound: a **splat,
+    # an .update(<non-literal>), or the dict escaping into a non-send
+    # helper that may add fields
+    open: bool = False
+
+
+@dataclass
+class FieldRead:
+    bare: bool
+    line: int
+
+
+@dataclass
+class HandlerSchema:
+    frame: str
+    path: str
+    func: str
+    line: int
+    reads: dict[str, FieldRead] = field(default_factory=dict)
+    # handler consumes the whole dict (iteration / dict(msg) / **msg /
+    # forwarding into an unresolvable callee): every sender field is
+    # "read" as far as dead-weight analysis can tell
+    reads_all: bool = False
+    # def carries the runtime malformed-frame backstop (@wire_guard):
+    # a missing/mistyped field produces a typed ERROR, not a crash
+    wire_guarded: bool = False
+
+
+@dataclass
+class WireSchema:
+    sends: dict[str, list[SendSite]] = field(default_factory=dict)
+    handlers: dict[str, list[HandlerSchema]] = field(default_factory=dict)
+    # module-level `*_SCHEMA = <int>` wire-version pins
+    versions: dict[str, int] = field(default_factory=dict)
+
+    def frames(self) -> list[str]:
+        return sorted(set(self.sends) | set(self.handlers))
+
+    def field_schema(self, frame: str) -> dict[str, dict]:
+        """Per-field ``{"kind", "required"}`` union over send sites.
+        A field is required only if every site names it unconditionally;
+        open sites cannot prove absence, but a field they *do* name
+        still counts toward presence."""
+        sites = self.sends.get(frame, [])
+        out: dict[str, dict] = {}
+        names: set[str] = set()
+        for s in sites:
+            names |= set(s.fields)
+        for f in sorted(names):
+            kinds = [s.fields[f].kind for s in sites if f in s.fields]
+            required = bool(sites) and all(
+                f in s.fields and not s.fields[f].conditional
+                for s in sites
+            )
+            out[f] = {"kind": merge_kinds(kinds), "required": required}
+        return out
+
+
+# ===================================================================
+# per-line `# tlproto: disable=` directives (tlint's grammar, our tool)
+# ===================================================================
+_DISABLE_MARK = "tlproto: disable="
+
+
+def collect_proto_disables(mod: ModuleInfo) -> dict[int, set[str]]:
+    """line -> rule ids disabled by a trailing `# tlproto:` comment
+    (empty set = blanket disable)."""
+    out: dict[int, set[str]] = {}
+    try:
+        for tok in tokenize.generate_tokens(StringIO(mod.source).readline):
+            if tok.type != tokenize.COMMENT or "tlproto:" not in tok.string:
+                continue
+            text = tok.string
+            if not text.lstrip("#").lstrip().startswith("tlproto:"):
+                continue
+            if _DISABLE_MARK in text:
+                spec = text.split(_DISABLE_MARK, 1)[1].split("#")[0]
+                rules = set()
+                for chunk in spec.replace(",", " ").split():
+                    if chunk.startswith("TLP") and chunk[3:].isdigit():
+                        rules.add(chunk)
+                    else:
+                        break  # free-form justification starts here
+                if rules:
+                    out[tok.start[0]] = rules
+            elif text.split("tlproto:", 1)[1].strip() == "disable":
+                out[tok.start[0]] = set()
+    except tokenize.TokenizeError:  # pragma: no cover — parse passed
+        pass
+    return out
+
+
+# ===================================================================
+# send-site extraction
+# ===================================================================
+def _iter_scopes(mod: ModuleInfo):
+    """(qualname, scope_node) for the module and every def, each scope
+    excluding its nested defs (they get their own entry)."""
+    yield "", mod.tree
+    stack: list[tuple[str, ast.AST]] = [("", mod.tree)]
+    while stack:
+        prefix, node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                stack.append((f"{prefix}{child.name}." if prefix else
+                              f"{child.name}.", child))
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                yield q, child
+                stack.append((f"{q}.", child))
+            else:
+                stack.append((prefix, child))
+
+
+def _own_statements(scope: ast.AST):
+    """Nodes of this scope only — nested defs are separate scopes."""
+    body = scope.body if hasattr(scope, "body") else []
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _frame_types(value: ast.AST) -> list[str]:
+    """Literal frame name(s) of a dict's "type" value (IfExp = both)."""
+    if isinstance(value, ast.Constant) and isinstance(value.value, str):
+        return [value.value] if _FRAME_RE.match(value.value) else []
+    if isinstance(value, ast.IfExp):
+        return _frame_types(value.body) + _frame_types(value.orelse)
+    return []
+
+
+def _typed_dict_frames(d: ast.Dict) -> list[str]:
+    for k, v in zip(d.keys, d.values):
+        if isinstance(k, ast.Constant) and k.value == "type":
+            return _frame_types(v)
+    return []
+
+
+def extract_send_sites(mod: ModuleInfo) -> list[SendSite]:
+    sites: list[SendSite] = []
+    for qual, scope in _iter_scopes(mod):
+        if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Module)):
+            continue
+        # parent map for THIS scope (cheap: scopes are small)
+        parents: dict[ast.AST, ast.AST] = {}
+        for node in _own_statements(scope):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        named: dict[str, SendSite] = {}
+        dict_sites: list[tuple[ast.Dict, SendSite]] = []
+        for node in _own_statements(scope):
+            if not isinstance(node, ast.Dict):
+                continue
+            frames = _typed_dict_frames(node)
+            if not frames:
+                continue
+            base = SendSite(
+                frame=frames[0], path=mod.path, func=qual,
+                line=node.lineno,
+            )
+            for k, v in zip(node.keys, node.values):
+                if k is None:  # **splat
+                    base.open = True
+                    continue
+                if not (isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)):
+                    base.open = True
+                    continue
+                if k.value in ENVELOPE_FIELDS:
+                    continue
+                base.fields[k.value] = FieldSpec(kind=infer_kind(v))
+            for fr in frames:
+                site = SendSite(
+                    frame=fr, path=base.path, func=base.func,
+                    line=base.line, open=base.open,
+                    fields={
+                        n: FieldSpec(s.kind, s.conditional)
+                        for n, s in base.fields.items()
+                    },
+                )
+                sites.append(site)
+                dict_sites.append((node, site))
+            # named-dict tracking: `out = {...}` then `out["x"] = v`
+            parent = parents.get(node)
+            if (
+                isinstance(parent, ast.Assign)
+                and len(parent.targets) == 1
+                and isinstance(parent.targets[0], ast.Name)
+            ):
+                for _, site in dict_sites[-len(frames):]:
+                    named[parent.targets[0].id] = site
+        # second pass over the same scope: conditional fields + escapes
+        for node in _own_statements(scope):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if (
+                    isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id in named
+                    and isinstance(t.slice, ast.Constant)
+                    and isinstance(t.slice.value, str)
+                ):
+                    site = named[t.value.id]
+                    if t.slice.value not in ENVELOPE_FIELDS:
+                        site.fields.setdefault(
+                            t.slice.value,
+                            FieldSpec(infer_kind(node.value),
+                                      conditional=True),
+                        )
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                leaf = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None
+                )
+                if leaf == "setdefault" and isinstance(fn, ast.Attribute) \
+                        and isinstance(fn.value, ast.Name) \
+                        and fn.value.id in named and node.args \
+                        and isinstance(node.args[0], ast.Constant):
+                    key = node.args[0].value
+                    if isinstance(key, str) and key not in ENVELOPE_FIELDS:
+                        k = (infer_kind(node.args[1])
+                             if len(node.args) > 1 else "any")
+                        named[fn.value.id].fields.setdefault(
+                            key, FieldSpec(k, conditional=True)
+                        )
+                elif leaf == "update" and isinstance(fn, ast.Attribute) \
+                        and isinstance(fn.value, ast.Name) \
+                        and fn.value.id in named:
+                    site = named[fn.value.id]
+                    lit = node.args[0] if node.args else None
+                    if isinstance(lit, ast.Dict):
+                        for k, v in zip(lit.keys, lit.values):
+                            if isinstance(k, ast.Constant) and \
+                                    isinstance(k.value, str) and \
+                                    k.value not in ENVELOPE_FIELDS:
+                                site.fields.setdefault(
+                                    k.value,
+                                    FieldSpec(infer_kind(v),
+                                              conditional=True),
+                                )
+                            else:
+                                site.open = True
+                    else:
+                        site.open = True
+                    for kw in node.keywords:
+                        if kw.arg and kw.arg not in ENVELOPE_FIELDS:
+                            site.fields.setdefault(
+                                kw.arg,
+                                FieldSpec(infer_kind(kw.value),
+                                          conditional=True),
+                            )
+                elif leaf not in _SEND_METHODS:
+                    # frame dict escaping into a non-send call: the
+                    # callee may add fields — the set is a lower bound
+                    for a in node.args:
+                        if isinstance(a, ast.Name) and a.id in named:
+                            named[a.id].open = True
+                        for n2, site in dict_sites:
+                            if a is n2:
+                                site.open = True
+            elif isinstance(node, ast.Dict):
+                # {**out, ...}: splatted into another frame literal
+                for k, v in zip(node.keys, node.values):
+                    if k is None and isinstance(v, ast.Name) \
+                            and v.id in named:
+                        named[v.id].open = True
+    return sites
+
+
+# ===================================================================
+# handler resolution + read extraction
+# ===================================================================
+def _registrations(mod: ModuleInfo):
+    """(frame, class_name_or_None, handler_attr_or_name, line) from
+    every ``self.on("TYPE", self._h_x)``-style call in the module."""
+    classes: dict[ast.AST, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef):
+            for inner in ast.walk(node):
+                classes.setdefault(inner, node.name)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "on"):
+            continue
+        if len(node.args) != 2:
+            continue
+        frame_arg, handler_arg = node.args
+        if not (isinstance(frame_arg, ast.Constant)
+                and isinstance(frame_arg.value, str)
+                and _FRAME_RE.match(frame_arg.value)):
+            continue
+        if isinstance(handler_arg, ast.Attribute):
+            name = handler_arg.attr
+        elif isinstance(handler_arg, ast.Name):
+            name = handler_arg.id
+        else:
+            continue
+        yield frame_arg.value, classes.get(node), name, node.lineno
+
+
+def _method_table(index: PackageIndex) -> dict[str, list]:
+    """method name -> [(ModuleInfo, FunctionDef)] across every class
+    hierarchy (class_units merges package-resolvable bases)."""
+    table: dict[str, list] = {}
+    for unit in class_units(index):
+        for name, defs in unit.methods.items():
+            table.setdefault(name, []).extend(defs)
+    return table
+
+
+# exception types whose `except` actually intercepts a missing-field
+# bare index (KeyError). A `try/except ValueError` around `msg["x"]`
+# does NOT stop a hostile peer omitting "x" from crashing the handler.
+_GUARDY_EXCEPTIONS = {
+    "KeyError", "LookupError", "Exception", "BaseException",
+}
+
+
+def _is_wire_guard_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    name = dec.attr if isinstance(dec, ast.Attribute) else (
+        dec.id if isinstance(dec, ast.Name) else None
+    )
+    return name == "wire_guard"
+
+
+def _handler_msg_param(fn: ast.AST) -> str | None:
+    args = [a.arg for a in fn.args.args]
+    if args and args[0] == "self":
+        args = args[1:]
+    # dispatch calls handler(node, peer, msg); helpers get msg last too
+    return args[-1] if args else None
+
+
+class _ReadCollector:
+    """Collect field reads of one dict parameter inside one function,
+    guard-aware: reads under ``try/except KeyError`` (et al.), under a
+    membership check, via ``.get``, or inside a @wire_guard def count
+    as guarded."""
+
+    def __init__(self, fn: ast.AST, param: str,
+                 helper_resolver=None):
+        self.fn = fn
+        self.param = param
+        self.aliases = {param}
+        self.reads: dict[str, FieldRead] = {}
+        self.reads_all = False
+        self.helper_resolver = helper_resolver
+        self.guarded_def = any(
+            _is_wire_guard_decorator(d)
+            for d in getattr(fn, "decorator_list", [])
+        )
+
+    def note(self, name: str, bare: bool, line: int) -> None:
+        if name in ENVELOPE_FIELDS:
+            return
+        prev = self.reads.get(name)
+        if prev is None or (bare and not prev.bare):
+            self.reads[name] = FieldRead(bare=bare, line=line)
+
+    def run(self) -> None:
+        self._walk_body(self.fn.body, guarded=self.guarded_def,
+                        checked=frozenset())
+
+    # -------------------------------------------------------- walking
+    def _is_msg(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Name) and node.id in self.aliases
+
+    def _membership_fields(self, test: ast.AST) -> set[str]:
+        """Fields proven present by an if-test ('"x" in msg' and
+        `msg.get("x") is not None` forms, incl. `and` chains)."""
+        out: set[str] = set()
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            for v in test.values:
+                out |= self._membership_fields(v)
+            return out
+        if isinstance(test, ast.Compare) and len(test.ops) == 1:
+            if isinstance(test.ops[0], ast.In) and \
+                    isinstance(test.left, ast.Constant) and \
+                    isinstance(test.left.value, str) and \
+                    self._is_msg(test.comparators[0]):
+                out.add(test.left.value)
+            if isinstance(test.ops[0], (ast.IsNot, ast.NotEq)) and \
+                    isinstance(test.left, ast.Call):
+                f = test.left.func
+                if isinstance(f, ast.Attribute) and f.attr == "get" and \
+                        self._is_msg(f.value) and test.left.args and \
+                        isinstance(test.left.args[0], ast.Constant):
+                    out.add(test.left.args[0].value)
+        return out
+
+    def _walk_body(self, stmts, guarded: bool, checked: frozenset) -> None:
+        for s in stmts:
+            self._walk(s, guarded, checked)
+
+    def _walk(self, node: ast.AST, guarded: bool,
+              checked: frozenset) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def sharing the closure: analyze with same context
+            self._walk_body(node.body, guarded, checked)
+            return
+        if isinstance(node, ast.Lambda):
+            self._walk(node.body, guarded, checked)
+            return
+        if isinstance(node, ast.Try):
+            catches = any(
+                h.type is None or any(
+                    n in _GUARDY_EXCEPTIONS
+                    for n in self._exc_names(h.type)
+                )
+                for h in node.handlers
+            )
+            self._walk_body(node.body, guarded or catches, checked)
+            for h in node.handlers:
+                self._walk_body(h.body, guarded, checked)
+            self._walk_body(node.orelse, guarded, checked)
+            self._walk_body(node.finalbody, guarded, checked)
+            return
+        if isinstance(node, ast.If):
+            self._expr(node.test, guarded, checked)
+            proven = self._membership_fields(node.test)
+            self._walk_body(node.body, guarded,
+                            checked | frozenset(proven))
+            self._walk_body(node.orelse, guarded, checked)
+            return
+        if isinstance(node, ast.Assign):
+            self._expr(node.value, guarded, checked)
+            # alias tracking: m = msg
+            if len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    self._is_msg(node.value):
+                self.aliases.add(node.targets[0].id)
+            for t in node.targets:
+                self._expr(t, guarded, checked, store=True)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, guarded, checked)
+            else:
+                self._walk(child, guarded, checked)
+
+    @staticmethod
+    def _exc_names(t: ast.AST) -> list[str]:
+        if isinstance(t, ast.Tuple):
+            out = []
+            for e in t.elts:
+                out.extend(_ReadCollector._exc_names(e))
+            return out
+        if isinstance(t, ast.Name):
+            return [t.id]
+        if isinstance(t, ast.Attribute):
+            return [t.attr]
+        return []
+
+    def _expr(self, node: ast.AST, guarded: bool, checked: frozenset,
+              store: bool = False) -> None:
+        if isinstance(node, ast.Subscript) and self._is_msg(node.value):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                if not store:
+                    bare = (not guarded) and sl.value not in checked
+                    self.note(sl.value, bare, node.lineno)
+                return
+            self.reads_all = True  # dynamic key
+            return
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and self._is_msg(fn.value):
+                if fn.attr == "get" and node.args and \
+                        isinstance(node.args[0], ast.Constant) and \
+                        isinstance(node.args[0].value, str):
+                    self.note(node.args[0].value, False, node.lineno)
+                    for a in node.args[1:]:
+                        self._expr(a, guarded, checked)
+                    return
+                if fn.attr in ("items", "keys", "values", "copy"):
+                    self.reads_all = True
+                    return
+            # whole-dict forwarding: helper(msg) / dict(msg) / self._f(msg)
+            forwarded_pos = None
+            for i, a in enumerate(node.args):
+                if self._is_msg(a):
+                    forwarded_pos = i
+            if forwarded_pos is not None:
+                leaf = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None
+                )
+                if leaf in ("dict",):
+                    self.reads_all = True
+                elif leaf in _SEND_METHODS or leaf in (
+                    "isinstance", "len", "bool",
+                ):
+                    pass  # re-send / size probe, not a field read
+                elif self.helper_resolver is not None:
+                    sub = self.helper_resolver(leaf, forwarded_pos)
+                    if sub is None:
+                        self.reads_all = True
+                    else:
+                        for fname, r in sub.reads.items():
+                            self.note(fname, r.bare and not guarded,
+                                      r.line)
+                        self.reads_all |= sub.reads_all
+                else:
+                    self.reads_all = True
+            for child in ast.iter_child_nodes(node):
+                self._expr(child, guarded, checked)
+            return
+        if isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.In, ast.NotIn)) and \
+                isinstance(node.left, ast.Constant) and \
+                isinstance(node.left.value, str) and \
+                self._is_msg(node.comparators[0]):
+            self.note(node.left.value, False, node.lineno)
+            return
+        if isinstance(node, (ast.For, ast.AsyncFor)) and \
+                self._is_msg(getattr(node, "iter", None)):
+            self.reads_all = True
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if k is None and self._is_msg(v):
+                    self.reads_all = True
+                elif k is not None:
+                    self._expr(k, guarded, checked)
+                if not (k is None and self._is_msg(v)):
+                    self._expr(v, guarded, checked)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, guarded, checked)
+            elif isinstance(child, (ast.comprehension,)):
+                self._expr(child.iter, guarded, checked)
+                for c in child.ifs:
+                    self._expr(c, guarded, checked)
+            else:
+                self._walk(child, guarded, checked)
+
+
+def analyze_handler(
+    mod: ModuleInfo, fn: ast.AST, frame: str,
+    method_table: dict[str, list] | None = None,
+    _depth: int = 0,
+) -> HandlerSchema:
+    param = _handler_msg_param(fn)
+    h = HandlerSchema(
+        frame=frame, path=mod.path, func=fn.name, line=fn.lineno,
+        wire_guarded=any(
+            _is_wire_guard_decorator(d) for d in fn.decorator_list
+        ),
+    )
+    if param is None:
+        return h
+
+    nested = {
+        n.name: n for n in ast.walk(fn)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and n is not fn
+    }
+
+    def resolver(name: str | None, pos: int):
+        if name is None or _depth >= 1:
+            return None
+        targets: list[tuple[ModuleInfo, ast.AST]] = []
+        if name in nested:
+            targets = [(mod, nested[name])]
+        elif method_table and name in method_table:
+            # EVERY def with the name: base-class hooks are overridden
+            # per role (handle_kv_blocks), and "which override runs" is
+            # not statically known — the union of their reads is
+            targets = list(method_table[name])
+        if not targets:
+            return None
+        out = HandlerSchema(frame=frame, path=targets[0][0].path,
+                            func=name, line=targets[0][1].lineno)
+        for tmod, target in targets:
+            args = [a.arg for a in target.args.args]
+            if args and args[0] == "self":
+                args = args[1:]
+            if pos >= len(args):
+                # lands in *args or defaults — give up conservatively
+                return None
+            col = _ReadCollector(target, args[pos])
+            col.guarded_def = col.guarded_def or any(
+                _is_wire_guard_decorator(d)
+                for d in target.decorator_list
+            )
+            col.run()
+            for fname, r in col.reads.items():
+                prev = out.reads.get(fname)
+                if prev is None or (r.bare and not prev.bare):
+                    out.reads[fname] = r
+            out.reads_all |= col.reads_all
+        return out
+
+    col = _ReadCollector(fn, param, helper_resolver=resolver)
+    col.run()
+    h.reads = col.reads
+    h.reads_all = col.reads_all
+    return h
+
+
+# ===================================================================
+# whole-package extraction
+# ===================================================================
+def extract(index: PackageIndex) -> WireSchema:
+    schema = WireSchema()
+    table = _method_table(index)
+    for mod in index.modules:
+        for site in extract_send_sites(mod):
+            schema.sends.setdefault(site.frame, []).append(site)
+        for frame, _cls, name, _line in _registrations(mod):
+            defs = table.get(name) or []
+            # prefer a def from the registering module's hierarchy;
+            # fall back to any def with the name
+            if not defs:
+                defs = [
+                    (m2, fn2)
+                    for m2 in index.modules
+                    for fn2 in ast.walk(m2.tree)
+                    if isinstance(fn2, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef))
+                    and fn2.name == name
+                ][:1]
+            for tmod, fn in defs[:1]:
+                h = analyze_handler(tmod, fn, frame, table)
+                existing = schema.handlers.setdefault(frame, [])
+                if not any(e.path == h.path and e.func == h.func
+                           for e in existing):
+                    existing.append(h)
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.endswith("_SCHEMA") \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, int):
+                schema.versions[node.targets[0].id] = node.value.value
+    for sites in schema.sends.values():
+        sites.sort(key=lambda s: (s.path, s.line))
+    return schema

@@ -619,6 +619,86 @@ def render_manifest_diff(diff: dict) -> str:
     return "\n".join(lines)
 
 
+def proto_manifest_diff(old: dict, new: dict) -> dict[str, Any]:
+    """Rolling-upgrade verdicts between two tlproto proto.manifest.json
+    files. The compatibility contract (analysis/proto.py TLP4xx): a
+    frame or field removal, a value-kind change, an optional field
+    turning required, a new required field, or a wire-version bump all
+    BREAK mixed-version fleets — an old peer still sends (or bare-reads)
+    the old shape. A new frame only needs its pin recorded; a new
+    optional field is the one silent evolution the contract allows."""
+    a = old.get("frames", {})
+    b = new.get("frames", {})
+    breaks: list[str] = []
+    pins: list[str] = []
+    ok: list[str] = []
+    frames: dict[str, Any] = {}
+    for name in sorted(set(a) - set(b)):
+        breaks.append(f"{name}: frame removed")
+    for name in sorted(set(b) - set(a)):
+        pins.append(f"{name}: frame added")
+    for name in sorted(set(a) & set(b)):
+        fa = a[name].get("fields", {})
+        fb = b[name].get("fields", {})
+        verdicts: dict[str, str] = {}
+        for f in sorted(set(fa) - set(fb)):
+            verdicts[f] = "removed"
+            breaks.append(f"{name}.{f}: field removed")
+        for f in sorted(set(fb) - set(fa)):
+            if fb[f].get("required"):
+                verdicts[f] = "added-required"
+                breaks.append(
+                    f"{name}.{f}: new required field (old senders omit it)"
+                )
+            else:
+                verdicts[f] = "added-optional"
+                ok.append(f"{name}.{f}: optional field added")
+        for f in sorted(set(fa) & set(fb)):
+            ka, kb = fa[f].get("kind"), fb[f].get("kind")
+            if ka != kb and "any" not in (ka, kb):
+                verdicts[f] = f"kind {ka}->{kb}"
+                breaks.append(f"{name}.{f}: kind changed {ka} -> {kb}")
+            elif not fa[f].get("required") and fb[f].get("required"):
+                verdicts[f] = "now-required"
+                breaks.append(
+                    f"{name}.{f}: optional field turned required"
+                )
+        if verdicts:
+            frames[name] = verdicts
+    va = old.get("versions", {})
+    vb = new.get("versions", {})
+    for k in sorted(set(va) | set(vb)):
+        if va.get(k) != vb.get(k):
+            breaks.append(
+                f"version {k}: {va.get(k)} -> {vb.get(k)}"
+            )
+    return {
+        "breaks": breaks, "pins": pins, "ok": ok, "frames": frames,
+        "compatible": not breaks,
+    }
+
+
+def render_proto_diff(diff: dict) -> str:
+    lines = [
+        f"proto diff: {len(diff['breaks'])} break(s), "
+        f"{len(diff['pins'])} pin update(s), "
+        f"{len(diff['ok'])} compatible change(s)"
+    ]
+    for item in diff["breaks"]:
+        lines.append(f"  BREAK {item}")
+    for item in diff["pins"]:
+        lines.append(f"  pin   {item}")
+    for item in diff["ok"]:
+        lines.append(f"  ok    {item}")
+    if diff["compatible"]:
+        lines.append("  rolling upgrade: safe (additive-optional only)")
+    else:
+        lines.append(
+            "  rolling upgrade: UNSAFE — drain the fleet or version-gate"
+        )
+    return "\n".join(lines)
+
+
 def latest_bench_record(root: str) -> tuple[str, dict] | None:
     """Newest USABLE committed BENCH_r*.json under ``root`` (descending
     round order; a round whose payload has no headline value or recorded
@@ -1055,6 +1135,16 @@ def main(argv: list[str] | None = None) -> int:
                          "measurement regresses (default 5%%)")
     md.add_argument("--json", action="store_true", dest="as_json",
                     help="print the full diff as JSON")
+    pd = sub.add_parser(
+        "proto-diff",
+        help="rolling-upgrade verdicts between two tlproto "
+             "proto.manifest.json (removals/kind changes break, "
+             "additive-optional is safe); exit 1 on breaks",
+    )
+    pd.add_argument("old")
+    pd.add_argument("new")
+    pd.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the full diff as JSON")
     wa = sub.add_parser(
         "watch",
         help="live fleet dashboard: poll a validator's /fleet and "
@@ -1141,6 +1231,16 @@ def main(argv: list[str] | None = None) -> int:
             else render_manifest_diff(diff)
         )
         return 0
+    if args.cmd == "proto-diff":
+        with open(args.old) as f:
+            old = json.load(f)
+        with open(args.new) as f:
+            new = json.load(f)
+        diff = proto_manifest_diff(old, new)
+        print(
+            json.dumps(diff) if args.as_json else render_proto_diff(diff)
+        )
+        return 0 if diff["compatible"] else 1
     if args.cmd == "watch":
         series = tuple(args.series) if args.series else WATCH_SERIES
         try:

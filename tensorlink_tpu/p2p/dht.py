@@ -48,14 +48,34 @@ class PeerInfo:
             d["alt_hosts"] = list(self.alt_hosts)
         return d
 
+    # wire-record clamps: peer lists and DHT values carry these records
+    # from untrusted peers, and every parsed one is held in the routing
+    # table — bound each field so a hostile record cannot smuggle
+    # megabyte strings into memory (tlproto registered sanitizer)
+    MAX_ID_LEN = 128
+    MAX_ROLE_LEN = 32
+    MAX_HOST_LEN = 256
+    MAX_ALT_HOSTS = 8
+
     @classmethod
     def from_wire(cls, d: dict) -> "PeerInfo":
+        """Parse an untrusted wire record. Raises KeyError/TypeError/
+        ValueError on a malformed one — callers drop-and-count."""
+        port = int(d["port"])
+        if isinstance(d["port"], bool) or not (0 < port < 65536):
+            raise ValueError(f"peer record port out of range: {port}")
+        node_id = str(d["node_id"])[: cls.MAX_ID_LEN]
+        if not node_id:
+            raise ValueError("peer record has an empty node_id")
         return cls(
-            node_id=str(d["node_id"]),
-            role=str(d["role"]),
-            host=str(d["host"]),
-            port=int(d["port"]),
-            alt_hosts=[str(h) for h in d.get("alt_hosts", [])],
+            node_id=node_id,
+            role=str(d["role"])[: cls.MAX_ROLE_LEN],
+            host=str(d["host"])[: cls.MAX_HOST_LEN],
+            port=port,
+            alt_hosts=[
+                str(h)[: cls.MAX_HOST_LEN]
+                for h in list(d.get("alt_hosts", []))[: cls.MAX_ALT_HOSTS]
+            ],
         )
 
 

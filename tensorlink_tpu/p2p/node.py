@@ -12,6 +12,7 @@ busy-wait polling shared dicts.
 from __future__ import annotations
 
 import asyncio
+import functools
 import random
 import time
 import uuid
@@ -26,6 +27,28 @@ from tensorlink_tpu.p2p.serialization import decode_message, encode_message
 from tensorlink_tpu.utils.logging import get_logger
 
 Handler = Callable[["Node", "Peer", dict], Awaitable[Any]]
+
+
+def wire_guard(fn):
+    """Malformed-frame backstop for wire handlers: a peer-controlled
+    field that is missing or mistyped must produce a typed ERROR reply,
+    not a handler crash. Handlers still validate the fields that matter
+    (better error messages, targeted counters); this wrapper is the
+    last line, so no hostile frame shape can take the handler task down
+    or leave a requester waiting on a reply that never comes.
+
+    tlproto treats reads inside a ``@wire_guard`` def as guarded."""
+
+    @functools.wraps(fn)
+    async def wrapped(self, node, peer, msg):
+        try:
+            return await fn(self, node, peer, msg)
+        except (KeyError, TypeError, ValueError, IndexError,
+                AttributeError) as e:
+            return self._reject_malformed(peer, msg, e)
+
+    wrapped.__wire_guarded__ = True
+    return wrapped
 
 
 @dataclass
@@ -722,6 +745,7 @@ class Node:
         self.metrics.incr("kv_wire_transfers_total")
         return resp
 
+    @wire_guard
     async def _h_kv_blocks(self, node, peer, msg) -> dict:
         blob = msg.get("blob")
         if not isinstance(blob, (bytes, bytearray)):
@@ -735,11 +759,14 @@ class Node:
     async def handle_kv_blocks(self, peer: Peer, msg: dict) -> dict:
         """Role hook: consume a received KV-block payload. The base
         node has no pool to graft into."""
-        return {
-            "type": "SERVE_FAILED",
-            "error_type": "ServingError",
-            "error": f"{self.role} node has no KV sink",
-        }
+        from tensorlink_tpu.parallel.serving import (
+            ServingError,
+            serve_error_to_wire,
+        )
+
+        return serve_error_to_wire(
+            ServingError(f"{self.role} node has no KV sink")
+        )
 
     # ------------------------------------------------------------ streaming
     # Chunked array transfer (serialization.py streaming section): large
@@ -753,6 +780,12 @@ class Node:
     # ``await finish()`` produces the STREAM_END response.
 
     STREAM_TIMEOUT_S = 300.0
+    # hostile-ingest clamps (tlproto TLP201/TLP202): a peer drives
+    # stream creation and chunk naming, so both are bounded — rejects
+    # count into stream_rejected_total and the flight recorder
+    MAX_ACTIVE_STREAMS = 64
+    MAX_STREAM_SID_LEN = 64
+    MAX_STREAM_NAME_LEN = 512
 
     def register_stream_kind(self, kind: str, factory) -> None:
         self._stream_kinds[kind] = factory
@@ -803,27 +836,46 @@ class Node:
             timeout=timeout or self.STREAM_TIMEOUT_S,
         )
 
+    def _reject_stream(self, peer: Peer, why: str) -> dict:
+        self.metrics.incr("stream_rejected_total")
+        self.flight.record(
+            "stream_rejected", "warn", peer=peer.node_id[:16], why=why,
+        )
+        return {"type": "ERROR", "error": why}
+
+    @wire_guard
     async def _h_stream_begin(self, node, peer, msg) -> dict:
         self._purge_expired_streams()  # reclaim abandoned BEGINs too
+        sid = msg.get("sid")
+        manifest = msg.get("manifest")
+        if not isinstance(sid, str) or not sid or \
+                len(sid) > self.MAX_STREAM_SID_LEN:
+            return self._reject_stream(peer, "bad stream sid")
+        if not isinstance(manifest, dict) or not manifest:
+            return self._reject_stream(peer, "bad stream manifest")
+        if len(self._streams) >= self.MAX_ACTIVE_STREAMS:
+            # the peer controls BEGIN volume: without a cap, looping
+            # BEGIN frames grows _streams (and its assemblers) until OOM
+            return self._reject_stream(peer, "too many active streams")
         factory = self._stream_kinds.get(str(msg.get("kind")))
         if factory is None:
             peer.ghosts += 1
             self._penalize(peer)
             return {"type": "ERROR", "error": "unknown stream kind"}
-        made = await factory(peer, msg.get("meta") or {}, msg["manifest"])
+        made = await factory(peer, msg.get("meta") or {}, manifest)
         if isinstance(made, dict):  # rejection (capacity/authorization)
-            return made
+            return self._typed_reply(made)
         sink, finish = made
         from tensorlink_tpu.p2p.serialization import StreamAssembler
 
-        self._streams[msg["sid"]] = {
+        self._streams[sid] = {
             "peer": peer.node_id,
-            "asm": StreamAssembler(msg["manifest"], sink),
+            "asm": StreamAssembler(manifest, sink),
             "finish": finish,
             "event": asyncio.Event(),
             "deadline": time.time() + self.STREAM_TIMEOUT_S,
         }
-        return {"type": "STREAM_ACCEPT", "sid": msg["sid"]}
+        return {"type": "STREAM_ACCEPT", "sid": sid}
 
     def _purge_expired_streams(self) -> None:
         now = time.time()
@@ -832,6 +884,7 @@ class Node:
                 self.log.warning("stream %s expired, reclaiming", sid[:8])
                 del self._streams[sid]
 
+    @wire_guard
     async def _h_stream_chunk(self, node, peer, msg) -> None:
         self._purge_expired_streams()
         st = self._streams.get(msg.get("sid"))
@@ -840,19 +893,35 @@ class Node:
             # normal race, and penalizing them 0.1 apiece would sever the
             # connection after ten stragglers (review finding)
             return None
+        # validate the peer-controlled fields BEFORE they reach the
+        # assembler (tlproto TLP201): name bounds the staging-buffer
+        # key space, off indexes raw memory, data is memcpy'd
+        name = msg.get("name")
+        off = msg.get("off")
+        data = msg.get("data")
+        if not isinstance(name, str) or not name or \
+                len(name) > self.MAX_STREAM_NAME_LEN:
+            self._reject_stream(peer, "bad chunk name")
+            return None
+        if not isinstance(off, int) or isinstance(off, bool) or off < 0:
+            self._reject_stream(peer, "bad chunk offset")
+            return None
+        if not isinstance(data, (bytes, bytearray)):
+            self._reject_stream(peer, "bad chunk data")
+            return None
         # the transfer is alive: push the idle deadline out (a fixed
         # BEGIN-anchored deadline capped stream size at rate x timeout)
         st["deadline"] = time.time() + self.STREAM_TIMEOUT_S
         # memcpy-sized work off the event loop so heartbeats keep flowing
-        await asyncio.to_thread(
-            st["asm"].feed, str(msg["name"]), int(msg["off"]), msg["data"]
-        )
+        await asyncio.to_thread(st["asm"].feed, name, off, data)
         if st["asm"].done:
             st["event"].set()
         return None
 
+    @wire_guard
     async def _h_stream_end(self, node, peer, msg) -> dict:
-        st = self._streams.get(msg.get("sid"))
+        sid = msg.get("sid")
+        st = self._streams.get(sid)
         if st is None or st["peer"] != peer.node_id:
             peer.ghosts += 1
             self._penalize(peer)
@@ -864,10 +933,12 @@ class Node:
                 st["event"].wait(), max(st["deadline"] - time.time(), 1.0)
             )
         except asyncio.TimeoutError:
-            del self._streams[msg["sid"]]
+            del self._streams[sid]
             return {"type": "ERROR", "error": "stream incomplete at END"}
-        del self._streams[msg["sid"]]
-        return await st["finish"]()
+        del self._streams[sid]
+        # finishers are role-registered closures — coerce whatever they
+        # produce onto the typed-reply invariant (tlproto TLP301)
+        return self._typed_reply(await st["finish"]())
 
     async def _recv_loop(self, peer: Peer) -> None:
         try:
@@ -926,7 +997,16 @@ class Node:
                 peer.ghosts += 1  # unsolicited response
                 self._penalize(peer)
             return
-        handler = self._handlers.get(msg["type"])
+        # a frame with a missing/non-str "type" must not KeyError the
+        # dispatch task — it is peer-controlled input like everything
+        # else in the envelope
+        mtype = msg.get("type")
+        if not isinstance(mtype, str):
+            self.metrics.incr("malformed_frames_total")
+            peer.ghosts += 1
+            self._penalize(peer)
+            return
+        handler = self._handlers.get(mtype)
         if handler is None:
             peer.ghosts += 1
             self._penalize(peer)
@@ -939,7 +1019,7 @@ class Node:
                 # on the other node, which is what stitches one job's
                 # RPC chain into a single cross-node trace
                 with self.tracer.span(
-                    f"rpc.{msg['type']}",
+                    f"rpc.{mtype}",
                     {"peer": peer.node_id[:8], "peer_role": peer.role},
                     remote=ctx,
                 ):
@@ -947,7 +1027,7 @@ class Node:
             else:
                 reply = await handler(self, peer, msg)
         except Exception as e:  # noqa: BLE001
-            self.log.warning("handler %s failed: %s", msg["type"], e)
+            self.log.warning("handler %s failed: %s", mtype, e)
             self.metrics.incr("dispatch_errors_total")
             self.flight.record(
                 "dispatch_error", "error", type=str(msg.get("type")),
@@ -973,6 +1053,41 @@ class Node:
         if peer.reputation == 0.0:
             self.log.warning("peer %s reputation zero, dropping", peer.node_id[:8])
             peer.stream.close()
+
+    def _reject_malformed(self, peer: Peer, msg: dict, exc: Exception) -> dict:
+        """Typed reject for a frame whose fields failed validation
+        (wire_guard's landing pad). Counts + flight-records, marks the
+        ghost, but does NOT touch reputation: _penalize docks 0.1 per
+        call, so a reputation hit here would let ten malformed frames
+        (or one fuzzing test run) sever an otherwise healthy link —
+        reputation is for protocol violations, not field typos."""
+        mtype = str(msg.get("type", "?"))[:32]
+        self.metrics.incr("malformed_frames_total")
+        self.flight.record(
+            "malformed_frame", "warn", type=mtype,
+            peer=peer.node_id[:16],
+            error=f"{type(exc).__name__}: {exc}"[:200],
+        )
+        peer.ghosts += 1
+        return {
+            "type": "ERROR",
+            "error": f"malformed {mtype} frame: {type(exc).__name__}",
+        }
+
+    @staticmethod
+    def _typed_reply(reply: Any, fallback: str = "ERROR") -> dict | None:
+        """Coerce a dynamically-produced reply (stream finisher, union
+        helper) onto the wire invariant: every reply is None or a
+        ``{"type": ...}`` dict. tlproto (TLP301) accepts returns routed
+        through this shim."""
+        if reply is None or (isinstance(reply, dict) and "type" in reply):
+            return reply
+        if isinstance(reply, dict):
+            return {"type": fallback, **reply}
+        return {
+            "type": fallback,
+            "error": f"untyped reply ({type(reply).__name__})",
+        }
 
     def _drop_peer(self, peer: Peer) -> None:
         # reclaim half-shipped streams from this peer: their assemblers
@@ -1178,9 +1293,24 @@ class Node:
         self._note_peer_capability(peer, resp.get("capability"))
         delta = resp.get("timeseries_delta")
         if isinstance(delta, dict):
-            self.fleet_series.ingest(
-                peer.node_id, delta, kv=resp.get("kv")
-            )
+            from tensorlink_tpu.runtime.timeseries import TS_DELTA_SCHEMA
+
+            # explicit wire-version gate (pinned in proto.manifest.json):
+            # an unknown version is a typed reject + flight event, never
+            # a parse attempt. Absent "v" = pre-versioning peer, accepted
+            # — the additive-optional grace the rollout itself needs.
+            v = delta.get("v", TS_DELTA_SCHEMA)
+            if isinstance(v, bool) or not isinstance(v, int) or \
+                    v != TS_DELTA_SCHEMA:
+                self.metrics.incr("ts_delta_rejected_total")
+                self.flight.record(
+                    "ts_delta_rejected", "warn",
+                    peer=peer.node_id[:16], version=str(v)[:32],
+                )
+            else:
+                self.fleet_series.ingest(
+                    peer.node_id, delta, kv=resp.get("kv")
+                )
         return peer.ping_ms
 
     # ------------------------------------------------------- failure detection
@@ -1289,15 +1419,39 @@ class Node:
                 continue
         return None
 
+    # a PEER_LIST is peer-controlled: entry count and every field in
+    # each record are clamped before the routing table sees them
+    MAX_PEER_LIST = 256
+
     async def discover_peers(self, peer: Peer) -> list[PeerInfo]:
-        """Ask a peer for its peer list; merge into routing table."""
+        """Ask a peer for its peer list; merge into routing table.
+        Malformed entries are dropped (counted), not raised — one bad
+        record must not discard the rest of the list."""
         resp = await self.request_idempotent(peer, {"type": "PEERS"})
-        infos = [PeerInfo.from_wire(d) for d in resp.get("peers", [])]
+        raw = resp.get("peers", [])
+        raw = raw if isinstance(raw, (list, tuple)) else []
+        if len(raw) > self.MAX_PEER_LIST:
+            self.metrics.incr(
+                "peer_list_rejected_total",
+                len(raw) - self.MAX_PEER_LIST,
+            )
+            self.flight.record(
+                "peer_list_clamped", "warn", peer=peer.node_id[:16],
+                got=len(raw), kept=self.MAX_PEER_LIST,
+            )
+            raw = raw[: self.MAX_PEER_LIST]
+        infos = []
+        for d in raw:
+            try:
+                infos.append(PeerInfo.from_wire(d))
+            except (KeyError, TypeError, ValueError):
+                self.metrics.incr("peer_list_rejected_total")
         for i in infos:
             self.dht.table.add(i)
         return infos
 
     # ------------------------------------------------------------ handlers
+    @wire_guard
     async def _h_ping(self, node, peer, msg) -> dict:
         out = {"type": "PONG", "t": time.time()}
         cap = self.capability_record()
@@ -1386,23 +1540,74 @@ class Node:
         validators)."""
         return not key.startswith("rep:")
 
+    # hostile-ingest clamps for remote DHT writes (tlproto TLP201):
+    # key length, serialized value size, and total remote-fed keys are
+    # bounded — rejects count into dht_rejected_total
+    MAX_DHT_KEY_LEN = 256
+    MAX_DHT_VALUE_BYTES = 64 << 10
+    MAX_DHT_KEYS = 4096
+    MAX_DHT_EXCLUDE = 64
+
+    def _reject_dht(self, peer: Peer, key: str, why: str) -> dict:
+        self.metrics.incr("dht_rejected_total")
+        self.flight.record(
+            "dht_rejected", "warn", peer=peer.node_id[:16],
+            key=key[:64], why=why,
+        )
+        return {"type": "DHT_DENIED", "key": key, "why": why}
+
+    def _clamp_dht_value(self, value):
+        """Registered tlproto sanitizer for remote DHT writes: the
+        value must be msgpack-encodable and fit the remote-write size
+        budget. Returns the value unchanged, or None on reject (None is
+        never worth storing — get_local reads it as a miss)."""
+        try:
+            # encode_message requires a "type" key; wrap the value in a
+            # minimal envelope purely to measure its encoded size (the
+            # lowercase type never leaves this function — not a frame)
+            size = len(encode_message({"type": "dht-size-probe", "v": value}))
+        except (TypeError, ValueError, OverflowError):
+            return None
+        if size > self.MAX_DHT_VALUE_BYTES:
+            return None
+        return value
+
+    @wire_guard
     async def _h_dht_store(self, node, peer, msg) -> dict:
-        key = str(msg["key"])
+        key = str(msg.get("key", ""))[: self.MAX_DHT_KEY_LEN + 1]
+        if not key or len(key) > self.MAX_DHT_KEY_LEN:
+            return self._reject_dht(peer, key, "bad key")
         if not self.dht_store_allowed(peer, key):
             peer.ghosts += 1
             self._penalize(peer)
             return {"type": "DHT_DENIED", "key": key}
-        self.dht.put_local(key, msg["value"])
+        value = self._clamp_dht_value(msg.get("value"))
+        if value is None:
+            return self._reject_dht(
+                peer, key, "unencodable or oversized value",
+            )
+        if key not in self.dht.store and \
+                len(self.dht.store) >= self.MAX_DHT_KEYS:
+            return self._reject_dht(peer, key, "store full")
+        self.dht.put_local(key, value)
         return {"type": "DHT_STORED"}
 
+    @wire_guard
     async def _h_dht_query(self, node, peer, msg) -> dict:
-        key = str(msg["key"])
+        key = str(msg.get("key", ""))[: self.MAX_DHT_KEY_LEN]
         val = self.dht.get_local(key)
         if val is None:
-            exclude = set(msg.get("exclude", [])) | {self.node_id}
+            raw = msg.get("exclude")
+            raw = raw if isinstance(raw, (list, tuple)) else []
+            # bound the peer-fed exclusion set: it rides every recursive
+            # hop of the lookup
+            exclude = {
+                str(x)[:128] for x in raw[: self.MAX_DHT_EXCLUDE]
+            } | {self.node_id}
             val = await self.dht_query(key, max_hops=2, _exclude=exclude)
         return {"type": "DHT_VALUE", "key": key, "value": val}
 
+    @wire_guard
     async def _h_peers(self, node, peer, msg) -> dict:
         return {
             "type": "PEER_LIST",

@@ -22,7 +22,7 @@ import numpy as np
 
 from tensorlink_tpu.config import NodeConfig
 from tensorlink_tpu.nn.module import Module, Sequential
-from tensorlink_tpu.p2p.node import Node, Peer
+from tensorlink_tpu.p2p.node import Node, Peer, wire_guard
 from tensorlink_tpu.p2p.serialization import (
     pack_arrays,
     tree_flatten_arrays,
@@ -1125,6 +1125,7 @@ class UserNode(Node):
     def drop_relay_waiter(self, key: tuple) -> None:
         self._relay_waiters.pop(key, None)
 
+    @wire_guard
     async def _h_relay_result(self, node, peer, msg) -> None:
         key = (
             str(msg.get("job_id")), int(msg.get("step", -1)),
@@ -1147,9 +1148,14 @@ class UserNode(Node):
             fut.set_exception(RuntimeError(
                 f"relay failed: {msg.get('error', 'unknown')}"
             ))
+        elif "data" not in msg:
+            # fail the waiter rather than KeyError into wire_guard: the
+            # caller would otherwise ride out the full relay timeout
+            fut.set_exception(RuntimeError("relay result missing data"))
         else:
             fut.set_result(msg["data"])
 
+    @wire_guard
     async def _h_params_stream_failed(self, node, peer, msg) -> None:
         """Worker-side stream failure: fail the waiting fetch immediately
         instead of riding out the stream timeout."""

@@ -16,7 +16,7 @@ import time
 from typing import Any
 
 from tensorlink_tpu.config import NodeConfig
-from tensorlink_tpu.p2p.node import Node, Peer
+from tensorlink_tpu.p2p.node import Node, Peer, wire_guard
 from tensorlink_tpu.roles.jobs import JobRecord, validate_job_request
 from tensorlink_tpu.roles.registry import Registry
 
@@ -389,6 +389,7 @@ class ValidatorNode(Node):
                 )
         return n
 
+    @wire_guard
     async def _h_job_replicate(self, node, peer, msg) -> dict:
         if not self._is_validator_peer(peer):
             return {"type": "ERROR", "error": "validators only"}
@@ -415,6 +416,7 @@ class ValidatorNode(Node):
         self.health.clear_condition(f"job:{job.job_id[:16]}")
         return {"type": "JOB_REPLICATED", "job_id": job.job_id}
 
+    @wire_guard
     async def _h_job_req(self, node, peer, msg) -> dict:
         """Validate -> store in DHT -> recruit one worker per stage ->
         reply ACCEPT_JOB with placements (reference: create_job,
@@ -483,6 +485,7 @@ class ValidatorNode(Node):
             "validators": siblings,
         }
 
+    @wire_guard
     async def _h_job_update(self, node, peer, msg) -> dict:
         """Loss/accuracy aggregation (reference stubs this:
         validator.py:329-331). ``done: true`` marks the job finished
@@ -504,6 +507,7 @@ class ValidatorNode(Node):
                 self.flight.record("job_done", job_id=jid[:16])
         return {"type": "JOB_UPDATED"}
 
+    @wire_guard
     async def _h_job_info(self, node, peer, msg) -> dict:
         jid = str(msg["job_id"])
         job = self.jobs.get(jid)
@@ -520,6 +524,7 @@ class ValidatorNode(Node):
             "validators": await self._job_replica_set(jid),
         }
 
+    @wire_guard
     async def _h_serve_plan(self, node, peer, msg) -> dict:
         """Disaggregated-serving placement (ROADMAP item 1): place a
         request's prefill and decode legs from the live fleet roofline
@@ -575,6 +580,7 @@ class ValidatorNode(Node):
         )
         return out
 
+    @wire_guard
     async def _h_replace_worker(self, node, peer, msg) -> dict:
         """Elastic re-recruitment after a stage failure (the reference's
         `handle_timeout` calls an undefined select_candidate_worker,

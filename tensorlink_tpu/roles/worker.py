@@ -27,7 +27,7 @@ import numpy as np
 
 from tensorlink_tpu.config import NodeConfig
 from tensorlink_tpu.nn.module import Module, module_from_config
-from tensorlink_tpu.p2p.node import Node, Peer
+from tensorlink_tpu.p2p.node import Node, Peer, wire_guard
 from tensorlink_tpu.p2p.serialization import (
     pack_arrays,
     packed_nbytes,
@@ -520,6 +520,10 @@ class WorkerNode(Node):
     STEP_END, PARAMS_REQUEST, POL_CHALLENGE (see pol.py)."""
 
     RESERVATION_TTL_S = 120.0
+    # peer-fed growth bounds (tlproto TLP202): a hostile peer may not
+    # park unbounded reservations or ship megatoken prompts
+    MAX_RESERVATIONS = 64
+    MAX_SERVE_IDS = 65536
 
     def __init__(self, cfg: NodeConfig | None = None, registry=None, **kw):
         cfg = cfg or NodeConfig(role="worker")
@@ -797,7 +801,7 @@ class WorkerNode(Node):
     def _serve_kwargs(msg: dict) -> dict:
         out = {
             "seed": int(msg.get("seed", 0)),
-            "priority": msg.get("priority", "standard"),
+            "priority": str(msg.get("priority", "standard"))[:32],
         }
         if msg.get("max_new") is not None:
             out["max_new"] = int(msg["max_new"])
@@ -805,6 +809,20 @@ class WorkerNode(Node):
             out["deadline_s"] = float(msg["deadline_s"])
         return out
 
+    def _serve_ids(self, msg: dict) -> np.ndarray:
+        """Validate a peer-supplied token-id list (tlproto registered
+        sanitizer). Raises TypeError/ValueError on malformed input, which
+        ``wire_guard`` turns into a typed malformed-frame reject."""
+        raw = msg["ids"]
+        if not isinstance(raw, (list, tuple)):
+            raise TypeError(f"ids must be a list, got {type(raw).__name__}")
+        if len(raw) > self.MAX_SERVE_IDS:
+            raise ValueError(
+                f"ids length {len(raw)} exceeds {self.MAX_SERVE_IDS}"
+            )
+        return np.asarray([int(t) for t in raw], np.int32).reshape(-1)
+
+    @wire_guard
     async def _h_serve_submit(self, node, peer, msg) -> dict:
         """Colocated admission: the full-request path (and the dead-leg
         fallback target). Typed scheduler rejections — overload with
@@ -815,13 +833,14 @@ class WorkerNode(Node):
         serving, err = self._serving_or_error()
         if err is not None:
             return err
-        ids = np.asarray(msg["ids"], np.int32).reshape(-1)
+        ids = self._serve_ids(msg)
         try:
             rid = await serving.asubmit(ids, **self._serve_kwargs(msg))
         except Exception as e:  # noqa: BLE001 — typed across the wire
             return serve_error_to_wire(e)
         return {"type": "SERVE_ACCEPTED", "rid": rid}
 
+    @wire_guard
     async def _h_serve_result(self, node, peer, msg) -> dict:
         from tensorlink_tpu.parallel.serving import serve_error_to_wire
 
@@ -843,6 +862,7 @@ class WorkerNode(Node):
             "tokens": [int(t) for t in np.asarray(tokens).reshape(-1)],
         }
 
+    @wire_guard
     async def _h_serve_prefill(self, node, peer, msg) -> dict:
         """The PREFILL leg: chunked-prefill the prompt into the local
         pool, ship the filled blocks to the decode worker named in
@@ -862,7 +882,7 @@ class WorkerNode(Node):
         serving, err = self._serving_or_error(need_paged=True)
         if err is not None:
             return err
-        ids = np.asarray(msg["ids"], np.int32).reshape(-1)
+        ids = self._serve_ids(msg)
         kw = self._serve_kwargs(msg)
         t0 = time.perf_counter()
         try:
@@ -1016,6 +1036,16 @@ class WorkerNode(Node):
                 rid = await asyncio.to_thread(
                     serving.import_prefill, payload, **kw
                 )
+        except ValueError as e:
+            # malformed or incompatible wire payload: CRC mismatch, or a
+            # KV_WIRE_SCHEMA this importer does not speak. Typed reject
+            # plus a flight event so rolling upgrades are observable.
+            self.metrics.incr("kv_wire_rejected_total")
+            self.flight.record(
+                "kv_wire_rejected", "warn",
+                peer=peer.node_id[:16], error=str(e)[:200],
+            )
+            return serve_error_to_wire(e)
         except Exception as e:  # noqa: BLE001 — typed across the wire
             return serve_error_to_wire(e)
         return {"type": "KV_IMPORTED", "rid": rid}
@@ -1035,6 +1065,7 @@ class WorkerNode(Node):
         cap = dev_free or host_free_memory_bytes() // 2
         return max(cap - self.reserved_bytes, 0)
 
+    @wire_guard
     async def _h_stats(self, node, peer, msg) -> dict:
         """Self-report (reference: worker.py:363-381)."""
         return {
@@ -1057,10 +1088,25 @@ class WorkerNode(Node):
             },
         }
 
+    @wire_guard
     async def _h_job_offer(self, node, peer, msg) -> dict:
         """Accept/decline by free memory (reference: worker.py:164-188).
         Memory bound = params + grads + 2x Adam state + activation slack."""
         need = int(msg["param_bytes"]) * 4 + (64 << 20)
+        if len(self._reservations) >= self.MAX_RESERVATIONS:
+            # bound peer-fed reservation growth (tlproto TLP202): expired
+            # entries are swept lazily, so a flood of offers from a
+            # hostile author must hit a hard ceiling, not the TTL
+            self.metrics.incr("job_offer_rejected_total")
+            self.flight.record(
+                "job_offer_rejected", "warn",
+                peer=peer.node_id[:16], reason="reservation table full",
+            )
+            return {
+                "type": "DECLINE_JOB",
+                "job_id": str(msg["job_id"]),
+                "stage": int(msg["stage"]),
+            }
         if need <= self.capacity_bytes():
             self._reservations[(str(msg["job_id"]), int(msg["stage"]))] = (
                 need,
@@ -1219,6 +1265,7 @@ class WorkerNode(Node):
             }
         return None
 
+    @wire_guard
     async def _h_module_spec(self, node, peer, msg) -> dict:
         """One-shot path: spec + weights in a single message (small
         stages; large ones arrive via the module_spec stream kind)."""
@@ -1346,6 +1393,7 @@ class WorkerNode(Node):
         self._penalize(peer)
         return {"type": "ERROR", "error": "unauthorized"}
 
+    @wire_guard
     async def _h_forward(self, node, peer, msg) -> dict | None:
         """Run the stage and return the activation to the requester
         (hub-and-spoke: the master drives the chain, reference §3.2).
@@ -1354,7 +1402,7 @@ class WorkerNode(Node):
         """
         runner = self._authorized_runner(peer, msg)
         if isinstance(runner, dict):
-            return runner
+            return self._typed_reply(runner)
         if int(msg.get("fence", 0)) < runner.fence:
             return {"type": "ERROR", "error": "stale fence (aborted step)"}
         x = unpack_arrays(msg["data"])["x"]
@@ -1384,10 +1432,11 @@ class WorkerNode(Node):
         }
         return reply
 
+    @wire_guard
     async def _h_backward(self, node, peer, msg) -> dict | None:
         runner = self._authorized_runner(peer, msg)
         if isinstance(runner, dict):
-            return runner
+            return self._typed_reply(runner)
         if int(msg.get("fence", 0)) < runner.fence:
             return {"type": "ERROR", "error": "stale fence (aborted step)"}
         g = unpack_arrays(msg["data"])["g"]
@@ -1565,12 +1614,15 @@ class WorkerNode(Node):
             return {"type": "RELAY_ACCEPTED", "stage": runner.stage_index}
         return None
 
+    @wire_guard
     async def _h_relay_forward(self, node, peer, msg) -> dict | None:
         return await self._h_relay(peer, msg, backward=False)
 
+    @wire_guard
     async def _h_relay_backward(self, node, peer, msg) -> dict | None:
         return await self._h_relay(peer, msg, backward=True)
 
+    @wire_guard
     async def _h_step_end(self, node, peer, msg) -> dict:
         """All micro-grads in: optimizer step (correctly: step, no
         pre-zeroing — contrast worker.py:320-321). When the stage has
@@ -1579,7 +1631,7 @@ class WorkerNode(Node):
         *planned* this, Whitepaper:21)."""
         runner = self._authorized_runner(peer, msg)
         if isinstance(runner, dict):
-            return runner
+            return self._typed_reply(runner)
         master_step = int(msg["step"]) if "step" in msg else None
         fence = int(msg.get("fence", 0))
         if not runner.replica_peers:
@@ -1698,6 +1750,7 @@ class WorkerNode(Node):
         for k in stale_ev:
             del self._grad_events[k]
 
+    @wire_guard
     async def _h_grad_share(self, node, peer, msg) -> dict:
         """A replica peer's gradient contribution. Only accepted from the
         stage's registered replica set."""
@@ -1731,6 +1784,7 @@ class WorkerNode(Node):
             ev.set()
         return {"type": "GRAD_ACK", "step": step}
 
+    @wire_guard
     async def _h_abort_step(self, node, peer, msg) -> dict:
         """Discard partial grads/activations after a mid-step stage
         failure so the master can retry the step against a recovered
@@ -1738,7 +1792,7 @@ class WorkerNode(Node):
         §5.3)."""
         runner = self._authorized_runner(peer, msg)
         if isinstance(runner, dict):
-            return runner
+            return self._typed_reply(runner)
         runner.fence = max(runner.fence, int(msg.get("fence", runner.fence + 1)))
         runner.reset_step()
         self.flight.record(
@@ -1747,6 +1801,7 @@ class WorkerNode(Node):
         )
         return {"type": "STEP_ABORTED", "step": runner.step, "fence": runner.fence}
 
+    @wire_guard
     async def _h_params_request(self, node, peer, msg) -> dict:
         """Return current stage params (reference: send_parameters,
         torch_node.py:148-157). With ``stream: true`` the weights come
@@ -1754,7 +1809,7 @@ class WorkerNode(Node):
         missing #3) and this response only carries the metadata."""
         runner = self._authorized_runner(peer, msg, allow_validator=True)
         if isinstance(runner, dict):
-            return runner
+            return self._typed_reply(runner)
         head = {
             "type": "PARAMETERS",
             "job_id": msg["job_id"],
@@ -1798,6 +1853,7 @@ class WorkerNode(Node):
         head["weights"] = pack_arrays(flat)
         return head
 
+    @wire_guard
     async def _h_unload(self, node, peer, msg) -> dict:
         """Free a finished job's stages + any reservation (job teardown;
         the reference had no teardown at all). Owner-only."""
@@ -1835,6 +1891,7 @@ class WorkerNode(Node):
             )
         return {"type": "UNLOADED", "job_id": jid, "stages": len(removed)}
 
+    @wire_guard
     async def _h_pol_challenge(self, node, peer, msg) -> dict:
         """Deterministic re-execution (whitepaper PoL made real — XLA
         programs are deterministic for a fixed compiled binary).
@@ -1851,7 +1908,7 @@ class WorkerNode(Node):
 
         runner = self._authorized_runner(peer, msg, allow_validator=True)
         if isinstance(runner, dict):
-            return runner
+            return self._typed_reply(runner)
         if "data" in msg:
             x = jnp.asarray(unpack_arrays(msg["data"])["x"])
         else:

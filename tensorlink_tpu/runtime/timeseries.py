@@ -75,6 +75,15 @@ MAX_DELTA_SERIES = 48
 MAX_DELTA_POINTS = 160
 MAX_NAME_LEN = 120
 
+# Wire version of the heartbeat-delta payload, pinned in
+# proto.manifest.json (tlproto TLP405). Bump it when the delta's field
+# layout changes; ingest rejects unknown versions with a typed counter
+# + flight event instead of attempting a parse. A delta WITHOUT the
+# "v" field is pre-versioning legacy and still accepted — additive-
+# optional is the one silent evolution the compatibility contract
+# allows, and that grace window is what lets this very field roll out.
+TS_DELTA_SCHEMA = 1
+
 
 class _Ring:
     """One retention tier of one series: ``slots`` fixed buckets of
@@ -304,7 +313,9 @@ class TimeSeriesStore:
             # first contact: only the finest tier's last ~30 s, the
             # cursor takes over from there
             since = t - 30.0
-        out: dict[str, Any] = {"t": round(t, 3), "series": {}}
+        out: dict[str, Any] = {
+            "v": TS_DELTA_SCHEMA, "t": round(t, 3), "series": {},
+        }
         budget = max_points
         with self._lock:
             for name in sorted(self._series):
@@ -329,6 +340,10 @@ def sanitize_delta(delta: Any) -> dict[str, Any] | None:
     non-numeric is dropped, never raised on."""
     if not isinstance(delta, dict):
         return None
+    v = delta.get("v", TS_DELTA_SCHEMA)  # absent = pre-versioning peer
+    if isinstance(v, bool) or not isinstance(v, int) or \
+            v != TS_DELTA_SCHEMA:
+        return None  # unknown wire version: reject, don't guess-parse
     raw = delta.get("series")
     if not isinstance(raw, dict):
         return None

@@ -912,3 +912,78 @@ def test_node_row_flags_xfer_stalled():
         "disagg": {"imports": 5},
     }, capability={"serving_mode": "decode"}))
     assert not any(f.startswith("XFER-STALLED") for f in silent["flags"])
+
+
+# ------------------------------------------------------ tldiag proto-diff
+def _proto_manifest(frames, versions=None):
+    return {"schema": 1, "frames": frames, "versions": versions or {}}
+
+
+def test_proto_diff_break_taxonomy():
+    from tensorlink_tpu.diag import proto_manifest_diff, render_proto_diff
+    old = _proto_manifest({
+        "PING": {"fields": {
+            "t": {"kind": "float", "required": True},
+            "tag": {"kind": "str", "required": False},
+        }},
+        "GONE": {"fields": {}},
+    }, {"KV_WIRE_SCHEMA": 1})
+    new = _proto_manifest({
+        "PING": {"fields": {
+            "t": {"kind": "str", "required": True},       # kind change
+            "tag": {"kind": "str", "required": True},     # now required
+            "mode": {"kind": "str", "required": True},    # new required
+            "opt": {"kind": "int", "required": False},    # additive-opt
+        }},
+        "FRESH": {"fields": {}},                          # new frame
+    }, {"KV_WIRE_SCHEMA": 2})                             # version bump
+    d = proto_manifest_diff(old, new)
+    assert not d["compatible"]
+    joined = " ".join(d["breaks"])
+    assert "GONE: frame removed" in joined
+    assert "PING.t: kind changed float -> str" in joined
+    assert "PING.tag: optional field turned required" in joined
+    assert "PING.mode: new required field" in joined
+    assert "version KV_WIRE_SCHEMA: 1 -> 2" in joined
+    assert d["pins"] == ["FRESH: frame added"]
+    assert d["ok"] == ["PING.opt: optional field added"]
+    text = render_proto_diff(d)
+    assert "rolling upgrade: UNSAFE" in text
+    assert text.count("BREAK") == len(d["breaks"])
+
+
+def test_proto_diff_additive_optional_is_safe():
+    from tensorlink_tpu.diag import proto_manifest_diff, render_proto_diff
+    old = _proto_manifest(
+        {"PING": {"fields": {"t": {"kind": "float", "required": True}}}}
+    )
+    new = _proto_manifest({"PING": {"fields": {
+        "t": {"kind": "float", "required": True},
+        "extra": {"kind": "dict", "required": False},
+    }}})
+    d = proto_manifest_diff(old, new)
+    assert d["compatible"] and d["breaks"] == []
+    assert "rolling upgrade: safe" in render_proto_diff(d)
+    # kind widening to "any" (statically unknown) is not a verdict
+    wide = _proto_manifest(
+        {"PING": {"fields": {"t": {"kind": "any", "required": True}}}}
+    )
+    assert proto_manifest_diff(old, wide)["compatible"]
+
+
+def test_cli_proto_diff(tmp_path, capsys):
+    a = tmp_path / "old.json"
+    b = tmp_path / "new.json"
+    a.write_text(json.dumps(_proto_manifest(
+        {"PING": {"fields": {"t": {"kind": "float", "required": True}}}}
+    )))
+    b.write_text(json.dumps(_proto_manifest({"PING": {"fields": {}}})))
+    assert main(["proto-diff", str(a), str(b)]) == 1  # break -> exit 1
+    out = capsys.readouterr().out
+    assert "BREAK PING.t: field removed" in out
+    assert main(["proto-diff", str(a), str(a)]) == 0
+    capsys.readouterr()
+    assert main(["proto-diff", str(a), str(b), "--json"]) == 1
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["compatible"] is False
+    assert parsed["frames"]["PING"]["t"] == "removed"
