@@ -551,6 +551,191 @@ def serving_disagg_round() -> dict:
     return out
 
 
+def serving_pipeline_round() -> dict:
+    """Pipeline-sharded serving round (ISSUE 18): the same request mix
+    served twice — SINGLE-NODE (one paged engine holds every layer)
+    and PIPELINED (a 3-stage localhost mesh; each worker holds only
+    its layer span's weights + KV, activations cross the ACT_FWD wire
+    every tick). Reported: the tokens/s ratio (higher-better; < 1.0
+    is the per-token hop tax, which in-flight microbatching must
+    hide), a token-parity pin (position-keyed sampling makes the
+    pipeline cut bit-invisible), activation wire bytes/token
+    (directionless — a property of dim and stage count), and a
+    per-stage TTFT decomposition from a 1-token probe: each stage's
+    prefill compute share vs the wire+scheduling residual."""
+    import asyncio
+
+    from tensorlink_tpu.config import MeshConfig, NodeConfig
+    from tensorlink_tpu.models.llama import Llama, LlamaConfig
+    from tensorlink_tpu.parallel.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from tensorlink_tpu.parallel.serving import (
+        PagedContinuousBatchingEngine,
+    )
+    from tensorlink_tpu.runtime.mesh import make_mesh
+
+    P0, Nn, NREQ, SLOTS, STAGES = 24, 24, 8, 4, 3
+    cfg = LlamaConfig(
+        vocab_size=256, dim=64, num_layers=3, num_heads=4,
+        num_kv_heads=2, hidden_dim=128, max_len=128, rope_theta=10000.0,
+    )
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0))
+
+    def engine():
+        # float32 end to end: the parity pin compares bit-exact token
+        # streams, so the activation hop must not add a cast the
+        # single-node program doesn't have
+        return InferenceEngine(
+            make_mesh(MeshConfig()), model, params, max_len=128,
+            cache_dtype=jnp.float32, param_dtype=jnp.float32,
+        )
+
+    gen = GenerationConfig(max_new_tokens=Nn)
+    r = np.random.default_rng(5)
+    warm_prompt = r.integers(0, cfg.vocab_size, (P0,))
+    prompts = [
+        r.integers(0, cfg.vocab_size, (P0 + (i % 5),)) for i in range(NREQ)
+    ]
+
+    out: dict = {}
+    # -- single-node baseline: every layer on one engine
+    single = PagedContinuousBatchingEngine(
+        engine(), slots=SLOTS, gen=gen, decode_chunk=SLOTS,
+        block_size=16, prefill_chunk=16,
+    )
+    single.result(single.submit(warm_prompt, seed=7))  # warm: compile
+    t0 = time.perf_counter()
+    rids = [single.submit(p_, seed=7) for p_ in prompts]
+    single.run_until_idle()
+    refs = [np.asarray(single.result(rid)) for rid in rids]
+    single_dt = time.perf_counter() - t0
+    single_tok = sum(len(t) for t in refs)
+    single_tps = single_tok / single_dt
+    out["serving_single_node_tokens_per_sec"] = round(single_tps, 1)
+
+    # -- pipelined: 3 stage workers on localhost sockets, head stage
+    # coordinates (continuous batching lives across the whole chain)
+    async def pipelined() -> dict:
+        from tensorlink_tpu.roles.user import UserNode
+        from tensorlink_tpu.roles.validator import ValidatorNode
+        from tensorlink_tpu.roles.worker import WorkerNode
+
+        def ncfg(role):
+            return NodeConfig(
+                role=role, host="127.0.0.1", port=0,
+                capability_bench=False,
+            )
+
+        def winfo(w):
+            return {
+                "node_id": w.node_id, "host": "127.0.0.1", "port": w.port,
+            }
+
+        val = ValidatorNode(ncfg("validator"))
+        ws = [WorkerNode(ncfg("worker")) for _ in range(STAGES)]
+        user = UserNode(ncfg("user"))
+        nodes = [val, *ws, user]
+        for n in nodes:
+            await n.start()
+        try:
+            kw = dict(
+                slots=SLOTS, gen=gen, block_size=16, prefill_chunk=16,
+                max_len=128,
+            )
+            spans = [(0, 1), (1, 2), (2, 3)]
+            for i in (1, 2):
+                ws[i].pipeline_stage(
+                    engine(), sid="bench", stage=i, n_stages=STAGES,
+                    lo=spans[i][0], hi=spans[i][1], **kw,
+                )
+            vpeer0 = await ws[0].connect("127.0.0.1", val.port)
+            ws[0].pipeline_stage(
+                engine(), sid="bench", stage=0, n_stages=STAGES,
+                lo=0, hi=1, route=[winfo(ws[1]), winfo(ws[2])],
+                validator=vpeer0, **kw,
+            )
+            for w in ws:
+                peer = await val.connect("127.0.0.1", w.port)
+                await val.ping(peer)
+            vpeer = await user.connect("127.0.0.1", val.port)
+            client = user.remote_serving(vpeer, pipeline=True)
+
+            # warm the whole chain (compile every stage program)
+            rid = await client.submit(warm_prompt, seed=7)
+            await client.result(rid)
+
+            def stage_prefill_s():
+                return [
+                    float(w._pipe_stage.stats()["prefill_s"]) for w in ws
+                ]
+
+            # 1-token probe: TTFT decomposed into per-stage prefill
+            # compute vs the wire + scheduling residual
+            pre0 = stage_prefill_s()
+            tp = time.perf_counter()
+            rid = await client.submit(prompts[0], seed=7, max_new=1)
+            await client.result(rid)
+            ttft = time.perf_counter() - tp
+            shares = [
+                b - a for a, b in zip(pre0, stage_prefill_s())
+            ]
+            res: dict = {"pipeline_ttft_total_s": round(ttft, 5)}
+            for i, s in enumerate(shares):
+                res[f"pipeline_ttft_stage{i}_prefill_s"] = round(s, 5)
+            res["pipeline_ttft_wire_host_s"] = round(
+                max(ttft - sum(shares), 0.0), 5
+            )
+
+            tq = time.perf_counter()
+            drids = [
+                await client.submit(p_, seed=7) for p_ in prompts
+            ]
+            outs = [
+                np.asarray(await client.result(rid)) for rid in drids
+            ]
+            pipe_dt = time.perf_counter() - tq
+            pipe_tok = sum(len(t) for t in outs)
+            res["_tps"] = pipe_tok / pipe_dt
+            res["pipeline_token_parity"] = float(all(
+                np.array_equal(a, b) for a, b in zip(outs, refs)
+            ))
+            # every transfer is counted once at BOTH sockets' ends
+            # (sender after the reply, receiver on ingest), so the
+            # bytes that actually crossed a wire = sum / 2
+            wire = sum(
+                n.metrics.snapshot()["counters"].get(
+                    "act_wire_bytes_total", 0
+                )
+                for n in (val, *ws, user)
+            ) / 2
+            res["act_wire_bytes_total"] = int(wire)
+            res["act_wire_bytes_per_token"] = round(wire / pipe_tok, 1)
+            bubbles = [
+                float(w._pipe_stage.stats()["bubble_frac"]) for w in ws
+            ]
+            res["pipeline_bubble_frac"] = round(max(bubbles), 4)
+            return res
+        finally:
+            for n in nodes:
+                await n.stop()
+
+    pres = asyncio.run(pipelined())
+    pipe_tps = pres.pop("_tps")
+    out["serving_pipeline_tokens_per_sec"] = round(pipe_tps, 1)
+    out["pipeline_vs_single_node"] = round(pipe_tps / single_tps, 3)
+    out.update(pres)
+    out["serving_pipeline_config"] = (
+        f"Llama {cfg.num_layers}L dim {cfg.dim} f32, {STAGES} stages x "
+        f"1 layer on localhost sockets, {NREQ} requests, {SLOTS} "
+        f"slots, block 16, {Nn} new tokens; single-node = same engine "
+        "unsharded"
+    )
+    return out
+
+
 def serving_under_load_round() -> dict:
     """Overload + churn round (ISSUE 14): Poisson-ish arrivals at ~4x
     the measured per-slot service capacity, mixed SLO classes, one
@@ -1627,6 +1812,14 @@ def main() -> None:
             out.update(serving_disagg_round())
         except Exception as e:  # noqa: BLE001 — must not sink the headline
             out["serving_disagg_error"] = str(e)[:200]
+
+    # -- pipeline-sharded serving (ISSUE 18): layer-sharded 3-stage
+    # localhost mesh vs the same engine unsharded, with a parity pin
+    if os.environ.get("BENCH_PIPELINE", "1") == "1" and _BERT == "base":
+        try:
+            out.update(serving_pipeline_round())
+        except Exception as e:  # noqa: BLE001 — must not sink the headline
+            out["pipeline_error"] = str(e)[:200]
 
     # -- observability cost (ISSUE 16): what the always-on ring
     # sampler + alert evaluation charges a loaded serving run, and the

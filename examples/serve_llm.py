@@ -125,7 +125,22 @@ def main() -> None:
                          "the filled KV blocks cross the wire "
                          "(CRC-framed, byte-counted), and the output "
                          "is token-identical to colocated serving")
+    ap.add_argument("--pipeline", type=int, default=0, metavar="N",
+                    help="PIPELINE-SHARDED serving demo over N local "
+                         "p2p stage workers: the layer stack is "
+                         "partitioned proportional to each worker's "
+                         "advertised HBM, every worker holds ONLY its "
+                         "span's weights + KV, activations stream "
+                         "stage-to-stage over the ACT_FWD wire each "
+                         "decode tick, and the output is "
+                         "token-identical to a single node holding "
+                         "the whole model")
     args = ap.parse_args()
+    if args.disaggregate and args.pipeline:
+        ap.error("--disaggregate and --pipeline are exclusive")
+    if args.pipeline:
+        _pipeline_demo(args)
+        return
     if args.disaggregate:
         _disaggregate_demo(args)
         return
@@ -441,6 +456,129 @@ def main() -> None:
         )
 
 
+
+
+def _pipeline_demo(args) -> None:
+    """N stage workers on localhost: the model sliced layer-wise by
+    HBM capability, activations as the wire unit (ISSUE 18 / ROADMAP
+    2). The point the demo pins: NO single worker holds the full
+    weights, yet the token stream is bit-identical to one that does."""
+    import asyncio
+
+    from tensorlink_tpu.config import NodeConfig
+    from tensorlink_tpu.nn.staging import (
+        layer_param_bytes,
+        param_bytes,
+        stage_spans,
+    )
+    from tensorlink_tpu.parallel.serving import PagedContinuousBatchingEngine
+    from tensorlink_tpu.roles.user import UserNode
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.roles.worker import WorkerNode
+
+    cfg = LlamaConfig(
+        vocab_size=512, dim=64, num_layers=4, num_heads=8, num_kv_heads=4,
+        hidden_dim=128, max_len=256, rope_theta=10000.0,
+    )
+    n_stages = max(2, min(int(args.pipeline), cfg.num_layers))
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0))
+    gen = GenerationConfig(
+        max_new_tokens=args.max_new, temperature=args.temperature,
+        top_p=args.top_p,
+    )
+
+    def engine():
+        # f32 end to end so the parity print compares bit-exact
+        # streams — the stage cut must be invisible to the sampler
+        return InferenceEngine(
+            make_mesh(MeshConfig()), model, params, max_len=256,
+            cache_dtype=jnp.float32, param_dtype=jnp.float32,
+        )
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, (n,)) for n in (9, 17, 5)]
+    ref_eng = PagedContinuousBatchingEngine(
+        engine(), slots=2, gen=gen, block_size=16,
+    )
+    refs = [ref_eng.result(ref_eng.submit(p, seed=i))
+            for i, p in enumerate(prompts)]
+
+    # a real deployment measures HBM (WorkerNode capability bench); the
+    # demo pins an asymmetric fleet so the capacity-proportional layer
+    # split has something to be proportional TO
+    total = param_bytes(params)
+    caps = [
+        int(total * (0.75 if i == 0 else 0.45)) for i in range(n_stages)
+    ]
+    spans = stage_spans(layer_param_bytes(params), caps)
+    print(f"model = {total} param bytes over {cfg.num_layers} layers; "
+          f"no worker holds it alone:")
+    for i, ((lo, hi), c) in enumerate(zip(spans, caps)):
+        print(f"  stage {i}: layers [{lo},{hi}) on a {c}-byte-HBM "
+              "worker")
+
+    async def demo():
+        nc = lambda role: NodeConfig(  # noqa: E731
+            role=role, host="127.0.0.1", port=0, capability_bench=False,
+        )
+        val = ValidatorNode(nc("validator"))
+        ws = [WorkerNode(nc("worker")) for _ in range(n_stages)]
+        user = UserNode(nc("user"))
+        for n in (val, *ws, user):
+            await n.start()
+        kw = dict(slots=2, gen=gen, block_size=16, prefill_chunk=16,
+                  max_len=256)
+        winfo = lambda w: {  # noqa: E731
+            "node_id": w.node_id, "host": "127.0.0.1", "port": w.port,
+        }
+        for i in range(1, n_stages):
+            ws[i].pipeline_stage(
+                engine(), sid="demo", stage=i, n_stages=n_stages,
+                lo=spans[i][0], hi=spans[i][1], **kw,
+            )
+        vpeer0 = await ws[0].connect("127.0.0.1", val.port)
+        ws[0].pipeline_stage(
+            engine(), sid="demo", stage=0, n_stages=n_stages,
+            lo=spans[0][0], hi=spans[0][1],
+            route=[winfo(w) for w in ws[1:]], validator=vpeer0, **kw,
+        )
+        for i, w in enumerate(ws):
+            w.capability = dict(w.capability or {}, hbm_bytes=caps[i])
+            await val.ping(await val.connect("127.0.0.1", w.port))
+        print("fleet (validator's heartbeat-harvested pipeline view):")
+        for nid, rec in val.peer_capabilities.items():
+            print(f"  {nid[:8]}  stage={rec.get('pipe_stage')}/"
+                  f"{rec.get('pipe_n_stages')} "
+                  f"layers=[{rec.get('pipe_lo')},{rec.get('pipe_hi')}) "
+                  f"hbm_bytes={rec.get('hbm_bytes')} "
+                  f"kv_free={rec.get('kv_blocks_free')}")
+        client = user.remote_serving(
+            await user.connect("127.0.0.1", val.port), pipeline=True,
+        )
+        for i, (p, ref) in enumerate(zip(prompts, refs)):
+            rid = await client.submit(p, seed=i)
+            out = await client.result(rid)
+            parity = "token-identical" if np.array_equal(out, ref) \
+                else "MISMATCH"
+            print(f"request {i}: {len(p)}-token prompt -> "
+                  f"{out.tolist()} ({parity} vs single-node)")
+        coord = ws[0].serving.stats()["pipeline"]
+        print(f"head coordinator: ticks={coord['ticks']} "
+              f"act_wire_bytes={coord['act_wire_bytes']} "
+              f"failovers={coord['failovers']}")
+        for i, w in enumerate(ws):
+            st = w._pipe_stage.stats()
+            c = w.metrics.snapshot()["counters"]
+            print(f"stage {i} (layers {st['layers']}): "
+                  f"decode_steps={st['decode_steps']} "
+                  f"bubble_frac={st['bubble_frac']:.3f} "
+                  f"act_wire_bytes_total="
+                  f"{c.get('act_wire_bytes_total', 0)}")
+        for n in (user, val, *ws):
+            await n.stop()
+
+    asyncio.run(demo())
 
 
 def _disaggregate_demo(args) -> None:

@@ -107,7 +107,12 @@ HLO_RULES = {
         "second decode program means some code path retraces per "
         "request shape. The manifest records the enumerated program "
         "names; a new name, a missing name, or a changed per-group "
-        "count is the finding."
+        "count is the finding.\n\n"
+        "Pipeline-sharded serving extends the same contract per stage: "
+        "each stage engine compiles exactly ONE decode + ONE "
+        "prefill_chunk program over its own layer span, so the "
+        "pipeline group's total budget scales with stage count only — "
+        "never with the request mix crossing the activation wire."
     ),
     "TLH106": (
         "Memory budget: temp or argument bytes moved beyond the "
@@ -771,6 +776,34 @@ def canonical_programs(
             skipped.append(("paged", why))
 
     serving_group()
+
+    def pipeline_group() -> list[dict]:
+        from tensorlink_tpu.parallel.pipeserve import PipelineStageEngine
+
+        cfg = LlamaConfig.tiny()
+        m = Llama(cfg)
+        p = m.init(key)
+        eng = InferenceEngine(
+            make_mesh(MeshConfig()), m, p, max_len=64,
+            cache_dtype=jnp.bfloat16, param_dtype=jnp.bfloat16,
+        )
+        # a 2-stage cut through the tiny stack: the per-stage budget is
+        # ONE decode + ONE prefill program REGARDLESS of request mix —
+        # total program count scales with stage count only (TLH105)
+        spans = [(0, 1), (1, cfg.num_layers)]
+        out: list[dict] = []
+        for stage, (lo, hi) in enumerate(spans):
+            seng = PipelineStageEngine(
+                eng, lo=lo, hi=hi, sid="audit", stage=stage,
+                n_stages=len(spans), slots=2, block_size=8,
+                prefill_chunk=16,
+            )
+            for it in seng.audit_programs():
+                it["name"] = f"stage{stage}_{it['name']}"
+                out.append(it)
+        return out
+
+    _try("pipeline", pipeline_group)
 
     def trainer_group() -> list[dict]:
         from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config

@@ -259,6 +259,9 @@ _TAINT_SANITIZERS = {
     "validate_job_request", "from_wire", "int", "float", "bool",
     "str", "len", "min", "max", "round", "unpack_arrays",
     "_clamp_dht_value", "_serve_ids", "_serve_kwargs",
+    # pipeline-sharded serving: peer-fed activation metadata and
+    # payload clamps (roles/worker.py _act_meta, pipeserve codec)
+    "_act_meta", "unpack_act_payload",
 }
 _GROWTH_METHODS = {"append", "add", "extend", "insert", "setdefault"}
 # (receiver-leaf, method) pairs whose mutation is internally bounded

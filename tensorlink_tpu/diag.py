@@ -173,6 +173,15 @@ def node_row(
     serve_mode = (node.get("capability") or {}).get("serving_mode")
     if serve_mode:
         row["role"] = f"{row['role']}/{serve_mode}"
+    # pipeline-sharded serving: a loaded stage names its slot in the
+    # chain (worker/stage1/3) so the table reads as the pipeline's
+    # actual topology — which stage lives where, at a glance
+    pcap = node.get("capability") or {}
+    if pcap.get("pipe_stage") is not None:
+        row["role"] = (
+            f"{node.get('role', '?')}/stage{pcap['pipe_stage']}"
+            f"/{pcap.get('pipe_n_stages', '?')}"
+        )
     row["node_id"] = str(node.get("node_id", "?"))[:16]
     peers = node.get("peers") or {}
     row["peers"] = len(peers)
@@ -259,9 +268,18 @@ def node_row(
         p.get("mfu") for p in progs.values()
         if isinstance(p, dict) and p.get("mfu") is not None
     ]
+    # pipeline stages advertise their decode MFU and bubble fraction
+    # as capability scalars (pipe_mfu / pipe_bubble_frac) — a stage
+    # with a fat bubble is waiting on its NEIGHBOURS' activations, and
+    # rebalancing the layer split (not more chip) is the fix
+    if cap.get("pipe_mfu") is not None:
+        mfus.append(cap["pipe_mfu"])
     if mfus:
         row["mfu_pct"] = round(max(mfus) * 100, 1)
-    gap = dt.get("host_gap_frac", cap.get("host_gap_frac"))
+    gap = dt.get(
+        "host_gap_frac",
+        cap.get("host_gap_frac", cap.get("pipe_bubble_frac")),
+    )
     if gap is not None:
         row["bubble_pct"] = round(float(gap) * 100, 1)
         if float(gap) > 0.3:
@@ -363,9 +381,13 @@ _HIGHER_BETTER = (
     # stay deliberately directionless — payload size is a property of
     # the workload, not a regression axis)
     "vs_colocated",
+    # pipeline-sharded serving: chain tokens/s over the single-node
+    # paged baseline on the same traffic (1.0 = parity; > 1.0 = the
+    # in-flight microbatching hides the hop latency)
+    "vs_single_node",
 )
 _LOWER_BETTER_RE = re.compile(
-    r"(_s$|_s_per_call$|seconds|latency|bubble_fraction|drop_fraction"
+    r"(_s$|_s_per_call$|seconds|latency|bubble_frac|drop_fraction"
     # serving latency percentiles (TTFT/TPOT histograms) and the int8
     # quality KL: smaller is better even where the unit suffix differs
     r"|ttft|tpot|(^|_)kl(_|$)"
@@ -668,7 +690,14 @@ def proto_manifest_diff(old: dict, new: dict) -> dict[str, Any]:
     va = old.get("versions", {})
     vb = new.get("versions", {})
     for k in sorted(set(va) | set(vb)):
-        if va.get(k) != vb.get(k):
+        if va.get(k) == vb.get(k):
+            continue
+        if k not in va:
+            # a version constant born WITH its frame family: no old
+            # peer ever sent those frames, so there is nothing to
+            # skew against — record the pin like a frame addition
+            pins.append(f"version {k}: pinned at {vb.get(k)}")
+        else:
             breaks.append(
                 f"version {k}: {va.get(k)} -> {vb.get(k)}"
             )

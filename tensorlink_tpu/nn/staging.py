@@ -1,0 +1,218 @@
+"""Stage-sliced model construction for pipeline-sharded serving.
+
+A :class:`StageSlice` views a contiguous run ``[lo, hi)`` of a decoder's
+transformer layers as a standalone compute unit: stage 0 additionally owns
+the embedding, the last stage additionally owns the final norm + LM head
+(including the tied-embedding head of GPT-2, whose ``wte`` therefore lives
+on BOTH ends of the pipeline). The slice never re-implements a layer — it
+calls the very same ``TransformerBlock.apply`` the whole-model forward
+uses, with the same mask/positions/cache contract, so composing the
+stages' outputs reproduces the single-chip forward bit-for-bit (token
+parity across the pipeline is an invariant tests pin, not a hope).
+
+Supports the two decoder layouts the repo ships:
+
+- GPT-2 style: ``wte``/``wpe``/``drop``/``blocks``/``ln_f`` + tied head
+  (``wte.attend``)
+- Llama style: ``tok_emb``/``blocks``/``norm_f``/``lm_head`` (RoPE rides
+  ``positions`` into the blocks; no positional embedding table)
+
+``slice_params`` keeps only the subtrees a stage actually needs, which is
+what lets a model whose full weights exceed any single worker's HBM run:
+each worker holds ~1/N of the block stack plus at most one embedding/head.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "StageSlice",
+    "layer_param_bytes",
+    "param_bytes",
+    "stage_spans",
+]
+
+
+def param_bytes(tree) -> int:
+    """Total bytes of every array leaf in a (nested) param tree."""
+    total = 0
+    stack = [tree]
+    while stack:
+        t = stack.pop()
+        if isinstance(t, dict):
+            stack.extend(t.values())
+        elif isinstance(t, (list, tuple)):
+            stack.extend(t)
+        elif hasattr(t, "nbytes"):
+            total += int(t.nbytes)
+        elif hasattr(t, "dtype") and hasattr(t, "size"):
+            total += int(np.dtype(t.dtype).itemsize) * int(t.size)
+    return total
+
+
+def layer_param_bytes(params: dict) -> list[int]:
+    """Per-transformer-layer parameter bytes, in layer order — the load
+    vector :func:`stage_spans` partitions against published HBM."""
+    blocks = params["blocks"]
+    return [param_bytes(blocks[str(i)]) for i in range(len(blocks))]
+
+
+def stage_spans(loads: list[int] | list[float],
+                capacities: list[float]) -> list[tuple[int, int]]:
+    """Partition ``len(loads)`` layers into ``len(capacities)`` contiguous
+    spans ``[lo, hi)``, one per stage, with per-stage load proportional to
+    that stage's capacity (published HBM bytes). Every stage gets at least
+    one layer; layers stay contiguous (activations cross the wire once per
+    stage boundary, so fragmenting a stage buys nothing and costs hops).
+    """
+    n, k = len(loads), len(capacities)
+    if k <= 0:
+        raise ValueError("need at least one stage")
+    if n < k:
+        raise ValueError(f"{n} layers cannot fill {k} stages")
+    if any(c <= 0 for c in capacities):
+        raise ValueError("stage capacities must be positive")
+    total_cap = float(sum(capacities))
+    total_load = float(sum(loads)) or 1.0
+    spans: list[tuple[int, int]] = []
+    lo, acc = 0, 0.0
+    cap_acc = 0.0
+    for s in range(k - 1):
+        cap_acc += capacities[s]
+        target = total_load * (cap_acc / total_cap)
+        hi = lo
+        while hi < n and (acc + loads[hi] <= target or hi == lo):
+            # always take at least one layer; stop once the cumulative
+            # load would overshoot this stage's capacity share
+            acc += loads[hi]
+            hi += 1
+        # leave enough layers for the remaining stages
+        hi = min(hi, n - (k - 1 - s))
+        hi = max(hi, lo + 1)
+        spans.append((lo, hi))
+        lo = hi
+    spans.append((lo, n))
+    return spans
+
+
+class StageSlice:
+    """A contiguous layer span of a decoder model, plus (depending on
+    position) the embedding front or the norm+head tail."""
+
+    def __init__(self, model, lo: int, hi: int):
+        kids = model.children
+        if "wte" in kids and "ln_f" in kids:
+            self.kind = "gpt2"
+        elif "tok_emb" in kids and "norm_f" in kids:
+            self.kind = "llama"
+        else:
+            raise ValueError(
+                "StageSlice supports GPT-2-style (wte/wpe/blocks/ln_f) and "
+                "Llama-style (tok_emb/blocks/norm_f/lm_head) decoders; got "
+                f"children {sorted(kids)}"
+            )
+        stack = kids["blocks"]
+        n = len(stack.children)
+        if not (0 <= lo < hi <= n):
+            raise ValueError(f"layer span [{lo}, {hi}) invalid for {n} layers")
+        self.model = model
+        self.lo, self.hi = lo, hi
+        self.num_layers = n
+        self.first = lo == 0
+        self.last = hi == n
+        self._blocks = [stack.children[str(i)] for i in range(lo, hi)]
+
+    # ------------------------------------------------------------ params
+    def param_keys(self) -> list[str]:
+        keys = ["blocks"]
+        if self.kind == "gpt2":
+            if self.first:
+                keys += ["wte", "wpe", "drop"]
+            if self.last:
+                keys += ["ln_f"]
+                if "wte" not in keys:
+                    keys.append("wte")  # tied head
+        else:
+            if self.first:
+                keys.append("tok_emb")
+            if self.last:
+                keys += ["norm_f", "lm_head"]
+        return keys
+
+    def slice_params(self, params: dict) -> dict:
+        """Keep only this stage's subtrees. The ``blocks`` subtree keeps
+        its original layer keys (``str(lo)``..``str(hi-1)``) so a sliced
+        tree still addresses layers by their global index."""
+        out: dict = {}
+        for k in self.param_keys():
+            if k == "blocks":
+                out["blocks"] = {
+                    str(i): params["blocks"][str(i)]
+                    for i in range(self.lo, self.hi)
+                }
+            elif k in params:
+                out[k] = params[k]
+        return out
+
+    def stage_param_bytes(self, params: dict) -> int:
+        return param_bytes(self.slice_params(params))
+
+    # ----------------------------------------------------------- compute
+    def embed(self, params, ids, positions):
+        """Stage-0 front: token ids -> hidden states. Matches the whole
+        model's embedding path exactly (GPT-2 adds wpe then applies the
+        inference-identity dropout; Llama embeds only — RoPE is applied
+        inside attention from ``positions``)."""
+        if not self.first:
+            raise ValueError("embed() is a stage-0 operation")
+        kids = self.model.children
+        if self.kind == "gpt2":
+            x = kids["wte"].apply(params["wte"], ids)
+            x = x + kids["wpe"].apply(params["wpe"], positions)
+            return kids["drop"].apply(params["drop"], x, train=False)
+        return kids["tok_emb"].apply(params["tok_emb"], ids)
+
+    def body(self, params, x, caches, *, mask, positions):
+        """Run this stage's layers, threading per-layer caches exactly as
+        ``TransformerStack.apply`` does. ``caches`` is stage-local (index
+        0 == global layer ``lo``); returns ``(x, new_caches)``."""
+        new_caches = []
+        for j, blk in enumerate(self._blocks):
+            gi = str(self.lo + j)
+            cache = caches[j] if caches is not None else None
+            x, new_attn = blk.apply(
+                params["blocks"][gi], x, mask=mask,
+                cache=cache, positions=positions,
+            )
+            new_caches.append(new_attn)
+        return x, new_caches
+
+    def head(self, params, x):
+        """Last-stage tail: hidden states -> logits (final norm + head)."""
+        if not self.last:
+            raise ValueError("head() is a last-stage operation")
+        kids = self.model.children
+        if self.kind == "gpt2":
+            x = kids["ln_f"].apply(params["ln_f"], x)
+            return kids["wte"].attend(params["wte"], x)
+        x = kids["norm_f"].apply(params["norm_f"], x)
+        return kids["lm_head"].apply(params["lm_head"], x)
+
+    # ------------------------------------------------------------ caches
+    def init_paged_caches(self, num_blocks: int, block_size: int,
+                          batch: int, max_blocks: int, *, dtype) -> list:
+        """Stage-local paged KV caches — one per layer in ``[lo, hi)``,
+        drawn from this stage's own block pool (the whole point: a stage
+        holds only its own layers' KV)."""
+        return [
+            {"attn": blk.children["attn"].init_paged_cache(
+                num_blocks, block_size, batch, max_blocks, dtype=dtype)}
+            for blk in self._blocks
+        ]
+
+    @property
+    def hidden_dim(self) -> int:
+        cfg = getattr(self.model, "cfg_obj", None) or getattr(
+            self.model, "cfg", None)
+        return int(cfg.dim)

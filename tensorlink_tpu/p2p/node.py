@@ -713,6 +713,7 @@ class Node:
         self.on("STREAM_CHUNK", self._h_stream_chunk)
         self.on("STREAM_END", self._h_stream_end)
         self.on("KV_BLOCKS", self._h_kv_blocks)
+        self.on("ACT_FWD", self._h_act_fwd)
 
     # ------------------------------------------------------ KV-block wire
     # Disaggregated serving's data plane (ROADMAP item 1): a prefill
@@ -766,6 +767,59 @@ class Node:
 
         return serve_error_to_wire(
             ServingError(f"{self.role} node has no KV sink")
+        )
+
+    # ---------------------------------------------------- activation wire
+    # Pipeline-sharded serving's data plane (ROADMAP item 2): per-chunk
+    # activations hop stage-to-stage as one CRC-framed blob
+    # (parallel/pipeserve.py codec). ACT_FWD is the request frame on
+    # every hop; the LAST stage's ACT_RESULT (sampled tokens / first
+    # token) relays back up the chain as each hop's reply, so typed
+    # errors and deadline decrements cross every leg exactly like the
+    # KV wire. Byte counters mirror the KV wire's discipline: the
+    # sender leg counts only after the receiver's reply proves the
+    # payload crossed.
+
+    ACT_TRANSFER_TIMEOUT_S = 60.0
+
+    async def send_activations(
+        self, peer: Peer, blob: bytes, meta: dict | None = None,
+        timeout: float | None = None,
+    ) -> dict:
+        """Ship one packed activation payload
+        (``pipeserve.pack_act_payload``) and await the end-of-chain
+        verdict (``ACT_RESULT``, or a typed ``SERVE_FAILED`` from
+        whichever stage rejected it)."""
+        resp = await self.request(
+            peer,
+            {"type": "ACT_FWD", "meta": dict(meta or {}), "blob": blob},
+            timeout=timeout or self.ACT_TRANSFER_TIMEOUT_S,
+        )
+        self.metrics.incr("act_wire_bytes_total", len(blob))
+        self.metrics.incr("act_wire_transfers_total")
+        return resp
+
+    @wire_guard
+    async def _h_act_fwd(self, node, peer, msg) -> dict:
+        blob = msg.get("blob")
+        if not isinstance(blob, (bytes, bytearray)):
+            peer.ghosts += 1
+            self._penalize(peer)
+            return {"type": "ERROR", "error": "ACT_FWD carries no blob"}
+        self.metrics.incr("act_wire_bytes_total", len(blob))
+        self.metrics.incr("act_wire_transfers_total")
+        return await self.handle_act_fwd(peer, msg)
+
+    async def handle_act_fwd(self, peer: Peer, msg: dict) -> dict:
+        """Role hook: run a pipeline stage over a received activation
+        chunk (and relay downstream). The base node holds no stage."""
+        from tensorlink_tpu.parallel.serving import (
+            ServingError,
+            serve_error_to_wire,
+        )
+
+        return serve_error_to_wire(
+            ServingError(f"{self.role} node has no pipeline stage")
         )
 
     # ------------------------------------------------------------ streaming
@@ -1241,6 +1295,12 @@ class Node:
         # two-leg placement gates on
         "serving_mode", "kv_blocks_free", "kv_blocks_total",
         "kv_block_size",
+        # pipeline-sharded serving: HBM capacity claim (the quantity
+        # stage partitioning is proportional to) and the loaded stage's
+        # identity/health — the replacement planner recruits spares and
+        # tldiag renders ROLE/MFU%/BUBBLE% from these
+        "hbm_bytes", "pipe_sid", "pipe_stage", "pipe_n_stages",
+        "pipe_lo", "pipe_hi", "pipe_bubble_frac", "pipe_mfu",
     )
     _CAP_MAX_PROGRAMS = 16
 

@@ -1,0 +1,1194 @@
+"""Pipeline-sharded serving: serve models no single worker can hold.
+
+The training path has sliced models across peers since the seed
+(``roles/worker.py`` StageRunner); this module brings the same vertical
+partitioning to *serving*. The layer stack is cut into contiguous stages
+(:func:`tensorlink_tpu.nn.staging.stage_spans`, proportional to each
+worker's published HBM), every stage worker runs a **stage-local paged
+engine** — its own :class:`~tensorlink_tpu.parallel.kvpool.BlockPool`
+holds only that stage's KV blocks — and per-chunk activations ([S, 1, D]
+per decode tick, [1, C, D] per prefill chunk — tiny, so ICI-less P2P hops
+are affordable exactly where PR 15 showed KV blocks are) stream
+worker-to-worker over the native CRC-framed codec via ``ACT_FWD`` /
+``ACT_RESULT`` frames in ``p2p/node.py``.
+
+Token parity is an invariant, not a tuning goal: every stage program is a
+layer-range restriction of the single-chip paged programs in
+``parallel/serving.py`` (same valid-mask update, same write-index
+discipline, same logical-coordinate causality), and sampling keys remain
+``fold_in(key(seed), position)`` — so an N-stage pipeline emits the exact
+token stream a single node with N× the HBM would.
+
+Continuous batching stays live *across* the pipeline: the head
+(:class:`PipelineCoordinator`) overlaps decode ticks of resident slots
+with prefill chunks of newly admitted ones, so different slots occupy
+different stages each tick and stage bubbles are filled by co-resident
+traffic (in-flight microbatching).
+
+Failure semantics reuse PR 15's machinery wholesale: typed
+``serve_error_to_wire`` errors cross every hop, end-to-end deadlines are
+decremented per leg, and a dead stage is survived by validator
+re-recruitment of a replica plus **prefix re-prefill** — the head keeps
+prompt + accepted tokens host-side, re-prefills them through the repaired
+chain, and position-keyed sampling continues the stream without losing or
+re-drawing a single accepted token.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from math import ceil
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorlink_tpu.nn.staging import StageSlice, layer_param_bytes, stage_spans
+from tensorlink_tpu.parallel.inference import (
+    GenerationConfig,
+    declared_compute_dtype,
+    sample_logits,
+)
+from tensorlink_tpu.parallel.kvpool import BlockPool, PoolExhaustedError
+from tensorlink_tpu.parallel.serving import (
+    DeadlineExceededError,
+    PoolOverloadedError,
+    Priority,
+    PromptTooLongError,
+    QueueFullError,
+    ServingError,
+    serve_error_from_wire,
+)
+from tensorlink_tpu.p2p.serialization import pack_arrays, unpack_arrays
+
+__all__ = [
+    "ACT_WIRE_SCHEMA",
+    "MAX_ACT_BYTES",
+    "PipelineCoordinator",
+    "PipelineStageEngine",
+    "layer_param_bytes",  # re-exported: deployers size stages with these
+    "pack_act_payload",
+    "plan_pipeline",
+    "stage_spans",
+    "unpack_act_payload",
+]
+
+
+# --------------------------------------------------------- activation wire
+# The activation payload is deliberately minimal: ONE tensor plus a schema
+# pin, framed by the same CRC-32C msgpack codec KV blocks ride
+# (p2p/serialization.py). All routing/shape metadata travels in the
+# ACT_FWD frame's ``meta`` dict where the receiving role's sanitizer can
+# clamp it field-by-field (tlproto TLP201).
+
+ACT_WIRE_SCHEMA = 1
+
+# hostile-ingest bound: a decode tick is S*D values and a prefill chunk
+# C*D — even a 70B-class stage at fp32 stays well under this; anything
+# bigger is a hostile or corrupt frame, not traffic
+MAX_ACT_BYTES = 256 << 20
+
+
+def pack_act_payload(x, codec: str = "zstd") -> bytes:
+    """Activation tensor (or sampled-token vector) -> wire blob."""
+    return pack_arrays(
+        {
+            "schema": np.asarray(ACT_WIRE_SCHEMA, np.int32),
+            "x": np.asarray(x),
+        },
+        codec=codec,
+    )
+
+
+def unpack_act_payload(blob) -> np.ndarray:
+    """Wire blob -> activation tensor, CRC-checked by the codec and
+    schema/size-clamped here (this is the taint sanitizer for peer-fed
+    activation payloads — the stage engine still validates exact shape
+    against its compiled program before any compute)."""
+    if not isinstance(blob, (bytes, bytearray)):
+        raise ValueError("activation blob must be bytes")
+    if len(blob) > MAX_ACT_BYTES:
+        raise ValueError(
+            f"activation blob {len(blob)}B exceeds cap {MAX_ACT_BYTES}B"
+        )
+    arrs = unpack_arrays(bytes(blob))
+    schema = int(np.asarray(arrs.get("schema", -1)).reshape(-1)[0])
+    if schema != ACT_WIRE_SCHEMA:
+        raise ValueError(
+            f"activation wire schema {schema} != {ACT_WIRE_SCHEMA} "
+            "(incompatible peer build)"
+        )
+    x = np.asarray(arrs["x"])
+    if x.ndim > 3:
+        raise ValueError(f"activation rank {x.ndim} > 3")
+    return x
+
+
+# -------------------------------------------------------------- placement
+def plan_pipeline(
+    fleet: dict[str, dict],
+    *,
+    n_stages: int | None = None,
+    need_bytes: int = 0,
+    exclude=(),
+) -> dict | None:
+    """Pick pipeline stage workers from published capability records.
+
+    Eligibility requires an ``hbm_bytes`` capacity claim (the quantity
+    the layer partition is proportional to). Workers are ranked by
+    published HBM, roofline decode bandwidth as tiebreak; when
+    ``n_stages`` is not forced, the plan takes the FEWEST workers whose
+    summed HBM covers ``need_bytes`` — every extra stage is an extra
+    per-token wire hop, so depth is a cost, not a goal. Returns ``None``
+    when the fleet cannot hold the model at all (the caller renders the
+    typed unplaceable error)."""
+    exclude = set(exclude or ())
+    elig = []
+    for nid, cap in (fleet or {}).items():
+        if nid in exclude or not isinstance(cap, dict):
+            continue
+        try:
+            hbm = float(cap.get("hbm_bytes") or 0.0)
+        except (TypeError, ValueError):
+            continue
+        if hbm <= 0:
+            continue
+        try:
+            gbps = float(cap.get("hbm_gbps") or 0.0)
+        except (TypeError, ValueError):
+            gbps = 0.0
+        elig.append((nid, hbm, gbps))
+    elig.sort(key=lambda t: (-t[1], -t[2], t[0]))
+    if n_stages is not None:
+        k = int(n_stages)
+        if k < 1 or len(elig) < k:
+            return None
+        pick = elig[:k]
+        if need_bytes and sum(h for _, h, _ in pick) < need_bytes:
+            return None
+    else:
+        if need_bytes <= 0:
+            raise ValueError("plan_pipeline needs n_stages or need_bytes")
+        pick, acc = [], 0.0
+        for row in elig:
+            pick.append(row)
+            acc += row[1]
+            if acc >= need_bytes:
+                break
+        if acc < need_bytes:
+            return None
+    return {
+        "stages": [nid for nid, _, _ in pick],
+        "capacities": [h for _, h, _ in pick],
+    }
+
+
+# ----------------------------------------------------------- stage engine
+class PipelineStageEngine:
+    """One pipeline stage: a layer-range restriction of the paged serving
+    programs, over a stage-local block pool.
+
+    Exactly TWO compiled programs per stage (tlhlo TLH105: the pipeline's
+    program-count budget scales with stage count and nothing else):
+
+    - ``decode``: one tick for all S slots. Stage 0 embeds the fed
+      tokens; every stage runs its layers through its paged KV; the last
+      stage applies the head and samples per-slot with the same
+      ``fold_in(key(seed), position)`` stream as the single-chip scan.
+    - ``prefill_chunk``: one shape-static chunk of one slot, writing
+      through the slot's block-table row — the mirror of
+      ``PagedContinuousBatchingEngine._build_prefill_chunk`` restricted
+      to this stage's layers.
+
+    Host-side slot/admission bookkeeping (block alloc, table ops, retire)
+    reuses the paged engine's discipline; prefix caching is deliberately
+    NOT wired here (a prefix hit would have to hit on every stage at once
+    to be sound — cross-stage prefix coherence is future work)."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        lo: int,
+        hi: int,
+        sid: str = "pipe",
+        stage: int = 0,
+        n_stages: int = 1,
+        slots: int = 4,
+        gen: GenerationConfig | None = None,
+        block_size: int = 16,
+        num_blocks: int | None = None,
+        prefill_chunk: int = 16,
+        max_len: int | None = None,
+        metrics=None,
+        recorder=None,
+        capability: dict | None = None,
+        **_ignored,
+    ):
+        self.slice = StageSlice(engine.model, lo, hi)
+        self.sid = str(sid)
+        self.stage = int(stage)
+        self.n_stages = int(n_stages)
+        self.slots = int(slots)
+        self.gen = gen or GenerationConfig()
+        self.L = int(max_len or engine.max_len)
+        self.block_size = int(block_size)
+        if self.L % self.block_size:
+            raise ValueError(
+                f"block_size {block_size} must divide the cache view "
+                f"width {self.L}"
+            )
+        self.chunk_len = int(prefill_chunk)
+        self.cache_dtype = engine.cache_dtype
+        self.metrics = metrics
+        self.recorder = recorder
+        self.capability = capability
+        # the stage holds ONLY its own subtrees — this is what lets a
+        # model larger than any one worker's HBM run at all
+        self.params = jax.tree.map(
+            jax.device_put, self.slice.slice_params(engine.params)
+        )
+        self.max_blocks = MB = self.L // self.block_size
+        nb = num_blocks if num_blocks is not None else self.slots * MB
+        self.pool = BlockPool(
+            int(nb), self.block_size, metrics=metrics, recorder=recorder
+        )
+        self._slot_blocks: list[list[int]] = [[] for _ in range(self.slots)]
+        caches = self.slice.init_paged_caches(
+            self.pool.num_blocks, self.block_size, self.slots, MB,
+            dtype=self.cache_dtype,
+        )
+        self._state = jax.tree.map(jax.device_put, {
+            "caches": caches,
+            "valid": jnp.zeros((self.slots, self.L), bool),
+        })
+        self._lock = threading.Lock()
+        self._decode_c = None  # AOT-compiled (cost analysis for free)
+        self._prefill_c = None
+        self._table_op = self._build_table_op()
+        self._retire_op = self._build_retire_op()
+        self._decode_cost: dict | None = None
+        # busy-vs-wall attribution for the per-stage MFU%/BUBBLE%
+        # columns in tldiag: busy is device time under this engine's
+        # programs, the window is first-to-last activity
+        self._busy = {"decode": 0.0, "prefill": 0.0}
+        self._steps = {"decode": 0, "prefill": 0}
+        self._t_first: float | None = None
+        self._t_last: float | None = None
+
+    # ---------------------------------------------------------- programs
+    def _build_decode(self):
+        sl, S, L = self.slice, self.slots, self.L
+        gen = self.gen
+        temperature, top_k, top_p = (
+            float(gen.temperature), int(gen.top_k), float(gen.top_p)
+        )
+
+        def sample_row(seed, n, logits_row):
+            key = jax.random.fold_in(jax.random.key(seed), n)
+            return sample_logits(logits_row, key, temperature, top_k, top_p)
+
+        def step(params, state, xin, n_valid, live, seeds):
+            caches, valid = state["caches"], state["valid"]
+            rows = jnp.arange(S)
+            index = caches[0]["attn"]["index"]
+            # identical to the single-chip scan: the fed token's cache
+            # slot becomes attendable for live rows only
+            valid = valid.at[rows, index].max(live, mode="drop")
+            if sl.first:
+                x = sl.embed(params, xin[:, None], n_valid[:, None])
+            else:
+                x = xin
+            x, new_attn = sl.body(
+                params, x, [c["attn"] for c in caches],
+                mask=valid[:, None, None, :],
+                positions=n_valid[:, None],
+            )
+            new_index = index + live.astype(jnp.int32)
+            new_caches = [
+                {"attn": {**a, "index": new_index}} for a in new_attn
+            ]
+            new_state = {"caches": new_caches, "valid": valid}
+            if sl.last:
+                logits = sl.head(params, x)
+                new_n = n_valid + live.astype(jnp.int32)
+                nxt = jax.vmap(sample_row)(
+                    seeds, new_n, logits[:, -1]
+                ).astype(jnp.int32)
+                return nxt, new_state
+            return x, new_state
+
+        return jax.jit(step, donate_argnums=(1,))
+
+    def _build_prefill_chunk(self):
+        sl, L, C = self.slice, self.L, self.chunk_len
+        gen = self.gen
+        temperature, top_k, top_p = (
+            float(gen.temperature), int(gen.top_k), float(gen.top_p)
+        )
+
+        def chunk(params, state, xin, slot, start, nreal, seed):
+            caches = state["caches"]
+            tmp = [
+                {
+                    "k": lc["attn"]["k"],
+                    "v": lc["attn"]["v"],
+                    "index": jnp.full((1,), start, jnp.int32),
+                    "block_table": jax.lax.dynamic_slice_in_dim(
+                        lc["attn"]["block_table"], slot, 1, axis=0
+                    ),
+                }
+                for lc in caches
+            ]
+            positions = (start + jnp.arange(C))[None, :]
+            if sl.first:
+                x = sl.embed(params, xin, positions)
+            else:
+                x = xin
+            # mask=None: the paged attention path builds causality in
+            # logical coordinates — exactly the single-chip chunk
+            x, new_tmp = sl.body(
+                params, x, tmp, mask=None, positions=positions
+            )
+            new_caches = [
+                {"attn": {
+                    "k": nt["k"],
+                    "v": nt["v"],
+                    "index": lc["attn"]["index"].at[slot].set(start + nreal),
+                    "block_table": lc["attn"]["block_table"],
+                }}
+                for lc, nt in zip(caches, new_tmp)
+            ]
+            n_end = start + nreal
+            new_state = {
+                "caches": new_caches,
+                "valid": state["valid"].at[slot].set(jnp.arange(L) < n_end),
+            }
+            if sl.last:
+                logits = sl.head(params, x)
+                last = jax.lax.dynamic_index_in_dim(
+                    logits[0], nreal - 1, axis=0, keepdims=False
+                )
+                key0 = jax.random.fold_in(jax.random.key(seed), n_end)
+                tok0 = sample_logits(
+                    last, key0, temperature, top_k, top_p
+                ).astype(jnp.int32)
+                return tok0, new_state
+            return x, new_state
+
+        return jax.jit(chunk, donate_argnums=(1,))
+
+    def _build_table_op(self):
+        def run(state, slot, row):
+            new_caches = [
+                {"attn": {
+                    **lc["attn"],
+                    "index": lc["attn"]["index"].at[slot].set(0),
+                    "block_table": lc["attn"]["block_table"].at[slot].set(
+                        row
+                    ),
+                }}
+                for lc in state["caches"]
+            ]
+            return {**state, "caches": new_caches}
+
+        return jax.jit(run, donate_argnums=(0,))
+
+    def _build_retire_op(self):
+        NB, MB, L = self.pool.num_blocks, self.max_blocks, self.L
+
+        def run(state, slot):
+            new_caches = [
+                {"attn": {
+                    **lc["attn"],
+                    "block_table": lc["attn"]["block_table"].at[slot].set(
+                        jnp.full((MB,), NB, jnp.int32)
+                    ),
+                }}
+                for lc in state["caches"]
+            ]
+            return {
+                **state,
+                "caches": new_caches,
+                "valid": state["valid"].at[slot].set(jnp.zeros((L,), bool)),
+            }
+
+        return jax.jit(run, donate_argnums=(0,))
+
+    # -------------------------------------------------------------- host
+    def _note(self, tag: str, dt: float) -> None:
+        now = time.perf_counter()
+        self._busy[tag] += dt
+        self._steps[tag] += 1
+        if self._t_first is None:
+            self._t_first = now - dt
+        self._t_last = now
+
+    def begin_request(self, slot: int, n_ctx: int, budget: int) -> None:
+        """Admit (or re-admit) a request into ``slot``: release the
+        previous tenant's blocks, allocate enough for prompt + budget
+        up front, point the slot's block-table row at them. Upfront
+        allocation keeps the decode tick free of growth ops — the wire
+        already serializes ticks, so admission is the only place the
+        pool is touched."""
+        slot = int(slot)
+        n_ctx, budget = int(n_ctx), int(budget)
+        if not (0 <= slot < self.slots):
+            raise ValueError(f"slot {slot} out of range")
+        if n_ctx < 1 or n_ctx + budget > self.L:
+            raise PromptTooLongError(
+                f"prompt {n_ctx} + budget {budget} exceeds cache view "
+                f"width {self.L}"
+            )
+        nblocks = ceil(min(n_ctx + budget, self.L) / self.block_size)
+        with self._lock:
+            for bid in self._slot_blocks[slot]:
+                self.pool.release(bid)
+            self._slot_blocks[slot] = []
+            try:
+                blocks = self.pool.alloc(nblocks)
+            except PoolExhaustedError as e:
+                raise PoolOverloadedError(
+                    f"stage {self.stage} pool exhausted: {e}"
+                ) from e
+            self._slot_blocks[slot] = blocks
+            row = np.full((self.max_blocks,), self.pool.num_blocks, np.int32)
+            row[: len(blocks)] = blocks
+            self._state = self._table_op(
+                self._state, jnp.int32(slot), jnp.asarray(row)
+            )
+
+    def release_slot(self, slot: int) -> None:
+        slot = int(slot)
+        with self._lock:
+            for bid in self._slot_blocks[slot]:
+                self.pool.release(bid)
+            self._slot_blocks[slot] = []
+            self._state = self._retire_op(self._state, jnp.int32(slot))
+
+    def reset_all(self) -> None:
+        for s in range(self.slots):
+            self.release_slot(s)
+
+    def _expect_x(self, x: np.ndarray, shape: tuple, dtype) -> jnp.ndarray:
+        x = jnp.asarray(x)
+        if tuple(x.shape) != shape:
+            raise ValueError(
+                f"activation shape {tuple(x.shape)} != expected {shape}"
+            )
+        return x.astype(dtype)
+
+    def _act_dtype(self):
+        return jnp.asarray(
+            jax.tree.leaves(self.params["blocks"])[0]
+        ).dtype
+
+    def prefill_chunk(self, slot, xin, start, nreal, seed,
+                      n_ctx=None, budget=None):
+        """Run one prefill chunk for ``slot``. On the first chunk
+        (``start == 0``) the slot is (re)admitted with ``n_ctx``/
+        ``budget``. Returns the stage output as a host array: hidden
+        states for relaying stages, the sampled first token for the
+        last stage (meaningful only on the final chunk — identical to
+        the single-chip program, which also samples every chunk and
+        lets the host keep only the last draw)."""
+        slot, start, nreal = int(slot), int(start), int(nreal)
+        C = self.chunk_len
+        if not (1 <= nreal <= C) or start < 0 or start + nreal > self.L:
+            raise ValueError("prefill chunk out of bounds")
+        if start == 0:
+            if n_ctx is None or budget is None:
+                raise ValueError("first chunk needs n_ctx and budget")
+            self.begin_request(slot, n_ctx, budget)
+        if self.slice.first:
+            x = self._expect_x(xin, (1, C), None).astype(jnp.int32)
+        else:
+            x = self._expect_x(
+                xin, (1, C, self.slice.hidden_dim), self._act_dtype()
+            )
+        with self._lock:
+            args = (
+                self.params, self._state, x, jnp.int32(slot),
+                jnp.int32(start), jnp.int32(nreal), jnp.uint32(seed),
+            )
+            t0 = time.perf_counter()
+            if self._prefill_c is None:
+                self._prefill_c = self._build_prefill_chunk()
+            out, self._state = self._prefill_c(*args)
+            out = np.asarray(out)
+            self._note("prefill", time.perf_counter() - t0)
+        return out
+
+    def decode_step(self, xin, n_valid, live, seeds):
+        """One decode tick across all S slots. ``xin`` is the fed token
+        vector [S] on stage 0 and the upstream hidden states [S, 1, D]
+        elsewhere; ``n_valid``/``live``/``seeds`` ride the wire from the
+        head so every stage computes with identical row state. Returns
+        hidden states (relay stages) or sampled tokens [S] (last)."""
+        S = self.slots
+        n_valid = np.asarray(n_valid, np.int32)
+        live = np.asarray(live, bool)
+        seeds = np.asarray(seeds, np.uint32)
+        if n_valid.shape != (S,) or live.shape != (S,) or seeds.shape != (S,):
+            raise ValueError("decode row-state arrays must be [slots]")
+        if self.slice.first:
+            x = self._expect_x(xin, (S,), None).astype(jnp.int32)
+        else:
+            x = self._expect_x(
+                xin, (S, 1, self.slice.hidden_dim), self._act_dtype()
+            )
+        with self._lock:
+            args = (
+                self.params, self._state, x, jnp.asarray(n_valid),
+                jnp.asarray(live), jnp.asarray(seeds),
+            )
+            t0 = time.perf_counter()
+            if self._decode_c is None:
+                self._decode_c = self._build_decode()
+                self._capture_decode_cost(args)
+            out, self._state = self._decode_c(*args)
+            out = np.asarray(out)
+            self._note("decode", time.perf_counter() - t0)
+        return out
+
+    def _capture_decode_cost(self, args) -> None:
+        """Opportunistic XLA cost analysis for the decode tick — the
+        flops behind the per-stage MFU% column. Advisory: not every
+        backend reports."""
+        try:
+            cost = self._decode_c.lower(*args).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            rec = {}
+            if cost.get("flops"):
+                rec["flops"] = float(cost["flops"])
+            if cost.get("bytes accessed"):
+                rec["bytes"] = float(cost["bytes accessed"])
+            self._decode_cost = rec or None
+        except Exception:  # noqa: BLE001 — telemetry must not fail serving
+            self._decode_cost = None
+
+    # ------------------------------------------------------------- audit
+    def audit_programs(self) -> list[dict]:
+        """Compiled-program inventory for tlhlo: ONE decode + ONE
+        prefill program per stage (the TLH105 pipeline budget). Fresh
+        jits lowered from avals — nothing executes or touches the
+        donated live state."""
+        dt = declared_compute_dtype(self.params)
+        with self._lock:
+            donated = len(jax.tree.leaves(self._state))
+            state_sds = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                self._state,
+            )
+        S, C, D = self.slots, self.chunk_len, self.slice.hidden_dim
+        sds = jax.ShapeDtypeStruct
+        i32, u32 = jnp.int32, jnp.uint32
+        act = jnp.dtype(self._act_dtype())
+        dec_x = sds((S,), i32) if self.slice.first else sds((S, 1, D), act)
+        pre_x = sds((1, C), i32) if self.slice.first else sds((1, C, D), act)
+
+        def lower_decode():
+            return self._build_decode().lower(
+                self.params, state_sds, dec_x, sds((S,), i32),
+                sds((S,), jnp.bool_), sds((S,), u32),
+            )
+
+        def lower_prefill():
+            return self._build_prefill_chunk().lower(
+                self.params, state_sds, pre_x, sds((), i32), sds((), i32),
+                sds((), i32), sds((), u32),
+            )
+
+        return [
+            {"name": "decode", "dtype": dt, "donated": donated,
+             "lower": lower_decode},
+            {"name": "prefill_chunk", "dtype": dt, "donated": donated,
+             "lower": lower_prefill},
+        ]
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        with self._lock:
+            busy_d, busy_p = self._busy["decode"], self._busy["prefill"]
+            steps_d, steps_p = self._steps["decode"], self._steps["prefill"]
+            t_first, t_last = self._t_first, self._t_last
+            cost = self._decode_cost
+        busy = busy_d + busy_p
+        window = 0.0
+        if t_first is not None and t_last is not None:
+            window = max(t_last - t_first, 0.0)
+        bubble = max(0.0, 1.0 - busy / window) if window > 1e-9 else 0.0
+        out = {
+            "pipeline_stage": self.stage,
+            "pipeline_n_stages": self.n_stages,
+            "layers": [self.slice.lo, self.slice.hi],
+            "decode_steps": steps_d,
+            "prefill_chunks": steps_p,
+            "decode_s": round(busy_d, 6),
+            "prefill_s": round(busy_p, 6),
+            "busy_s": round(busy, 6),
+            "window_s": round(window, 6),
+            "bubble_frac": round(bubble, 4),
+            "pool": self.pool.stats(),
+        }
+        mfu = self._mfu_from(cost, busy_d, steps_d)
+        if mfu is not None:
+            out["mfu"] = mfu
+        return out
+
+    def stage_mfu(self) -> float | None:
+        """Measured decode MFU against the published roofline — the
+        tldiag per-stage MFU% column. None when the backend reports no
+        flops or no capability was measured."""
+        with self._lock:
+            cost = self._decode_cost
+            busy, n = self._busy["decode"], self._steps["decode"]
+        return self._mfu_from(cost, busy, n)
+
+    def _mfu_from(
+        self, cost: dict | None, busy: float, n: int,
+    ) -> float | None:
+        peak = (self.capability or {}).get("peak_tflops")
+        if not cost or not cost.get("flops") or not peak or busy <= 0:
+            return None
+        return round(
+            (cost["flops"] * n / busy) / (float(peak) * 1e12), 6
+        )
+
+
+# ------------------------------------------------------------ coordinator
+class PipelineCoordinator:
+    """Head-of-pipeline scheduler (runs on the stage-0 worker).
+
+    Duck-types the serving-engine surface the worker's SERVE_SUBMIT /
+    SERVE_RESULT handlers and :class:`RemoteServingClient` already speak
+    — ``asubmit`` / ``aresult`` / ``stats`` / ``pool`` — so the entire
+    PR 15 client path works against a pipeline unchanged.
+
+    Per decode tick: run the local stage-0 program over ALL slots, ship
+    the [S, 1, D] hidden states down the chain as one ``ACT_FWD`` whose
+    reply (the last stage's sampled tokens) relays back up, then apply
+    EOS/budget bookkeeping host-side. Prefill streams chunk-by-chunk the
+    same way. Admissions overlap in-flight ticks (asyncio.gather), so a
+    newly admitted request's prefill chunks occupy early stages while
+    resident slots' decode traffic occupies later ones."""
+
+    ACT_TIMEOUT_S = 60.0
+
+    def __init__(
+        self,
+        node,
+        engine: PipelineStageEngine,
+        *,
+        route: list[dict],
+        sid: str,
+        validator=None,
+        max_queue: int = 64,
+        gen: GenerationConfig | None = None,
+    ):
+        self.node = node
+        self.engine = engine
+        self.route = [dict(w) for w in (route or [])]
+        self.sid = str(sid)
+        self.n_stages = len(self.route) + 1
+        self.validator = validator
+        self.gen = gen or engine.gen
+        self.max_queue = int(max_queue)
+        self.slots = engine.slots
+        self.L = engine.L
+        self._requests: dict[int, dict] = {}
+        self._slot_rid: list[int | None] = [None] * self.slots
+        self._queue: list[int] = []
+        self._next_rid = 1
+        self._wake = asyncio.Event()
+        self._pump_task: asyncio.Task | None = None
+        self._opened = False
+        self._ticks = 0
+        self._act_bytes = 0
+        self._failovers = 0
+        self._refills = 0
+
+    # expose the stage-0 pool so capability records advertise real
+    # KV headroom for this node's share of the pipeline
+    @property
+    def pool(self):
+        return self.engine.pool
+
+    # ------------------------------------------------------------ submit
+    async def asubmit(
+        self, ids, *, max_new: int | None = None, seed: int = 0,
+        priority=Priority.STANDARD, deadline_s: float | None = None,
+    ) -> int:
+        ids = [int(t) for t in np.asarray(ids).reshape(-1)]
+        max_new = int(max_new if max_new is not None else
+                      self.gen.max_new_tokens)
+        if not ids:
+            raise ServingError("empty prompt")
+        if len(ids) + max_new > self.L:
+            raise PromptTooLongError(
+                f"prompt {len(ids)} + max_new {max_new} exceeds pipeline "
+                f"cache view width {self.L}"
+            )
+        if len(self._queue) >= self.max_queue:
+            raise QueueFullError(
+                f"pipeline admission queue full ({self.max_queue})",
+                retry_after_s=1.0,
+            )
+        rid = self._next_rid
+        self._next_rid += 1
+        self._requests[rid] = {
+            "rid": rid, "ids": ids, "max_new": max_new,
+            "seed": int(seed) & 0xFFFFFFFF,
+            "deadline_at": (
+                time.perf_counter() + float(deadline_s)
+                if deadline_s is not None else None
+            ),
+            "tokens": [], "state": "queued", "slot": None,
+            "last_tok": 0, "n_valid": 0,
+            "done": asyncio.Event(), "error": None,
+        }
+        self._queue.append(rid)
+        self._ensure_pump()
+        return rid
+
+    async def aresult(
+        self, rid: int, *, timeout_s: float | None = None,
+        deadline_s: float | None = None,
+    ) -> list[int]:
+        req = self._requests.get(int(rid))
+        if req is None:
+            raise ServingError(f"unknown rid {rid}")
+        wait = timeout_s if timeout_s is not None else deadline_s
+        try:
+            if wait is None:
+                await req["done"].wait()
+            else:
+                await asyncio.wait_for(req["done"].wait(), float(wait))
+        except asyncio.TimeoutError:
+            if deadline_s is not None and timeout_s is None:
+                self._fail(req, DeadlineExceededError(
+                    f"rid {rid} missed its result deadline", rid=rid
+                ))
+            else:
+                # soft timeout: the stream is still running and
+                # collectable by a later poll — typed so the client
+                # can tell this from a dead leg
+                raise TimeoutError(
+                    f"rid {rid} still decoding after {wait}s"
+                ) from None
+        if req["error"] is not None:
+            self._requests.pop(int(rid), None)
+            raise req["error"]
+        self._requests.pop(int(rid), None)
+        return list(req["tokens"])
+
+    # -------------------------------------------------------------- pump
+    def _ensure_pump(self) -> None:
+        self._wake.set()
+        if self._pump_task is None or self._pump_task.done():
+            self._pump_task = asyncio.get_running_loop().create_task(
+                self._pump()
+            )
+
+    def _active(self) -> list[dict]:
+        return [
+            self._requests[r] for r in self._slot_rid
+            if r is not None and r in self._requests
+        ]
+
+    async def open(self) -> None:
+        """Geometry handshake: PIPE_LOAD every downstream stage and
+        verify sid/slot-count/cache-width/layer-contiguity before any
+        activation crosses the wire."""
+        if self._opened:
+            return
+        want_lo = self.engine.slice.hi
+        for i in range(1, self.n_stages):
+            peer = await self._stage_peer(i)
+            resp = await self.node.request(peer, {
+                "type": "PIPE_LOAD", "sid": self.sid, "stage": i,
+                "n_stages": self.n_stages, "slots": self.slots,
+                "max_len": self.L, "reset": False,
+            })
+            self._check_act(resp, "PIPE_LOAD")
+            if int(resp.get("lo", -1)) != want_lo:
+                raise ServingError(
+                    f"stage {i} layers [{resp.get('lo')}, "
+                    f"{resp.get('hi')}) do not continue [.., {want_lo})"
+                )
+            want_lo = int(resp.get("hi", -1))
+        if want_lo != self.engine.slice.num_layers:
+            raise ServingError(
+                f"pipeline covers layers up to {want_lo} of "
+                f"{self.engine.slice.num_layers}"
+            )
+        self._opened = True
+
+    async def _pump(self) -> None:
+        while True:
+            try:
+                if not self._opened:
+                    await self.open()
+            except Exception as e:  # noqa: BLE001 — typed + transport
+                self._fail_all(e)
+                return
+            self._expire_deadlines()
+            admits = []
+            while self._queue and None in self._slot_rid:
+                rid = self._queue.pop(0)
+                req = self._requests.get(rid)
+                if req is None:
+                    continue
+                slot = self._slot_rid.index(None)
+                self._slot_rid[slot] = rid
+                req["slot"] = slot
+                req["state"] = "prefill"
+                admits.append(req)
+            decoding = [
+                r for r in self._active() if r["state"] == "decoding"
+            ]
+            tasks = []
+            if decoding:
+                tasks.append(self._tick(decoding))
+            tasks.extend(self._prefill(r) for r in admits)
+            if not tasks:
+                if not self._queue and not self._active():
+                    return  # idle: next asubmit restarts the pump
+                self._wake.clear()
+                await self._wake.wait()
+                continue
+            results = await asyncio.gather(*tasks, return_exceptions=True)
+            for err in results:
+                if isinstance(err, Exception):
+                    await self._handle_chain_error(err)
+
+    def _expire_deadlines(self) -> None:
+        now = time.perf_counter()
+        for req in list(self._requests.values()):
+            da = req["deadline_at"]
+            if da is not None and now > da and not req["done"].is_set():
+                self._fail(req, DeadlineExceededError(
+                    f"rid {req['rid']} deadline passed mid-pipeline",
+                    rid=req["rid"],
+                ))
+
+    # ------------------------------------------------------------- legs
+    async def _stage_peer(self, i: int):
+        winfo = self.route[i - 1]
+        p = self.node.peers.get(winfo["node_id"])
+        if p is not None:
+            return p
+        return await self.node.connect_candidates(
+            winfo["host"], int(winfo["port"]),
+            tuple(winfo.get("alt_hosts", ()) or ()),
+            expect_id=winfo["node_id"],
+        )
+
+    @staticmethod
+    def _check_act(resp: dict, want: str) -> dict:
+        if isinstance(resp, dict) and resp.get("type") == "SERVE_FAILED":
+            e = serve_error_from_wire(resp)
+            e.dead_stage = resp.get("dead_stage")
+            e.dead_node = resp.get("dead_node")
+            raise e
+        if not isinstance(resp, dict) or resp.get("type") != want:
+            raise ServingError(
+                f"pipeline hop replied "
+                f"{resp.get('type') if isinstance(resp, dict) else resp!r}, "
+                f"wanted {want}"
+            )
+        return resp
+
+    async def _chain(self, out, meta: dict) -> dict:
+        """Ship a stage-0 output down the chain; the last stage's
+        ACT_RESULT relays back as this request's reply. Transport
+        failures on the FIRST hop are tagged dead_stage=1 here; deeper
+        hops tag themselves in their typed relay error."""
+        blob = await asyncio.to_thread(pack_act_payload, out)
+        self._act_bytes += len(blob)
+        route_rest = [
+            {k: w[k] for k in ("node_id", "host", "port") if k in w}
+            | {"alt_hosts": list(w.get("alt_hosts", ()) or [])}
+            for w in self.route[1:]
+        ]
+        meta = {
+            **meta, "sid": self.sid, "stage": 1, "route": route_rest,
+        }
+        try:
+            peer = await self._stage_peer(1)
+            resp = await self.node.send_activations(
+                peer, blob, meta, timeout=self.ACT_TIMEOUT_S
+            )
+        except (ConnectionError, OSError, asyncio.TimeoutError,
+                TimeoutError) as e:
+            err = ServingError(f"pipeline stage 1 unreachable: {e}")
+            err.dead_stage = 1
+            err.dead_node = self.route[0].get("node_id")
+            raise err from e
+        return self._check_act(resp, "ACT_RESULT")
+
+    def _leg_deadline(self, reqs) -> float | None:
+        das = [r["deadline_at"] for r in reqs if r["deadline_at"]]
+        if not das:
+            return None
+        return max(0.001, min(das) - time.perf_counter())
+
+    async def _prefill(self, req: dict) -> None:
+        """Stream one request's prompt (plus, after a failover, its
+        already-accepted tokens) through the pipeline chunk-by-chunk.
+        The final chunk's relayed ``tok0`` is the next token of the
+        stream — sampled at logical position ``len(ids_eff)``, exactly
+        where the single-chip program would draw it."""
+        try:
+            eng = self.engine
+            ids_eff = req["ids"] + req["tokens"]
+            budget = req["max_new"] - len(req["tokens"])
+            if budget <= 0:
+                self._finish(req)
+                return
+            n, C, slot = len(ids_eff), eng.chunk_len, req["slot"]
+            tok0 = None
+            for start in range(0, n, C):
+                da = req["deadline_at"]
+                if da is not None and time.perf_counter() > da:
+                    raise DeadlineExceededError(
+                        f"rid {req['rid']} deadline passed during "
+                        "prefill", rid=req["rid"],
+                    )
+                nreal = min(C, n - start)
+                ids_chunk = np.zeros((1, C), np.int32)
+                ids_chunk[0, :nreal] = ids_eff[start:start + nreal]
+                out = await asyncio.to_thread(
+                    eng.prefill_chunk, slot, ids_chunk, start, nreal,
+                    req["seed"], n, budget,
+                )
+                if self.n_stages == 1:
+                    tok0 = int(out)
+                    continue
+                resp = await self._chain(out, {
+                    "kind": "prefill", "slot": slot, "start": start,
+                    "nreal": nreal, "seed": req["seed"], "n_ctx": n,
+                    "budget": budget,
+                    "deadline_s": self._leg_deadline([req]),
+                })
+                tok0 = int(resp["tok0"])
+            req["n_valid"] = n
+            req["tokens"].append(tok0)
+            req["last_tok"] = tok0
+            req["n_valid"] += 1
+            eos = self.gen.eos_token_id
+            if budget <= 1 or (eos is not None and tok0 == eos):
+                self._finish(req)
+            else:
+                req["state"] = "decoding"
+        except (ServingError, TimeoutError) as e:
+            if getattr(e, "dead_stage", None) is not None:
+                raise  # chain death: let the pump run failover
+            self._fail(req, e)
+
+    async def _tick(self, decoding: list[dict]) -> None:
+        """One pipeline decode tick for every decoding slot at once —
+        the in-flight microbatch."""
+        from tensorlink_tpu.runtime import chaos
+
+        if chaos.ACTIVE is not None and chaos.ACTIVE.apply_sync(
+            "pipeserve.tick", tick=self._ticks, sid=self.sid
+        ):
+            return  # chaos drop: skip this tick, state untouched
+        eng = self.engine
+        S = self.slots
+        toks = np.zeros((S,), np.int32)
+        n_valid = np.zeros((S,), np.int32)
+        live = np.zeros((S,), bool)
+        seeds = np.zeros((S,), np.uint32)
+        for req in decoding:
+            s = req["slot"]
+            toks[s] = req["last_tok"]
+            # the fed token occupies position n_valid - 1; the decode
+            # program is fed the SEQUENCE length before this tick's
+            # token, i.e. the single-chip state's n_valid
+            n_valid[s] = req["n_valid"] - 1
+            live[s] = True
+            seeds[s] = req["seed"]
+        out = await asyncio.to_thread(
+            eng.decode_step, toks, n_valid, live, seeds
+        )
+        if self.n_stages > 1:
+            resp = await self._chain(out, {
+                "kind": "decode", "tick": self._ticks,
+                "n_valid": n_valid.tolist(),
+                "live": live.tolist(),
+                "seeds": seeds.tolist(),
+                "deadline_s": self._leg_deadline(decoding),
+            })
+            tokens = np.asarray(resp["tokens"], np.int64)
+            if tokens.shape != (S,):
+                raise ServingError(
+                    f"pipeline tick returned {tokens.shape} tokens, "
+                    f"wanted ({S},)"
+                )
+        else:
+            tokens = np.asarray(out, np.int64)
+        self._ticks += 1
+        eos = self.gen.eos_token_id
+        for req in decoding:
+            tok = int(tokens[req["slot"]])
+            req["tokens"].append(tok)
+            req["last_tok"] = tok
+            req["n_valid"] += 1
+            remaining = req["max_new"] - len(req["tokens"])
+            if remaining <= 0 or (eos is not None and tok == eos):
+                self._finish(req)
+
+    # ---------------------------------------------------------- failover
+    async def _handle_chain_error(self, err: Exception) -> None:
+        dead = getattr(err, "dead_stage", None)
+        if dead is None:
+            # a typed per-request error already handled in _prefill, or
+            # a local fault: fail everything in flight loudly
+            self._fail_all(err)
+            return
+        ok = await self._failover(int(dead), getattr(err, "dead_node", None))
+        if not ok:
+            self._fail_all(ServingError(
+                f"pipeline stage {dead} died and no replacement is "
+                f"available ({err})"
+            ))
+
+    async def _failover(self, dead_stage: int, dead_node) -> bool:
+        """Survive a dead stage: validator re-recruits a replica worker
+        already holding the same stage slice, every downstream stage
+        resets, and the head re-prefills prompt + accepted tokens for
+        each in-flight request through the repaired chain. Accepted
+        tokens are never re-sampled — position-keyed sampling continues
+        the stream exactly."""
+        self._failovers += 1
+        node = self.node
+        if getattr(node, "flight", None) is not None:
+            node.flight.record(
+                "serving.pipeline_failover", "warn", sid=self.sid,
+                stage=dead_stage, dead=str(dead_node)[:64],
+            )
+        if self.validator is None:
+            return False
+        # re-resolve the validator handle: the stored Peer may be stale
+        # (a later inbound dial from the validator displaces the
+        # outbound stream in _register_peer) — the registry holds the
+        # LIVE connection under the same node_id
+        validator = node.peers.get(
+            getattr(self.validator, "node_id", None)
+        ) or self.validator
+        try:
+            resp = await node.request(validator, {
+                "type": "SERVE_PIPELINE_PLAN", "sid": self.sid,
+                "stage": int(dead_stage),
+                "exclude": [dead_node] if dead_node else [],
+            })
+        except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+            if getattr(node, "flight", None) is not None:
+                node.flight.record(
+                    "serving.pipeline_failover_failed", "error",
+                    sid=self.sid, stage=dead_stage, error=str(e)[:120],
+                )
+            return False
+        if not isinstance(resp, dict) or resp.get("error") or \
+                not resp.get("node"):
+            return False
+        winfo = resp["node"]
+        old = self.route[dead_stage - 1]
+        self.route[dead_stage - 1] = dict(winfo)
+        # a stale peer handle to the dead node must not be reused
+        self.node.peers.pop(old.get("node_id"), None)
+        self._opened = False
+        try:
+            # re-handshake (PIPE_LOAD) then hard-reset every stage's
+            # slots — re-prefill rebuilds all KV from scratch
+            await self.open()
+            for i in range(1, self.n_stages):
+                peer = await self._stage_peer(i)
+                resp = await self.node.request(peer, {
+                    "type": "PIPE_LOAD", "sid": self.sid, "stage": i,
+                    "n_stages": self.n_stages, "slots": self.slots,
+                    "max_len": self.L, "reset": True,
+                })
+                self._check_act(resp, "PIPE_LOAD")
+            await asyncio.to_thread(self.engine.reset_all)
+            for req in self._active():
+                if req["done"].is_set():
+                    continue
+                self._refills += 1
+                req["state"] = "prefill"
+                await self._prefill(req)
+        except (ServingError, TimeoutError, ConnectionError, OSError,
+                asyncio.TimeoutError) as e:
+            if getattr(node, "flight", None) is not None:
+                node.flight.record(
+                    "serving.pipeline_failover_failed", "error",
+                    sid=self.sid, stage=dead_stage, error=str(e)[:120],
+                )
+            return False
+        if getattr(node, "flight", None) is not None:
+            node.flight.record(
+                "serving.pipeline_failover_done", "info", sid=self.sid,
+                stage=dead_stage, replacement=str(
+                    winfo.get("node_id"))[:16],
+            )
+        return True
+
+    # ------------------------------------------------------- bookkeeping
+    def _finish(self, req: dict) -> None:
+        self._release(req)
+        req["state"] = "done"
+        req["done"].set()
+
+    def _fail(self, req: dict, err: Exception) -> None:
+        self._release(req)
+        req["state"] = "failed"
+        req["error"] = err
+        req["done"].set()
+
+    def _release(self, req: dict) -> None:
+        slot = req.get("slot")
+        if slot is not None and self._slot_rid[slot] == req["rid"]:
+            self._slot_rid[slot] = None
+            # stage-0 blocks free now; downstream stages recycle a
+            # slot's blocks at its next admission (their pools are
+            # sized for all slots fully resident, so lazy reclamation
+            # cannot strand capacity)
+            try:
+                self.engine.release_slot(slot)
+            except Exception:  # noqa: BLE001 — teardown must not mask
+                pass
+        req["slot"] = None
+        self._wake.set()
+
+    def _fail_all(self, err: Exception) -> None:
+        for rid in list(self._queue):
+            req = self._requests.get(rid)
+            if req is not None and not req["done"].is_set():
+                self._fail(req, err)
+        self._queue.clear()
+        for req in self._active():
+            if not req["done"].is_set():
+                self._fail(req, err)
+
+    # ------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        return {
+            "pipeline": {
+                "sid": self.sid,
+                "stage": 0,
+                "n_stages": self.n_stages,
+                "ticks": self._ticks,
+                "act_wire_bytes": self._act_bytes,
+                "failovers": self._failovers,
+                "reprefills": self._refills,
+                "queued": len(self._queue),
+                "active": len(self._active()),
+            },
+            "stage0": self.engine.stats(),
+            "pool": self.engine.pool.stats(),
+        }

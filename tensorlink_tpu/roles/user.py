@@ -1080,14 +1080,25 @@ class UserNode(Node):
         the distributed pipelined path stays ``DistributedJob.forward``."""
         return self._build_serving(engine, paged=paged, **kw)
 
-    def remote_serving(self, validator: Peer | None = None) -> "RemoteServingClient":
+    def remote_serving(
+        self, validator: Peer | None = None, *,
+        pipeline: bool = False, sid: str | None = None,
+    ) -> "RemoteServingClient":
         """The DISTRIBUTED serving front end (ROADMAP item 1): the same
         submit()/result() surface as a local engine, but each request's
         prefill and decode legs are placed across the mesh by a
         validator's fleet-roofline table and the KV blocks cross the
         wire between them. Falls back to colocated serving when the
         fleet cannot split (or a leg dies mid-request). ``validator``
-        defaults to the first connected validator peer."""
+        defaults to the first connected validator peer.
+
+        With ``pipeline=True`` the client targets a PIPELINE-sharded
+        deployment instead: the validator is asked (``SERVE_PIPELINE_PLAN``
+        with ``stage=0``) which worker runs the head stage of the
+        pipeline ``sid`` (or any pipeline when ``sid`` is None), and
+        requests are submitted there — the head's coordinator streams
+        activations across the stages and owns mid-stream failover, so
+        from here the surface is exactly the colocated one."""
         if validator is None:
             validator = next(
                 (p for p in self.peers.values() if p.role == "validator"),
@@ -1097,7 +1108,9 @@ class UserNode(Node):
                 raise ValueError(
                     "remote_serving needs a connected validator peer"
                 )
-        return RemoteServingClient(self, validator)
+        return RemoteServingClient(
+            self, validator, pipeline=pipeline, pipeline_sid=sid
+        )
 
     def on_peer_lost(self, peer: Peer) -> None:
         for dj in list(self._jobs.values()):
@@ -1685,11 +1698,39 @@ class RemoteServingClient:
 
     RESULT_TIMEOUT_S = 120.0
 
-    def __init__(self, user: "UserNode", validator: Peer):
+    def __init__(
+        self, user: "UserNode", validator: Peer, *,
+        pipeline: bool = False, pipeline_sid: str | None = None,
+    ):
         self.user = user
         self.validator = validator
+        self.pipeline = bool(pipeline)
+        self.pipeline_sid = pipeline_sid
         self._handles: dict[int, dict] = {}
         self._next_rid = 0
+
+    async def _pipeline_head(self) -> Peer:
+        """Locate the stage-0 (head) worker of the target pipeline via
+        the validator's placement table. The head fronts the whole
+        pipeline — submit/result against it is the colocated surface."""
+        from tensorlink_tpu.parallel.serving import OverloadedError
+
+        node = self.user
+        msg: dict = {"type": "SERVE_PIPELINE_PLAN", "stage": 0}
+        if self.pipeline_sid:
+            msg["sid"] = self.pipeline_sid
+        plan = self._check(
+            await node.request(self.validator, msg), "SERVE_PIPELINE_PLAN"
+        )
+        if plan.get("error") or not plan.get("node"):
+            raise OverloadedError(
+                "validator knows no live pipeline head"
+                + (f" for sid {self.pipeline_sid!r}" if self.pipeline_sid
+                   else "")
+                + (f": {plan['error']}" if plan.get("error") else ""),
+                reason="unplaceable",
+            )
+        return await self._peer(plan["node"])
 
     def _wire_request(
         self, ids, max_new, seed, priority, deadline_s
@@ -1755,6 +1796,36 @@ class RemoteServingClient:
             "serving.disagg_request", {"prompt_len": len(req["ids"])}
         )
         ctx = root.context()
+        if self.pipeline:
+            # pipeline mode: one plan hop to find the head stage, then
+            # the head's coordinator owns placement/streaming/failover —
+            # the handle is colocated-shaped (no client-side fallback
+            # leg; failover happens inside the pipeline)
+            try:
+                with node.tracer.span("serving.leg.plan", remote=ctx):
+                    hpeer = await self._pipeline_head()
+                with node.tracer.span(
+                    "serving.leg.pipeline_submit", remote=ctx,
+                    attrs={"head": hpeer.node_id[:8]},
+                ):
+                    resp = self._check(
+                        await node.request(
+                            hpeer, {"type": "SERVE_SUBMIT", **req}
+                        ),
+                        "SERVE_ACCEPTED",
+                    )
+            except BaseException:
+                node.tracer.finish_span(root, status="error")
+                raise
+            rid = self._next_rid
+            self._next_rid += 1
+            self._handles[rid] = {
+                "root": root, "req": req, "plan": {"pipeline": True},
+                "t0": time.perf_counter(), "result_peer": hpeer,
+                "remote_rid": int(resp["rid"]),
+                "fallback_info": None, "colocated": True,
+            }
+            return rid
         with node.tracer.span("serving.leg.plan", remote=ctx):
             plan = self._check(
                 await node.request(

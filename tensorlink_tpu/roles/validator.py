@@ -36,6 +36,7 @@ def roofline_score(cap: dict, leg: str) -> tuple[float, float]:
 
 def plan_serving(
     fleet: dict[str, dict], *, need_blocks: int = 0, need_tokens: int = 0,
+    pipeline: int | None = None, need_bytes: int = 0,
 ) -> dict | None:
     """Place one request's prefill and decode legs from a fleet
     capability table (``{node_id: capability record}`` — the live view
@@ -58,7 +59,25 @@ def plan_serving(
     keep serving).
 
     Returns ``{"colocated": True, "node": id}`` or ``{"colocated":
-    False, "prefill": id, "decode": id}``; None when nothing fits."""
+    False, "prefill": id, "decode": id}``; None when nothing fits.
+
+    PIPELINE MODE (ROADMAP item 2): with ``pipeline`` (a stage count)
+    or ``need_bytes`` (model weight bytes no single worker can hold),
+    placement delegates to :func:`pipeserve.plan_pipeline` — stage
+    workers picked by published ``hbm_bytes``, fewest stages that cover
+    the model — and returns ``{"pipeline": True, "stages": [ids],
+    "capacities": [bytes]}`` instead (None when the fleet's summed HBM
+    cannot hold the model)."""
+    if pipeline is not None or need_bytes:
+        from tensorlink_tpu.parallel.pipeserve import plan_pipeline
+
+        plan = plan_pipeline(
+            fleet, n_stages=pipeline, need_bytes=need_bytes
+        )
+        if plan is None:
+            return None
+        return {"pipeline": True, **plan}
+
     def headroom_ok(c: dict) -> bool:
         free = c.get("kv_blocks_free")
         if free is None:
@@ -184,6 +203,7 @@ class ValidatorNode(Node):
         self.on("REPLACE_WORKER", self._h_replace_worker)
         self.on("JOB_REPLICATE", self._h_job_replicate)
         self.on("SERVE_PLAN", self._h_serve_plan)
+        self.on("SERVE_PIPELINE_PLAN", self._h_serve_pipeline_plan)
 
     def authorize_peer(self, node_id: str, role: str) -> bool:
         """Reputation gate (reference: smart_node.py:329-337)."""
@@ -579,6 +599,105 @@ class ValidatorNode(Node):
             decode=str(plan.get("decode", plan.get("node", "")))[:16],
         )
         return out
+
+    MAX_PLAN_EXCLUDE = 64
+
+    @wire_guard
+    async def _h_serve_pipeline_plan(self, node, peer, msg) -> dict:
+        """Pipeline-serving placement (ROADMAP item 2), two modes:
+
+        - FRESH (``n_stages`` and/or ``need_bytes``): partition a model
+          across the fewest workers whose published ``hbm_bytes`` cover
+          its weights; reply carries per-stage dial info + capacities
+          (the head slices layers proportional to capacity).
+        - REPLACEMENT (``stage`` + ``sid``): a stage died mid-stream —
+          recruit a live worker already advertising the SAME
+          ``pipe_sid``/``pipe_stage`` (a pre-loaded spare replica, so no
+          param shipping on the failover path), best decode roofline
+          first, the dead node excluded."""
+        fleet = {
+            nid: cap
+            for nid, cap in self.peer_capabilities.items()
+            if nid in self.peers and cap.get("role") == "worker"
+        }
+
+        def winfo(nid: str) -> dict:
+            info = self.peers[nid].info.to_wire()
+            info["pipe_stage"] = fleet[nid].get("pipe_stage")
+            return info
+
+        exclude = {
+            str(x)[:64]
+            for x in list(msg.get("exclude") or [])[:self.MAX_PLAN_EXCLUDE]
+        }
+        if msg.get("stage") is not None:
+            stage = int(msg["stage"])
+            sid = str(msg.get("sid", ""))[:64]
+            spares = [
+                nid for nid, cap in fleet.items()
+                if nid not in exclude
+                and cap.get("pipe_stage") == stage
+                and (not sid or cap.get("pipe_sid") == sid)
+            ]
+            best = max(
+                spares,
+                key=lambda n: (*roofline_score(fleet[n], "decode"), n),
+                default=None,
+            )
+            if best is None:
+                self.flight.record(
+                    "serving.pipeline_unplaceable", "warn", sid=sid[:16],
+                    stage=stage, fleet=len(fleet),
+                )
+                return {
+                    "type": "SERVE_PIPELINE_PLAN",
+                    "error": f"no spare worker advertises pipeline "
+                             f"{sid!r} stage {stage}",
+                }
+            self.flight.record(
+                "serving.pipeline_placement", sid=sid[:16], stage=stage,
+                node=best[:16], replacement=True,
+            )
+            return {
+                "type": "SERVE_PIPELINE_PLAN", "stage": stage,
+                "node": winfo(best),
+            }
+        n_stages = (
+            int(msg["n_stages"]) if msg.get("n_stages") is not None
+            else None
+        )
+        need_bytes = int(msg.get("need_bytes", 0) or 0)
+        from tensorlink_tpu.parallel.pipeserve import plan_pipeline
+
+        try:
+            plan = plan_pipeline(
+                {n: c for n, c in fleet.items() if n not in exclude},
+                n_stages=n_stages, need_bytes=need_bytes,
+            )
+        except ValueError as e:
+            return {"type": "SERVE_PIPELINE_PLAN", "error": str(e)[:200]}
+        if plan is None:
+            self.flight.record(
+                "serving.pipeline_unplaceable", "warn",
+                n_stages=n_stages, need_bytes=need_bytes,
+                fleet=len(fleet),
+            )
+            return {
+                "type": "SERVE_PIPELINE_PLAN",
+                "error": f"fleet of {len(fleet)} cannot hold "
+                         f"{need_bytes} bytes across "
+                         f"{n_stages or 'any'} stages",
+            }
+        self.flight.record(
+            "serving.pipeline_placement",
+            stages=[s[:16] for s in plan["stages"]],
+            need_bytes=need_bytes,
+        )
+        return {
+            "type": "SERVE_PIPELINE_PLAN",
+            "stages": [winfo(nid) for nid in plan["stages"]],
+            "capacities": plan["capacities"],
+        }
 
     @wire_guard
     async def _h_replace_worker(self, node, peer, msg) -> dict:

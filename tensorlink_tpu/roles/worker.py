@@ -567,6 +567,14 @@ class WorkerNode(Node):
         # the resulting fleet roofline table.
         self.serving = None
         self.serving_mode: str | None = None
+        # pipeline-sharded serving (ROADMAP item 2): this worker may host
+        # ONE stage of a layer-partitioned pipeline. Stage 0 additionally
+        # runs the PipelineCoordinator (attached as self.serving, so the
+        # SERVE_SUBMIT/SERVE_RESULT surface is unchanged); stages >= 1
+        # compute ACT_FWD hops only. Advertised via pipe_* fields in the
+        # heartbeat capability record.
+        self._pipe_stage = None
+        self._pipe_coord = None
 
     # ------------------------------------------------------------ autotune
     def _autotune_key(self):
@@ -662,6 +670,25 @@ class WorkerNode(Node):
         XLA's compile-time flops over the measured ``stage{i}_fwd_s``
         mean — the roofline entry per loaded pipeline stage."""
         rec = super().capability_record()
+        if self._pipe_stage is not None:
+            # a pipeline stage advertises itself even when the node has
+            # no measured roofline and no SERVE_SUBMIT surface (stages
+            # >= 1 serve only ACT_FWD hops): the validator's replacement
+            # planner and tldiag's ROLE column both read these fields
+            if rec is None:
+                rec = dict(self.capability or {})
+            st = self._pipe_stage.stats()
+            rec["pipe_sid"] = self._pipe_stage.sid
+            rec["pipe_stage"] = self._pipe_stage.stage
+            rec["pipe_n_stages"] = self._pipe_stage.n_stages
+            rec["pipe_lo"], rec["pipe_hi"] = st["layers"]
+            rec["pipe_bubble_frac"] = st["bubble_frac"]
+            if st.get("mfu") is not None:
+                rec["pipe_mfu"] = st["mfu"]
+            pool = self._pipe_stage.pool
+            rec.setdefault("kv_blocks_free", pool.available)
+            rec.setdefault("kv_blocks_total", pool.num_blocks)
+            rec.setdefault("kv_block_size", pool.block_size)
         if rec is None:
             return None
         progs = dict(rec.get("programs") or {})
@@ -740,6 +767,7 @@ class WorkerNode(Node):
         self.on("SERVE_SUBMIT", self._h_serve_submit)
         self.on("SERVE_RESULT", self._h_serve_result)
         self.on("SERVE_PREFILL", self._h_serve_prefill)
+        self.on("PIPE_LOAD", self._h_pipe_load)
         self.register_stream_kind("module_spec", self._stream_module_spec)
 
     # ------------------------------------------------ serving (disagg)
@@ -1049,6 +1077,317 @@ class WorkerNode(Node):
         except Exception as e:  # noqa: BLE001 — typed across the wire
             return serve_error_to_wire(e)
         return {"type": "KV_IMPORTED", "rid": rid}
+
+    # ---------------------------------------------- serving (pipeline)
+    # hostile-ingest clamps for peer-fed activation metadata (tlproto
+    # TLP201): slot counts, chunk bounds, and relay route length are
+    # bounded before any of them select compute or a dial target
+    MAX_ACT_SLOTS = 4096
+    MAX_ACT_ROUTE = 16
+
+    def pipeline_stage(
+        self, engine, *, sid: str, stage: int, n_stages: int,
+        lo: int, hi: int, route: list[dict] | None = None,
+        validator=None, **kw,
+    ):
+        """Attach ONE stage of a pipeline-sharded serving deployment
+        (parallel/pipeserve.py) to this worker.
+
+        ``engine`` is a full :class:`InferenceEngine`; the stage keeps
+        only the ``[lo, hi)`` layer slice of its params (plus embedding
+        on stage 0 / head on the last) — the whole point is that the
+        full model never has to fit this worker. Stage 0 additionally
+        hosts the :class:`PipelineCoordinator` (attached as
+        ``self.serving``, so SERVE_SUBMIT/SERVE_RESULT and the PR 15
+        client surface work unchanged) and needs the downstream
+        ``route`` (winfo dicts for stages 1..N-1) plus optionally the
+        ``validator`` peer for dead-stage re-recruitment. Stages >= 1
+        serve ACT_FWD hops only. A worker may also pre-load a stage as
+        a SPARE replica (same sid/stage, not in any route): its
+        capability record advertises ``pipe_sid``/``pipe_stage`` and the
+        validator's replacement planner recruits it on stage death."""
+        from tensorlink_tpu.parallel.pipeserve import (
+            PipelineCoordinator,
+            PipelineStageEngine,
+        )
+
+        kw.setdefault("metrics", self.metrics)
+        kw.setdefault("recorder", self.flight)
+        kw.setdefault("capability", self.capability)
+        stage_eng = PipelineStageEngine(
+            engine, lo=lo, hi=hi, sid=sid, stage=stage,
+            n_stages=n_stages, **kw,
+        )
+        self._pipe_stage = stage_eng
+        if int(stage) == 0:
+            if int(n_stages) > 1 and not route:
+                raise ValueError(
+                    "stage 0 needs the downstream route (winfo dicts "
+                    "for stages 1..N-1)"
+                )
+            coord = PipelineCoordinator(
+                self, stage_eng, route=route or [], sid=sid,
+                validator=validator, gen=stage_eng.gen,
+            )
+            self._pipe_coord = coord
+            self.serving = coord
+            self.serving_mode = "pipeline"
+            self.flight.record(
+                "serving.attached", mode=f"pipeline/stage0/{n_stages}",
+                paged=True,
+            )
+            return coord
+        self.flight.record(
+            "serving.attached", mode=f"pipeline/stage{stage}/{n_stages}",
+            paged=True,
+        )
+        return stage_eng
+
+    def _act_meta(self, msg: dict) -> dict:
+        """Validate peer-fed activation metadata (tlproto registered
+        sanitizer). Raises TypeError/ValueError on malformed input;
+        every field that selects compute (slot, chunk bounds, row-state
+        vectors) or a dial target (relay route) is type- and
+        range-clamped before use."""
+        raw = msg.get("meta")
+        if not isinstance(raw, dict):
+            raise TypeError("ACT_FWD carries no meta dict")
+        kind = str(raw.get("kind", ""))[:16]
+        if kind not in ("prefill", "decode"):
+            raise ValueError(f"unknown activation kind {kind!r}")
+        out: dict = {"sid": str(raw.get("sid", ""))[:64], "kind": kind}
+        route = raw.get("route")
+        if route is None:
+            route = []
+        if not isinstance(route, (list, tuple)) or \
+                len(route) > self.MAX_ACT_ROUTE:
+            raise ValueError("activation route malformed or too long")
+        out["route"] = [
+            {
+                "node_id": str(w["node_id"])[:64],
+                "host": str(w["host"])[:255],
+                "port": int(w["port"]),
+                "alt_hosts": [
+                    str(h)[:255] for h in (w.get("alt_hosts") or [])
+                ][:8],
+            }
+            for w in route
+        ]
+        out["deadline_s"] = (
+            float(raw["deadline_s"])
+            if raw.get("deadline_s") is not None else None
+        )
+        if kind == "prefill":
+            out["slot"] = int(raw["slot"])
+            out["start"] = int(raw["start"])
+            out["nreal"] = int(raw["nreal"])
+            out["seed"] = int(raw["seed"]) & 0xFFFFFFFF
+            out["n_ctx"] = int(raw["n_ctx"])
+            out["budget"] = int(raw["budget"])
+            if not (0 <= out["slot"] <= self.MAX_ACT_SLOTS
+                    and 0 <= out["start"] <= self.MAX_SERVE_IDS
+                    and 1 <= out["nreal"] <= self.MAX_SERVE_IDS
+                    and 1 <= out["n_ctx"] <= self.MAX_SERVE_IDS
+                    and 0 <= out["budget"] <= self.MAX_SERVE_IDS):
+                raise ValueError("prefill chunk bounds out of range")
+        else:
+            for name in ("n_valid", "live", "seeds"):
+                v = raw[name]
+                if not isinstance(v, (list, tuple)) or \
+                        len(v) > self.MAX_ACT_SLOTS:
+                    raise ValueError(
+                        f"decode {name} malformed or too long"
+                    )
+            out["n_valid"] = [int(x) for x in raw["n_valid"]]
+            out["live"] = [bool(x) for x in raw["live"]]
+            out["seeds"] = [int(x) & 0xFFFFFFFF for x in raw["seeds"]]
+            out["tick"] = int(raw.get("tick", 0))
+        return out
+
+    async def handle_act_fwd(self, peer: Peer, msg: dict) -> dict:
+        """One pipeline hop: run this worker's stage over the received
+        activation chunk, then either reply with the stage output
+        relayed down the remaining route (the last stage's ACT_RESULT
+        — sampled tokens / first token — travels back up as each hop's
+        reply) or, on the last stage, answer directly. Typed serving
+        errors cross every hop; a dead downstream peer is reported with
+        ``dead_stage`` so the head can re-recruit exactly the stage
+        that died. The end-to-end deadline is decremented by this
+        stage's compute + packing before the next leg sees it."""
+        from tensorlink_tpu.parallel.pipeserve import (
+            pack_act_payload,
+            unpack_act_payload,
+        )
+        from tensorlink_tpu.parallel.serving import (
+            DeadlineExceededError,
+            ServingError,
+            serve_error_to_wire,
+        )
+
+        eng = self._pipe_stage
+        if eng is None or eng.stage == 0:
+            # the head ORIGINATES activation traffic; an ACT_FWD aimed
+            # at it (or at a stage-less worker) is a routing error
+            return serve_error_to_wire(ServingError(
+                "no relay pipeline stage attached to this worker"
+            ))
+        try:
+            meta = self._act_meta(msg)
+        except (KeyError, TypeError, ValueError) as e:
+            self.metrics.incr("act_wire_rejected_total")
+            self.flight.record(
+                "act_wire_rejected", "warn",
+                peer=peer.node_id[:16], error=str(e)[:200],
+            )
+            return serve_error_to_wire(ServingError(
+                f"malformed activation frame: {e}"
+            ))
+        if meta["sid"] != eng.sid:
+            return serve_error_to_wire(ServingError(
+                f"activation for pipeline {meta['sid']!r}; this stage "
+                f"serves {eng.sid!r}"
+            ))
+        t0 = time.perf_counter()
+        dl = meta["deadline_s"]
+        if dl is not None and dl <= 0:
+            return serve_error_to_wire(DeadlineExceededError(
+                f"deadline exhausted before stage {eng.stage} compute"
+            ))
+        try:
+            x = await asyncio.to_thread(
+                unpack_act_payload, bytes(msg["blob"])
+            )
+        except ValueError as e:
+            # CRC mismatch, schema skew, or a hostile oversized tensor
+            self.metrics.incr("act_wire_rejected_total")
+            self.flight.record(
+                "act_wire_rejected", "warn",
+                peer=peer.node_id[:16], error=str(e)[:200],
+            )
+            return serve_error_to_wire(e)
+        try:
+            with self.tracer.span(
+                "serving.pipeline_stage",
+                {"stage": eng.stage, "kind": meta["kind"]},
+            ):
+                if meta["kind"] == "prefill":
+                    out = await asyncio.to_thread(
+                        eng.prefill_chunk, meta["slot"], x,
+                        meta["start"], meta["nreal"], meta["seed"],
+                        meta["n_ctx"], meta["budget"],
+                    )
+                else:
+                    out = await asyncio.to_thread(
+                        eng.decode_step, x, meta["n_valid"],
+                        meta["live"], meta["seeds"],
+                    )
+        except Exception as e:  # noqa: BLE001 — typed across the wire
+            return serve_error_to_wire(e)
+        if eng.slice.last:
+            if meta["kind"] == "decode":
+                return {
+                    "type": "ACT_RESULT", "sid": eng.sid,
+                    "tick": meta.get("tick", 0),
+                    "tokens": [
+                        int(t) for t in np.asarray(out).reshape(-1)
+                    ],
+                }
+            return {
+                "type": "ACT_RESULT", "sid": eng.sid,
+                "tok0": int(np.asarray(out).reshape(())),
+            }
+        route = meta["route"]
+        if not route:
+            return serve_error_to_wire(ServingError(
+                f"stage {eng.stage} is not last but the relay route is "
+                "empty"
+            ))
+        nxt = route[0]
+        blob2 = await asyncio.to_thread(pack_act_payload, out)
+        fwd = {k: v for k, v in meta.items() if k != "route"}
+        fwd["route"] = route[1:]
+        fwd["stage"] = eng.stage + 1
+        if dl is not None:
+            rem = dl - (time.perf_counter() - t0)
+            if rem <= 0:
+                return serve_error_to_wire(DeadlineExceededError(
+                    f"deadline consumed by stage {eng.stage} compute"
+                ))
+            fwd["deadline_s"] = rem
+        try:
+            npeer = self.peers.get(nxt["node_id"])
+            if npeer is None:
+                npeer = await self.connect_candidates(
+                    nxt["host"], int(nxt["port"]),
+                    tuple(nxt.get("alt_hosts", ()) or ()),
+                    expect_id=nxt["node_id"],
+                )
+            # coerce the relayed verdict: a hostile downstream stage
+            # must not be able to push an untyped frame back up the
+            # chain through this hop's reply
+            return self._typed_reply(
+                await self.send_activations(npeer, blob2, fwd),
+                fallback="SERVE_FAILED",
+            )
+        except (ConnectionError, OSError, KeyError,
+                asyncio.TimeoutError) as e:
+            self.flight.record(
+                "serving.pipeline_hop_dead", "warn",
+                stage=eng.stage + 1, node=str(nxt.get("node_id"))[:16],
+                error=str(e)[:120],
+            )
+            err = serve_error_to_wire(ServingError(
+                f"pipeline stage {eng.stage + 1} unreachable from "
+                f"stage {eng.stage}: {e}"
+            ))
+            # exact attribution rides the relayed error so the head
+            # re-recruits the stage that died, not the one that told it
+            err["dead_stage"] = eng.stage + 1
+            err["dead_node"] = nxt.get("node_id")
+            return err
+
+    @wire_guard
+    async def _h_pipe_load(self, node, peer, msg) -> dict:
+        """Geometry handshake / reset for a pipeline stage: the head
+        verifies sid + slot count + cache width + layer continuity
+        before any activation crosses, and hard-resets the stage's
+        slots during dead-stage failover (re-prefill rebuilds all KV
+        from scratch on the repaired chain)."""
+        from tensorlink_tpu.parallel.serving import (
+            ServingError,
+            serve_error_to_wire,
+        )
+
+        eng = self._pipe_stage
+        if eng is None:
+            return serve_error_to_wire(ServingError(
+                "no pipeline stage attached to this worker"
+            ))
+        sid = str(msg.get("sid", ""))[:64]
+        if sid != eng.sid:
+            return serve_error_to_wire(ServingError(
+                f"this worker serves pipeline {eng.sid!r}, not {sid!r}"
+            ))
+        for field, want in (
+            ("stage", eng.stage), ("slots", eng.slots),
+            ("max_len", eng.L), ("n_stages", eng.n_stages),
+        ):
+            if msg.get(field) is not None and int(msg[field]) != want:
+                return serve_error_to_wire(ServingError(
+                    f"pipeline geometry mismatch: {field} "
+                    f"{msg[field]} != {want}"
+                ))
+        if bool(msg.get("reset")):
+            await asyncio.to_thread(eng.reset_all)
+            self.flight.record(
+                "serving.pipeline_reset", sid=sid, stage=eng.stage
+            )
+        return {
+            "type": "PIPE_LOAD", "ok": True, "sid": eng.sid,
+            "stage": eng.stage, "lo": eng.slice.lo, "hi": eng.slice.hi,
+            "slots": eng.slots, "max_len": eng.L,
+            "block_size": eng.block_size,
+        }
 
     def _observe_stage(self, stage: int, kind: str, dt: float) -> None:
         """Per-stage local compute time: the stage{i}_fwd_s/_bwd_s series
