@@ -1116,6 +1116,103 @@ def observability_round() -> dict:
     return o
 
 
+def metering_round() -> dict:
+    """Work-receipt metering cost round (ISSUE 19): the same loaded
+    continuous-batching traffic with per-request metering ON (engine
+    accumulators + canonical-bytes receipt signing for every finished
+    request, exactly what a worker does on the serve path) vs metering
+    compiled out. Also reports the wall cost of signing one receipt
+    and of one auditor verify+ingest. ``metering_overhead_frac`` is
+    the acceptance number (< 0.01); lower-better via the
+    ``overhead_frac`` / ``_s`` suffixes ``tldiag bench-diff`` keys on."""
+    from tensorlink_tpu.config import MeshConfig
+    from tensorlink_tpu.models.gpt2 import GPT2, GPT2Config
+    from tensorlink_tpu.parallel.inference import (
+        GenerationConfig,
+        InferenceEngine,
+    )
+    from tensorlink_tpu.parallel.serving import (
+        PagedContinuousBatchingEngine,
+    )
+    from tensorlink_tpu.p2p.crypto import Identity
+    from tensorlink_tpu.runtime.ledger import (
+        ReceiptAuditor,
+        build_receipt,
+    )
+    from tensorlink_tpu.runtime.mesh import make_mesh
+
+    P_, N_, SLOTS, NREQ, REPS = 32, 32, 8, 24, 3
+    mcfg = GPT2Config(qkv_fused=True)
+    mmodel = GPT2(mcfg)
+    meng = InferenceEngine(
+        make_mesh(MeshConfig()), mmodel, mmodel.init(jax.random.key(0)),
+        max_len=256,
+    )
+    gen = GenerationConfig(max_new_tokens=N_)
+    prompts = np.random.default_rng(13).integers(
+        0, mcfg.vocab_size, (NREQ, P_)
+    )
+    ident = Identity.generate()
+
+    def run_once(metered: bool) -> tuple[float, int]:
+        sch = PagedContinuousBatchingEngine(
+            meng, slots=SLOTS, gen=gen, decode_chunk=8, block_size=16,
+            prefill_chunk=32, max_queue=NREQ, prefix_cache=True,
+            warm_buckets=True, metering=metered,
+        )
+        nrec = 0
+        t0 = time.perf_counter()
+        rids = [sch.submit(p_) for p_ in prompts]
+        sch.run_until_idle()
+        ntok = sum(len(sch.result(r_)) for r_ in rids)
+        if metered:  # sign inside the timed region — it's serve-path work
+            receipts = [
+                build_receipt(m_, ident) for m_ in sch.drain_meters(NREQ)
+            ]
+            nrec = len(receipts)
+        return ntok / (time.perf_counter() - t0), nrec
+
+    run_once(False)  # warm buckets for both arms
+    # interleave the arms so drift (thermal, page cache) hits both
+    on = [run_once(True) for _ in range(REPS)]
+    tps_off = max(run_once(False)[0] for _ in range(REPS))
+    tps_on = max(t_ for t_, _ in on)
+    o: dict = {
+        "metering_overhead_frac": round(
+            max(1.0 - tps_on / tps_off, 0.0), 4
+        ),
+        "metering_receipts_per_request": round(
+            sum(n_ for _, n_ in on) / (REPS * NREQ), 3
+        ),
+    }
+
+    # microcosts: one canonical-bytes sign, one auditor verify+ingest
+    meter = {
+        "schema": 1, "rid": 1, "tenant": "bench", "kind": "serve",
+        "t_start": 100.0, "t_end": 101.0, "prompt_tokens": P_,
+        "emitted_tokens": N_, "busy_s": 0.5, "flops": 1e9,
+        "hbm_bytes": 1e8, "kv_block_s": 3.0, "wire_bytes": 128,
+    }
+    t0 = time.perf_counter()
+    K = 200
+    for i in range(K):
+        build_receipt({**meter, "rid": i}, ident)
+    o["receipt_sign_s"] = round((time.perf_counter() - t0) / K, 6)
+    aud = ReceiptAuditor()
+    batch = [build_receipt({**meter, "rid": i}, ident) for i in range(K)]
+    t0 = time.perf_counter()
+    for r_ in batch:
+        aud.ingest(r_)
+    o["receipt_audit_s"] = round((time.perf_counter() - t0) / K, 6)
+    assert aud.accepted_total == K, "bench receipts must verify"
+    o["metering_config"] = (
+        f"GPT-2 small bf16 paged, {NREQ} reqs (P{P_} N{N_}) over "
+        f"{SLOTS} slots; metering+signing vs metering=False, best of "
+        f"{REPS}; microcosts averaged over {K} receipts"
+    )
+    return o
+
+
 def main() -> None:
     devices = backend_with_retry()
     device_kind = devices[0].device_kind
@@ -1829,6 +1926,15 @@ def main() -> None:
             out.update(observability_round())
         except Exception as e:  # noqa: BLE001 — must not sink the headline
             out["observability_error"] = str(e)[:200]
+
+    # -- work-receipt metering cost (ISSUE 19): what per-request
+    # metering + canonical-bytes receipt signing charges the serve
+    # path, and the sign/audit microcosts.
+    if os.environ.get("BENCH_METER", "1") == "1" and _BERT == "base":
+        try:
+            out.update(metering_round())
+        except Exception as e:  # noqa: BLE001 — must not sink the headline
+            out["metering_error"] = str(e)[:200]
 
     # -- int8 end-to-end quality (VERDICT #8): logit KL between bf16 and
     # int8 weight-only GPT-2 small on a fixed eval batch. The number the

@@ -111,6 +111,11 @@ def main() -> None:
                          "firing alerts after every finished request — "
                          "the same rules `tldiag check`/a node's "
                          "alert engine evaluate")
+    ap.add_argument("--ledger", action="store_true",
+                    help="meter every request, sign a WorkReceipt per "
+                         "finished request with a dev identity, audit "
+                         "the receipts locally, and print the tenant/"
+                         "worker ledger (runtime/ledger.py)")
     ap.add_argument("--profile-dir", default=None,
                     help="capture the whole serving run under "
                          "jax.profiler into this directory (open with "
@@ -154,6 +159,8 @@ def main() -> None:
         args.speculate or args.ngram or args.draft or spec_auto
     ) and not (args.continuous or args.paged):
         args.continuous = True  # speculation lives in the schedulers
+    if args.ledger and not (args.continuous or args.paged):
+        args.paged = True  # metering lives in the schedulers
 
     # tiny config so the example runs on a dev box; swap for
     # LlamaConfig.llama3_8b() / .mistral_7b() + HF weights in production
@@ -367,6 +374,23 @@ def main() -> None:
         if tdec:
             print(f"ttft decomposition (EWMA): {tdec}")
 
+    def print_ledger(sch) -> None:
+        """What the worker+validator pair does over the wire, inline:
+        sign each finished request's meter, audit the receipts, print
+        the tenant/worker ledger tables."""
+        from tensorlink_tpu.diag import render_ledger
+        from tensorlink_tpu.p2p.crypto import Identity
+        from tensorlink_tpu.runtime.ledger import (
+            ReceiptAuditor,
+            build_receipt,
+        )
+
+        ident = Identity.generate()
+        aud = ReceiptAuditor()
+        for m in sch.drain_meters(1024):
+            aud.ingest(build_receipt(m, ident))
+        print(render_ledger(aud.snapshot()))
+
     prof_cm = None
     if args.profile_dir:
         from tensorlink_tpu.runtime.profiling import trace
@@ -416,6 +440,8 @@ def main() -> None:
             f"of {st['pool']['num_blocks']}"
         )
         print_spec(st)
+        if args.ledger:
+            print_ledger(sch)
     elif args.continuous:
         # staggered traffic: variable-length prompts submitted one by
         # one, interleaved prefill+decode over a fixed slot batch;
@@ -444,6 +470,8 @@ def main() -> None:
                   f"{ktraj}")
         print("scheduler:", sch.stats())
         print_spec(sch.stats())
+        if args.ledger:
+            print_ledger(sch)
     else:
         prompts = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 8)))
         tokens = eng.generate(prompts, gen, rng=jax.random.key(0))

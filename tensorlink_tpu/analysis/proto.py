@@ -262,6 +262,10 @@ _TAINT_SANITIZERS = {
     # pipeline-sharded serving: peer-fed activation metadata and
     # payload clamps (roles/worker.py _act_meta, pipeserve codec)
     "_act_meta", "unpack_act_payload",
+    # work receipts: peer-fed signed meters and client observations
+    # (runtime/ledger.py) — field-by-field type/bounds clamps; the
+    # auditor's ingest/observe run them internally as well
+    "sanitize_receipt", "sanitize_receipt_obs",
 }
 _GROWTH_METHODS = {"append", "add", "extend", "insert", "setdefault"}
 # (receiver-leaf, method) pairs whose mutation is internally bounded

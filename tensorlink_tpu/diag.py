@@ -39,7 +39,8 @@ from typing import Any
 # validators, /kv only on paged serving nodes, /history and /fleet only
 # when the time-series sampler is on — all fetched opportunistically
 ROUTES = ("/healthz", "/metrics", "/metrics?format=prom", "/spans",
-          "/events", "/node", "/jobs", "/history", "/kv", "/fleet")
+          "/events", "/node", "/jobs", "/history", "/kv", "/fleet",
+          "/ledger")
 
 
 # ------------------------------------------------------------- scraping
@@ -122,6 +123,7 @@ ANOMALY_COUNTERS = (
     "train_nonfinite_total",
     "peer_dropped_total",
     "dispatch_errors_total",
+    "receipt_anomaly_total",
 )
 
 
@@ -301,6 +303,14 @@ def node_row(
     }
     if row["anomalies"]:
         row["flags"].append("ANOMALIES")
+    # receipt auditing (validator rows): a worker billing busy seconds
+    # its own published roofline / wall window cannot explain is a
+    # metering integrity failure — name the count, `tldiag ledger`
+    # names the worker
+    ledger = _route_body(scrape, "/ledger") or {}
+    oc = (ledger.get("anomalies") or {}).get("overclaim")
+    if oc:
+        row["flags"].append(f"OVERCLAIM({oc})")
     events = (_route_body(scrape, "/events") or {}).get("events") or []
     row["error_events"] = sum(1 for e in events if e.get("severity") == "error")
     return row
@@ -403,7 +413,10 @@ _LOWER_BETTER_RE = re.compile(
     r"|shed_rate|shed_total|deadline_miss|p99_degradation"
     # device-time telemetry: host-gap (pipeline bubble) fraction and
     # the measured always-on timing overhead — both pure waste
-    r"|host_gap|overhead_frac)"
+    r"|host_gap|overhead_frac"
+    # work-receipt auditing (runtime/ledger.py): flagged/rejected
+    # receipts at fixed traffic are integrity failures, not volume
+    r"|anomal)"
 )
 
 
@@ -1026,6 +1039,75 @@ def render_history(payload: dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+# ------------------------------------------------- work-receipt ledger
+async def fetch_ledger(target: str, timeout: float = 5.0) -> dict[str, Any]:
+    """GET /ledger from a validator: the receipt auditor's per-tenant /
+    per-worker rollups and anomaly tallies (runtime/ledger.py)."""
+    host, port = parse_target(target)
+    status, body = await http_get(host, port, "/ledger", timeout)
+    payload = json.loads(body) if body else {}
+    if status != 200:
+        raise ConnectionError(
+            f"/ledger -> HTTP {status}: {payload.get('error', '?')} "
+            "(only nodes carrying a ReceiptAuditor — validators — "
+            "serve this route)"
+        )
+    return payload
+
+
+def _ledger_table(rows: dict[str, dict], label: str) -> list[str]:
+    head = (f"{label:<20} {'receipts':>8} {'prompt':>8} {'emitted':>8} "
+            f"{'observed':>8} {'busy_s':>9} {'kv_blk_s':>9} "
+            f"{'wire_kb':>8} {'anom':>5}")
+    out = [head, "-" * len(head)]
+    for key, r in sorted(
+        rows.items(), key=lambda kv: -kv[1].get("emitted_tokens", 0)
+    ):
+        obs = r.get("observed_tokens")
+        out.append(
+            f"{key[:20]:<20} {r.get('receipts', 0):>8} "
+            f"{r.get('prompt_tokens', 0):>8} "
+            f"{r.get('emitted_tokens', 0):>8} "
+            f"{obs if obs is not None else '-':>8} "
+            f"{r.get('busy_s', 0.0):>9.3f} "
+            f"{r.get('kv_block_s', 0.0):>9.1f} "
+            f"{r.get('wire_bytes', 0) / 1024:>8.1f} "
+            f"{r.get('anomalies', 0):>5}"
+        )
+    return out
+
+
+def render_ledger(payload: dict[str, Any]) -> str:
+    lines = [
+        f"receipts: {payload.get('accepted_total', 0)} accepted, "
+        f"{payload.get('rejected_total', 0)} rejected; "
+        f"{payload.get('observed_tokens_total', 0)} user-observed "
+        "token(s)"
+    ]
+    anomalies = payload.get("anomalies") or {}
+    if anomalies:
+        lines.append("anomalies: " + ", ".join(
+            f"{k}={v}" for k, v in sorted(anomalies.items())
+        ))
+    tenants = payload.get("tenants") or {}
+    if tenants:
+        lines.append("")
+        lines += _ledger_table(tenants, "tenant")
+    workers = payload.get("workers") or {}
+    if workers:
+        lines.append("")
+        lines += _ledger_table(workers, "worker")
+        flagged = [
+            (k, r["last_anomaly"]) for k, r in workers.items()
+            if r.get("last_anomaly")
+        ]
+        for wid, why in flagged:
+            lines.append(f"  !! {wid[:20]}: last anomaly {why}")
+    if not tenants and not workers:
+        lines.append("(no receipts ingested yet)")
+    return "\n".join(lines)
+
+
 # ------------------------------------------------------- SLO gate (CI)
 async def check_nodes(
     targets: list[str],
@@ -1200,6 +1282,15 @@ def main(argv: list[str] | None = None) -> int:
     hi.add_argument("--step", type=float, default=None,
                     help="preferred bucket seconds (picks the tier)")
     hi.add_argument("--json", action="store_true", dest="as_json")
+    lg = sub.add_parser(
+        "ledger",
+        help="per-tenant / per-worker metering rollups from a "
+             "validator's receipt auditor (GET /ledger)",
+    )
+    lg.add_argument("target", metavar="HOST:PORT",
+                    help="a node carrying a ReceiptAuditor (validator)")
+    lg.add_argument("--json", action="store_true", dest="as_json")
+    lg.add_argument("--timeout", type=float, default=5.0)
     ck = sub.add_parser(
         "check",
         help="SLO gate: evaluate alert rules against each node's "
@@ -1289,6 +1380,15 @@ def main(argv: list[str] | None = None) -> int:
             return 1
         print(json.dumps(payload) if args.as_json
               else render_history(payload))
+        return 0
+    if args.cmd == "ledger":
+        try:
+            payload = asyncio.run(fetch_ledger(args.target, args.timeout))
+        except (OSError, ConnectionError, asyncio.TimeoutError) as e:
+            print(f"{args.target}: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(payload) if args.as_json
+              else render_ledger(payload))
         return 0
     if args.cmd == "check":
         result = asyncio.run(check_nodes(

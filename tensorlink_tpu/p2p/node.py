@@ -1371,6 +1371,33 @@ class Node:
                 self.fleet_series.ingest(
                     peer.node_id, delta, kv=resp.get("kv")
                 )
+        # receipt harvest: only a node carrying a ReceiptAuditor (the
+        # validator role) consumes these; the same explicit version
+        # gate as timeseries_delta — unknown schema is a typed reject
+        # plus flight event, never a parse attempt
+        auditor = getattr(self, "receipt_auditor", None)
+        if auditor is not None and (
+            "receipts" in resp or "receipt_obs" in resp
+        ):
+            from tensorlink_tpu.runtime.ledger import RECEIPT_SCHEMA
+
+            v = resp.get("receipt_schema")
+            if isinstance(v, bool) or not isinstance(v, int) or \
+                    v != RECEIPT_SCHEMA:
+                self.metrics.incr("receipt_rejected_total")
+                self.flight.record(
+                    "receipt_rejected", "warn",
+                    peer=peer.node_id[:16], version=str(v)[:32],
+                )
+            else:
+                rs = resp.get("receipts")
+                if isinstance(rs, list):
+                    for r in rs[:64]:
+                        auditor.ingest(r)
+                obs = resp.get("receipt_obs")
+                if isinstance(obs, list):
+                    for o in obs[:256]:
+                        auditor.observe(o)
         return peer.ping_ms
 
     # ------------------------------------------------------- failure detection
@@ -1531,6 +1558,33 @@ class Node:
                     out["kv"] = serving.kv_stats_summary()
                 except Exception:  # noqa: BLE001
                     pass
+        # work-receipt piggyback (runtime/ledger.py): signed finished-
+        # request receipts (workers) and client-side token observations
+        # (users) ride the PONG back to the auditing validator — no new
+        # RPC round-trips. Drain-once semantics, so only a validator's
+        # ping collects them; version-gated like timeseries_delta.
+        if peer.role == "validator":
+            stamped = False
+            for attr, key in (
+                ("pending_receipts", "receipts"),
+                ("pending_receipt_obs", "receipt_obs"),
+            ):
+                fn = getattr(self, attr, None)
+                if fn is None:
+                    continue
+                try:
+                    items = fn()
+                except Exception:  # noqa: BLE001 — telemetry only
+                    continue
+                if items:
+                    out[key] = items
+                    if not stamped:
+                        from tensorlink_tpu.runtime.ledger import (
+                            RECEIPT_SCHEMA,
+                        )
+
+                        out["receipt_schema"] = RECEIPT_SCHEMA
+                        stamped = True
         return out
 
     def _build_serving(self, engine, *, paged: bool = False, **kw):
@@ -1720,6 +1774,17 @@ class Node:
             out["fleet"] = {
                 nid[:16]: rec
                 for nid, rec in self.peer_capabilities.items()
+            }
+        auditor = getattr(self, "receipt_auditor", None)
+        if auditor is not None:
+            # headline numbers only — the full per-tenant/per-worker
+            # rollup lives at GET /ledger (auditor.snapshot())
+            out["ledger"] = {
+                "accepted": auditor.accepted_total,
+                "rejected": auditor.rejected_total,
+                "anomalies": dict(auditor.anomaly_counts),
+                "tenants": len(auditor.tenants),
+                "workers": len(auditor.workers),
             }
         own = self.alerts.active()
         fleet = self.fleet_alerts.active()

@@ -37,8 +37,10 @@ re-drawing a single accepted token.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import threading
 import time
+from collections import OrderedDict, deque
 from math import ceil
 
 import jax
@@ -460,6 +462,13 @@ class PipelineStageEngine:
                 self._state, jnp.int32(slot), jnp.asarray(row)
             )
 
+    def slot_blocks(self, slot: int) -> int:
+        """How many pool blocks ``slot`` currently pins (metering reads
+        this for the KV block-seconds rectangle; upfront allocation at
+        admission means it is constant over a request's residency)."""
+        with self._lock:
+            return len(self._slot_blocks[int(slot)])
+
     def release_slot(self, slot: int) -> None:
         slot = int(slot)
         with self._lock:
@@ -710,6 +719,30 @@ class PipelineCoordinator:
         self._act_bytes = 0
         self._failovers = 0
         self._refills = 0
+        # per-request resource metering (runtime/ledger.py): the head
+        # owns the whole request lifecycle, so it is the one place a
+        # pipeline request's stage-0 busy seconds, activation wire
+        # bytes, and KV block-seconds can be folded into ONE meter the
+        # stage-0 worker signs (kind="pipeline"). Downstream stages'
+        # device time is deliberately NOT claimed — a receipt only ever
+        # bills work the signing node itself performed.
+        self.metering = True
+        self.meter_kind = "pipeline"
+        self._meter_log: OrderedDict[int, dict] = OrderedDict()
+        self._meter_fresh: deque = deque(maxlen=512)
+        self._metered_total = 0
+
+    # ------------------------------------------------------------ spans
+    def _span(self, name: str, req: dict | None = None, **attrs):
+        """Child span of a request's ``serving.pipeline_request`` root
+        (or of the current task's span, for hop spans opened inside a
+        prefill/tick span). No tracer on the node -> no-op."""
+        tracer = getattr(self.node, "tracer", None)
+        if tracer is None:
+            return contextlib.nullcontext()
+        root = (req or {}).get("span")
+        remote = root.context() if root is not None else None
+        return tracer.span(name, attrs=attrs, remote=remote)
 
     # expose the stage-0 pool so capability records advertise real
     # KV headroom for this node's share of the pipeline
@@ -721,6 +754,7 @@ class PipelineCoordinator:
     async def asubmit(
         self, ids, *, max_new: int | None = None, seed: int = 0,
         priority=Priority.STANDARD, deadline_s: float | None = None,
+        tenant: str | None = None,
     ) -> int:
         ids = [int(t) for t in np.asarray(ids).reshape(-1)]
         max_new = int(max_new if max_new is not None else
@@ -739,7 +773,7 @@ class PipelineCoordinator:
             )
         rid = self._next_rid
         self._next_rid += 1
-        self._requests[rid] = {
+        req = {
             "rid": rid, "ids": ids, "max_new": max_new,
             "seed": int(seed) & 0xFFFFFFFF,
             "deadline_at": (
@@ -749,7 +783,26 @@ class PipelineCoordinator:
             "tokens": [], "state": "queued", "slot": None,
             "last_tok": 0, "n_valid": 0,
             "done": asyncio.Event(), "error": None,
+            # metering accumulators + wall anchors (runtime/ledger.py)
+            "tenant": str(tenant)[:128] if tenant else None,
+            "t_wall0": time.time(), "t0": time.perf_counter(),
+            "busy_s": 0.0, "wire_bytes": 0.0,
+            "kv_blocks": 0, "kv_anchor": None, "kv_block_s": 0.0,
+            "span": None,
         }
+        tracer = getattr(self.node, "tracer", None)
+        if tracer is not None:
+            # root of this request's timeline: prefill chunks, decode
+            # ticks, and chain hops open as its children, and the
+            # downstream stages' handler spans continue the same trace
+            # over the wire — /spans on any stage shows the stitched
+            # per-stage view
+            req["span"] = tracer.start_span(
+                "serving.pipeline_request",
+                {"sid": self.sid, "rid": rid, "prompt_len": len(ids),
+                 "max_new": max_new, "n_stages": self.n_stages},
+            )
+        self._requests[rid] = req
         self._queue.append(rid)
         self._ensure_pump()
         return rid
@@ -902,13 +955,18 @@ class PipelineCoordinator:
             )
         return resp
 
-    async def _chain(self, out, meta: dict) -> dict:
+    async def _chain(self, out, meta: dict, bill=()) -> dict:
         """Ship a stage-0 output down the chain; the last stage's
         ACT_RESULT relays back as this request's reply. Transport
         failures on the FIRST hop are tagged dead_stage=1 here; deeper
-        hops tag themselves in their typed relay error."""
+        hops tag themselves in their typed relay error. ``bill`` lists
+        the request dicts whose meters split this hop's wire bytes."""
         blob = await asyncio.to_thread(pack_act_payload, out)
         self._act_bytes += len(blob)
+        if self.metering and bill:
+            share = len(blob) / len(bill)
+            for r in bill:
+                r["wire_bytes"] += share
         route_rest = [
             {k: w[k] for k in ("node_id", "host", "port") if k in w}
             | {"alt_hosts": list(w.get("alt_hosts", ()) or [])}
@@ -918,10 +976,17 @@ class PipelineCoordinator:
             **meta, "sid": self.sid, "stage": 1, "route": route_rest,
         }
         try:
-            peer = await self._stage_peer(1)
-            resp = await self.node.send_activations(
-                peer, blob, meta, timeout=self.ACT_TIMEOUT_S
-            )
+            # the hop span parents under the enclosing prefill/tick
+            # span (same coroutine), so each chain crossing shows up
+            # on the request timeline with its payload size
+            with self._span(
+                "serving.pipeline.hop", None,
+                stage=1, kind=str(meta.get("kind")), bytes=len(blob),
+            ):
+                peer = await self._stage_peer(1)
+                resp = await self.node.send_activations(
+                    peer, blob, meta, timeout=self.ACT_TIMEOUT_S
+                )
         except (ConnectionError, OSError, asyncio.TimeoutError,
                 TimeoutError) as e:
             err = ServingError(f"pipeline stage 1 unreachable: {e}")
@@ -951,30 +1016,43 @@ class PipelineCoordinator:
                 return
             n, C, slot = len(ids_eff), eng.chunk_len, req["slot"]
             tok0 = None
-            for start in range(0, n, C):
-                da = req["deadline_at"]
-                if da is not None and time.perf_counter() > da:
-                    raise DeadlineExceededError(
-                        f"rid {req['rid']} deadline passed during "
-                        "prefill", rid=req["rid"],
+            with self._span(
+                "serving.pipeline.prefill", req,
+                stage=0, slot=slot, n_ctx=n,
+            ):
+                for start in range(0, n, C):
+                    da = req["deadline_at"]
+                    if da is not None and time.perf_counter() > da:
+                        raise DeadlineExceededError(
+                            f"rid {req['rid']} deadline passed during "
+                            "prefill", rid=req["rid"],
+                        )
+                    nreal = min(C, n - start)
+                    ids_chunk = np.zeros((1, C), np.int32)
+                    ids_chunk[0, :nreal] = ids_eff[start:start + nreal]
+                    tb = time.perf_counter()
+                    out = await asyncio.to_thread(
+                        eng.prefill_chunk, slot, ids_chunk, start, nreal,
+                        req["seed"], n, budget,
                     )
-                nreal = min(C, n - start)
-                ids_chunk = np.zeros((1, C), np.int32)
-                ids_chunk[0, :nreal] = ids_eff[start:start + nreal]
-                out = await asyncio.to_thread(
-                    eng.prefill_chunk, slot, ids_chunk, start, nreal,
-                    req["seed"], n, budget,
-                )
-                if self.n_stages == 1:
-                    tok0 = int(out)
-                    continue
-                resp = await self._chain(out, {
-                    "kind": "prefill", "slot": slot, "start": start,
-                    "nreal": nreal, "seed": req["seed"], "n_ctx": n,
-                    "budget": budget,
-                    "deadline_s": self._leg_deadline([req]),
-                })
-                tok0 = int(resp["tok0"])
+                    if self.metering:
+                        req["busy_s"] += time.perf_counter() - tb
+                        if start == 0:
+                            # upfront allocation: the block count is
+                            # fixed for the slot's whole residency, so
+                            # the KV rectangle is one anchor + one close
+                            req["kv_blocks"] = eng.slot_blocks(slot)
+                            req["kv_anchor"] = time.perf_counter()
+                    if self.n_stages == 1:
+                        tok0 = int(out)
+                        continue
+                    resp = await self._chain(out, {
+                        "kind": "prefill", "slot": slot, "start": start,
+                        "nreal": nreal, "seed": req["seed"], "n_ctx": n,
+                        "budget": budget,
+                        "deadline_s": self._leg_deadline([req]),
+                    }, bill=(req,))
+                    tok0 = int(resp["tok0"])
             req["n_valid"] = n
             req["tokens"].append(tok0)
             req["last_tok"] = tok0
@@ -1013,25 +1091,36 @@ class PipelineCoordinator:
             n_valid[s] = req["n_valid"] - 1
             live[s] = True
             seeds[s] = req["seed"]
-        out = await asyncio.to_thread(
-            eng.decode_step, toks, n_valid, live, seeds
-        )
-        if self.n_stages > 1:
-            resp = await self._chain(out, {
-                "kind": "decode", "tick": self._ticks,
-                "n_valid": n_valid.tolist(),
-                "live": live.tolist(),
-                "seeds": seeds.tolist(),
-                "deadline_s": self._leg_deadline(decoding),
-            })
-            tokens = np.asarray(resp["tokens"], np.int64)
-            if tokens.shape != (S,):
-                raise ServingError(
-                    f"pipeline tick returned {tokens.shape} tokens, "
-                    f"wanted ({S},)"
-                )
-        else:
-            tokens = np.asarray(out, np.int64)
+        with self._span(
+            "serving.pipeline.decode_tick", decoding[0],
+            stage=0, tick=self._ticks, rows=len(decoding),
+        ):
+            tb = time.perf_counter()
+            out = await asyncio.to_thread(
+                eng.decode_step, toks, n_valid, live, seeds
+            )
+            if self.metering:
+                # one program run serves every live row: each slot
+                # bills for the batch lane it held this tick
+                share = (time.perf_counter() - tb) / len(decoding)
+                for req in decoding:
+                    req["busy_s"] += share
+            if self.n_stages > 1:
+                resp = await self._chain(out, {
+                    "kind": "decode", "tick": self._ticks,
+                    "n_valid": n_valid.tolist(),
+                    "live": live.tolist(),
+                    "seeds": seeds.tolist(),
+                    "deadline_s": self._leg_deadline(decoding),
+                }, bill=decoding)
+                tokens = np.asarray(resp["tokens"], np.int64)
+                if tokens.shape != (S,):
+                    raise ServingError(
+                        f"pipeline tick returned {tokens.shape} tokens, "
+                        f"wanted ({S},)"
+                    )
+            else:
+                tokens = np.asarray(out, np.int64)
         self._ticks += 1
         eos = self.gen.eos_token_id
         for req in decoding:
@@ -1142,15 +1231,72 @@ class PipelineCoordinator:
     def _finish(self, req: dict) -> None:
         self._release(req)
         req["state"] = "done"
+        self._meter_finish(req)
+        self._finish_span(req, "ok")
         req["done"].set()
 
     def _fail(self, req: dict, err: Exception) -> None:
         self._release(req)
         req["state"] = "failed"
         req["error"] = err
+        self._finish_span(req, "error")
         req["done"].set()
 
+    def _finish_span(self, req: dict, status: str) -> None:
+        sp = req.pop("span", None)
+        if sp is not None:
+            tracer = getattr(self.node, "tracer", None)
+            if tracer is not None:
+                tracer.finish_span(sp, status=status)
+
+    def _meter_finish(self, req: dict) -> None:
+        """Freeze this request's meter for receipt signing (successful
+        completions only — a failed stream delivered nothing billable).
+        The worker's ``work_receipt``/``pending_receipts`` read these
+        through the same ``meter``/``drain_meters`` surface the flat
+        engines expose."""
+        if not self.metering:
+            return
+        t0 = req.get("t_wall0") or time.time()
+        meter = {
+            "rid": int(req["rid"]),
+            "tenant": req.get("tenant"),
+            "kind": self.meter_kind,
+            "t_start": float(t0),
+            "t_end": float(
+                t0 + max(time.perf_counter() - req.get("t0", 0.0), 0.0)
+            ) if req.get("t0") else float(t0),
+            "prompt_tokens": len(req["ids"]),
+            "emitted_tokens": len(req["tokens"]),
+            "busy_s": float(req.get("busy_s", 0.0)),
+            "flops": 0.0,
+            "hbm_bytes": 0.0,
+            "kv_block_s": float(req.get("kv_block_s", 0.0)),
+            "wire_bytes": int(req.get("wire_bytes", 0.0)),
+        }
+        self._meter_log[meter["rid"]] = meter
+        while len(self._meter_log) > 4096:
+            self._meter_log.popitem(last=False)
+        self._meter_fresh.append(meter)
+        self._metered_total += 1
+
+    def meter(self, rid: int) -> dict | None:
+        return self._meter_log.get(int(rid))
+
+    def drain_meters(self, limit: int = 64) -> list[dict]:
+        out: list[dict] = []
+        while self._meter_fresh and len(out) < limit:
+            out.append(self._meter_fresh.popleft())
+        return out
+
     def _release(self, req: dict) -> None:
+        # close the KV block-seconds rectangle while the blocks are
+        # still attributable to this request
+        if req.get("kv_anchor") is not None:
+            req["kv_block_s"] += req.get("kv_blocks", 0) * max(
+                time.perf_counter() - req["kv_anchor"], 0.0
+            )
+            req["kv_anchor"] = None
         slot = req.get("slot")
         if slot is not None and self._slot_rid[slot] == req["rid"]:
             self._slot_rid[slot] = None
@@ -1188,6 +1334,11 @@ class PipelineCoordinator:
                 "reprefills": self._refills,
                 "queued": len(self._queue),
                 "active": len(self._active()),
+            },
+            "metering": {
+                "enabled": self.metering,
+                "metered_total": self._metered_total,
+                "undrained": len(self._meter_fresh),
             },
             "stage0": self.engine.stats(),
             "pool": self.engine.pool.stats(),

@@ -324,6 +324,23 @@ class _Request:
     spec_rounds: int = 0  # verify passes this request was live for
     spec_proposed: int = 0  # drafted tokens verified on its behalf
     spec_accepted: int = 0  # drafted tokens accepted into its stream
+    # work-receipt metering (runtime/ledger.py): device-busy seconds
+    # apportioned from this request's share of drained dispatches,
+    # claimed flops/HBM bytes from the AOT cost model, KV
+    # block-seconds integrated from the paged pool's alloc/release
+    # stream, and the billing identity the submitter declared
+    tenant: str | None = None
+    busy_s: float = 0.0
+    flops: float = 0.0
+    hbm_bytes: float = 0.0
+    kv_block_s: float = 0.0
+    kv_blocks_now: int = 0
+    kv_anchor: float | None = None
+    wire_bytes: int = 0
+    # prefill dispatch handles not yet folded into busy_s (chunked
+    # prefill stacks several; FIFO finalization means all are stamped
+    # by the time the first token syncs)
+    disp_hist: list = field(default_factory=list)
 
 
 class ContinuousBatchingEngine:
@@ -360,6 +377,7 @@ class ContinuousBatchingEngine:
         tracer=None,
         device_timing: bool = True,
         capability: dict | None = None,
+        metering: bool = True,
     ):
         if engine.rolling:
             raise NotImplementedError(
@@ -406,6 +424,19 @@ class ContinuousBatchingEngine:
         # per-phase TTFT decomposition EWMAs (queue vs prefill-compute
         # vs first-dispatch), folded in at _finish
         self._ttft_decomp: dict[str, float] = {}
+        # work-receipt metering (runtime/ledger.py): finished-request
+        # meter dicts, rid-addressable for the reply path and drainable
+        # once for heartbeat piggybacking — both bounded
+        self.metering = bool(metering)
+        # what this engine's finished requests bill as: "serve"
+        # (colocated), or the disagg "prefill_leg"/"decode_leg" —
+        # roles/worker.py sets it from the serving mode
+        self.meter_kind = "serve"
+        self._meter_log: collections.OrderedDict[int, dict] = (
+            collections.OrderedDict()
+        )
+        self._meter_fresh: collections.deque = collections.deque(maxlen=512)
+        self._metered_total = 0
 
         self._queue: collections.deque[_Request] = collections.deque()
         self._requests: dict[int, _Request] = {}
@@ -1218,6 +1249,7 @@ class ContinuousBatchingEngine:
         self, ids, *, max_new: int | None = None, seed: int = 0,
         priority: Priority | int | str = Priority.STANDARD,
         deadline_s: float | None = None,
+        tenant: str | None = None,
         _hold: bool = False,
     ) -> int:
         """Enqueue one prompt (1-D token array). Returns a request id;
@@ -1276,6 +1308,9 @@ class ContinuousBatchingEngine:
                 # wall-clock anchor: the span timeline converts the
                 # monotonic stamps against this pair
                 submitted_ns=time.time_ns(),
+                # billing identity for the work receipt; clamped — it
+                # crosses trust boundaries verbatim
+                tenant=(str(tenant)[:128] if tenant else None),
             )
             # internal (prefill_export): the hold must be set UNDER the
             # admission lock — set after submit() returns, a concurrent
@@ -1548,6 +1583,8 @@ class ContinuousBatchingEngine:
         req.first_token = tok0
         if self._timer is not None:
             req.disp = self._timer.dispatch("prefill", tok0)
+            if self.metering:
+                req.disp_hist.append(req.disp)
         self._event("serving.admit", rid=req.rid, slot=slot, padded=Tp)
 
     def _maybe_record_ttft(self, req: _Request) -> None:
@@ -1635,9 +1672,115 @@ class ContinuousBatchingEngine:
                 parent=root,
             )
 
+    # ---------------------------------------------------------- metering
+    def _meter_apportion(self, disp, live) -> None:
+        """Split one drained chunk's device-busy seconds (and the AOT
+        cost model's per-dispatch flops/bytes) equally across the rows
+        that occupied the batch: a slot bills for the lane it held —
+        the chunk's device cost was invariant to how many of its rows
+        emitted. Called right after the chunk finalized, so
+        ``disp.busy_s`` is stamped; pure host arithmetic, no sync."""
+        share = 1.0 / len(live)
+        cost = self._prog_cost.get(disp.program) or {}
+        busy = disp.busy_s * share
+        fl = cost.get("flops", 0.0) * share
+        by = cost.get("bytes", 0.0) * share
+        for req in live:
+            req.busy_s += busy
+            req.flops += fl
+            req.hbm_bytes += by
+
+    def _meter_fold_prefill(self, req: _Request) -> None:
+        """Fold the request's finalized prefill dispatches into its
+        meter. Prefill programs serve ONE request, so the whole
+        dispatch bills to it. FIFO finalization means every chunk is
+        stamped by the time the first token syncs; a handle not yet
+        finalized (aborted mid-prefill) stays parked."""
+        if not req.disp_hist:
+            return
+        rest = []
+        for d in req.disp_hist:
+            if not d.done:
+                rest.append(d)
+                continue
+            req.busy_s += d.busy_s
+            cost = self._prog_cost.get(d.program)
+            if cost:
+                req.flops += cost.get("flops", 0.0)
+                req.hbm_bytes += cost.get("bytes", 0.0)
+        req.disp_hist = rest
+
+    def _meter_kv(self, req: _Request, blocks: int | None = None) -> None:
+        """Integrate KV block-seconds: fold the (blocks x elapsed)
+        rectangle since the last holding change, then anchor at the
+        new count. Called at alloc/grow/preempt/finish on the paged
+        engine; the contiguous engine holds no pool blocks."""
+        now = time.perf_counter()
+        if req.kv_anchor is not None:
+            req.kv_block_s += req.kv_blocks_now * (now - req.kv_anchor)
+        req.kv_anchor = now
+        if blocks is not None:
+            req.kv_blocks_now = int(blocks)
+
+    def _meter_finish(self, req: _Request, kind: str | None = None) -> None:
+        """Freeze the finished request's accumulators into the meter
+        record a work receipt is built from (runtime/ledger.py).
+        Wall-clock start/end reconstruct from the ``submitted_ns``
+        anchor the span timeline already keeps — monotonic stamps
+        never leave the host they were taken on."""
+        if not self.metering:
+            return
+        self._meter_fold_prefill(req)
+        self._meter_kv(req, 0)
+        t0 = (req.submitted_ns or time.time_ns()) / 1e9
+        end = (
+            req.finished_at if req.finished_at is not None
+            else time.perf_counter()
+        )
+        meter = {
+            "rid": req.rid,
+            "tenant": req.tenant or "anonymous",
+            "kind": kind or self.meter_kind,
+            "t_start": t0,
+            "t_end": t0 + max(end - req.submitted_at, 0.0),
+            "prompt_tokens": (
+                int(req.ids.size) if req.ids is not None else 0
+            ),
+            "emitted_tokens": len(req.tokens),
+            "busy_s": req.busy_s,
+            "flops": req.flops,
+            "hbm_bytes": req.hbm_bytes,
+            "kv_block_s": req.kv_block_s,
+            "wire_bytes": req.wire_bytes,
+        }
+        self._meter_log[req.rid] = meter
+        while len(self._meter_log) > 4 * self.keep_results:
+            self._meter_log.popitem(last=False)
+        self._meter_fresh.append(meter)
+        self._metered_total += 1
+
+    def meter(self, rid: int) -> dict | None:
+        """The finished request's meter record — None until it
+        finishes (or after bounded eviction). Values are immutable
+        once written."""
+        with self._lock:
+            return self._meter_log.get(rid)
+
+    def drain_meters(self, limit: int = 64) -> list[dict]:
+        """Up to ``limit`` finished meters not yet drained — the
+        heartbeat-piggyback source. Each meter is handed out exactly
+        once; a lost carrier frame loses the receipt (the reply-path
+        copy and the bounded ``meter()`` log remain)."""
+        out: list[dict] = []
+        with self._lock:
+            while self._meter_fresh and len(out) < limit:
+                out.append(self._meter_fresh.popleft())
+        return out
+
     def _finish(self, req: _Request) -> None:
         req.done = True
         req.finished_at = time.perf_counter()
+        self._meter_finish(req)
         req.ids = None  # prompt no longer needed; keep retention light
         self._emit_request_timeline(req)
         slot = req.slot
@@ -1721,6 +1864,14 @@ class ContinuousBatchingEngine:
             arr = np.asarray(payload[0])  # [K, S] — THE host sync point
             if disp is not None:
                 self._timer.drained(disp)  # right after the sync: exact
+            if self.metering and disp is not None:
+                # apportion BEFORE the append loop: a request the loop
+                # finishes freezes its meter with this chunk included
+                live = [
+                    r for r in snapshot if r is not None and not r.done
+                ]
+                if live:
+                    self._meter_apportion(disp, live)
             emitted = 0
             for k in range(arr.shape[0]):
                 for s, req in enumerate(snapshot):
@@ -1746,6 +1897,10 @@ class ContinuousBatchingEngine:
         toks = np.asarray(payload[0])  # THE host sync point
         if disp is not None:
             self._timer.drained(disp)  # right after the sync: exact
+        if self.metering and disp is not None:
+            live = [r for r in snapshot if r is not None and not r.done]
+            if live:
+                self._meter_apportion(disp, live)
         ne = np.asarray(payload[1])
         na = np.asarray(payload[2])
         fb = np.asarray(payload[3])
@@ -1881,6 +2036,10 @@ class ContinuousBatchingEngine:
             if req.disp is not None and self._timer is not None:
                 self._timer.drained(req.disp)  # prefill synced here
             req.disp = None
+            if self.metering:
+                # fold BEFORE the append: a max_new=1 request finishes
+                # inside it, and its meter must include the prefill
+                self._meter_fold_prefill(req)
             self._maybe_record_ttft(req)
             req.first_token = None
             self._append_token(req, t0)
@@ -1970,6 +2129,7 @@ class ContinuousBatchingEngine:
         self, ids, *, max_new: int | None = None, seed: int = 0,
         priority: Priority | int | str = Priority.STANDARD,
         deadline_s: float | None = None,
+        tenant: str | None = None,
     ) -> int:
         """Asyncio wrapper for ``submit``: admission dispatches a
         prefill (and, for a new prompt-length bucket, compiles one) and
@@ -1980,7 +2140,7 @@ class ContinuousBatchingEngine:
         return await loop.run_in_executor(
             None, lambda: self.submit(
                 ids, max_new=max_new, seed=seed, priority=priority,
-                deadline_s=deadline_s,
+                deadline_s=deadline_s, tenant=tenant,
             )
         )
 
@@ -2104,6 +2264,11 @@ class ContinuousBatchingEngine:
             # shed client is being told (retry_after_s), how much was
             # shed per class, and the measured EWMAs behind both
             out["admission"] = adm
+            out["metering"] = {
+                "enabled": self.metering,
+                "metered_total": self._metered_total,
+                "undrained": len(self._meter_fresh),
+            }
             dt = self._device_time_locked()
             if dt is not None:
                 out["device_time"] = dt
@@ -2551,6 +2716,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self, ids, *, max_new: int | None = None, seed: int = 0,
         priority: Priority | int | str = Priority.STANDARD,
         deadline_s: float | None = None, timeout_s: float | None = None,
+        tenant: str | None = None,
     ) -> dict:
         """Run this request's PREFILL leg only and export the result.
 
@@ -2571,7 +2737,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._disagg_guard()
         rid = self.submit(
             ids, max_new=max_new, seed=seed, priority=priority,
-            deadline_s=deadline_s, _hold=True,
+            deadline_s=deadline_s, tenant=tenant, _hold=True,
         )
         with self._lock:
             req = self._requests[rid]
@@ -2685,6 +2851,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self, payload: dict, *,
         priority: Priority | int | str = Priority.STANDARD,
         deadline_s: float | None = None,
+        tenant: str | None = None,
+        wire_bytes: int = 0,
     ) -> int:
         """Graft a prefill leg's exported blocks into THIS engine's pool
         and start decoding them: the decode side of disaggregated
@@ -2801,6 +2969,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     now + deadline_s if deadline_s is not None else None
                 ),
                 submitted_ns=time.time_ns(),
+                tenant=(str(tenant)[:128] if tenant else None),
+                # the packed blob this leg received over the wire —
+                # folded into the decode-leg receipt
+                wire_bytes=max(int(wire_bytes), 0),
             )
             if deadline_s is not None:
                 self._deadlined += 1
@@ -2810,6 +2982,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             req.admitted_at = now
             self._slot_req[slot] = req
             self._slot_blocks[slot] = list(bids)
+            if self.metering:
+                self._meter_kv(req, len(bids))
             self._slot_limit[slot] = min(t0 + max_new, self.L)
             self._slot_ub[slot] = t0
             try:
@@ -3156,6 +3330,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self._slot_blocks[slot] = (
             hits + ([tail_bid] if tail is not None else []) + new_blocks
         )
+        if self.metering:
+            self._meter_kv(req, len(self._slot_blocks[slot]))
         self._slot_limit[slot] = min(t0 + max_new_eff, self.L)
         self._slot_ub[slot] = t0
         if cow_src is not None:
@@ -3231,6 +3407,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             # every chunk is its own dispatch; tok0 (a device scalar
             # output, garbage on non-final chunks) is the ready probe
             req.disp = self._timer.dispatch("prefill_chunk", tok0)
+            if self.metering:
+                req.disp_hist.append(req.disp)
         self._event(
             "serving.prefill_chunk", rid=req.rid, slot=slot, start=pos,
             tokens=nreal, final=is_final,
@@ -3300,6 +3478,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         if req.done:
             return  # finished in flight; _finish already freed everything
         self._release_slot_blocks(slot)
+        if self.metering:
+            self._meter_kv(req, 0)  # holds nothing while re-queued
         self._slot_req[slot] = None
         req.slot = None
         self._free.append(slot)
@@ -3398,6 +3578,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                     continue  # the slot itself was evicted
                 self._slot_blocks[slot].extend(got)
                 self._set_row(slot)
+                if self.metering:
+                    self._meter_kv(req, len(self._slot_blocks[slot]))
             self._slot_ub[slot] = target
         return [
             s for s in decoding

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -1057,6 +1058,29 @@ class UserNode(Node):
         # (readiness condition + flight event), not only when the next
         # train_step happens to fail
         self._jobs: dict[str, DistributedJob] = {}
+        # user-side receipt observations (what this client ACTUALLY
+        # received per remote request) queued for the validator's next
+        # heartbeat PONG — the auditor cross-checks them against the
+        # worker's signed claim, so a worker inflating emitted_tokens
+        # gets a token_mismatch even with a valid signature
+        self._receipt_obs: deque[dict] = deque(maxlen=1024)
+
+    def record_receipt_obs(
+        self, worker: str, rid: int, tenant: str, tokens: int
+    ) -> None:
+        self._receipt_obs.append({
+            "worker": str(worker), "rid": int(rid),
+            "tenant": str(tenant)[:128], "tokens": int(tokens),
+        })
+
+    def pending_receipt_obs(self, limit: int = 256) -> list[dict]:
+        """Drain queued observations for a validator PONG (read by
+        ``Node._h_ping`` via duck-typed hook, same contract as the
+        worker's ``pending_receipts``)."""
+        out: list[dict] = []
+        while self._receipt_obs and len(out) < limit:
+            out.append(self._receipt_obs.popleft())
+        return out
 
     def _register_job(self, job: "DistributedJob") -> None:
         self._jobs[job.job.job_id] = job
@@ -1708,6 +1732,44 @@ class RemoteServingClient:
         self.pipeline_sid = pipeline_sid
         self._handles: dict[int, dict] = {}
         self._next_rid = 0
+        # client rid -> verified work receipt (the worker's signed
+        # resource claim that rode the SERVE_TOKENS reply), bounded so
+        # a long-lived client doesn't grow without end
+        self.receipts: deque[tuple[int, dict]] = deque(maxlen=256)
+
+    def receipt(self, rid: int) -> dict | None:
+        for r, rec in self.receipts:
+            if r == rid:
+                return rec
+        return None
+
+    def _note_receipt(self, rid: int, h: dict, resp: dict) -> str:
+        """Verify + store the receipt (if any) that rode the tokens
+        reply, and queue the user-side observation the validator
+        cross-checks against the worker's claim. Returns the tenant to
+        bill the observation under. Never raises: accounting must not
+        break token delivery."""
+        node = self.user
+        tenant = str(node.node_id)[:128]
+        rec = resp.get("receipt")
+        if isinstance(rec, dict):
+            from tensorlink_tpu.runtime.ledger import verify_receipt
+
+            ok, why = verify_receipt(rec)
+            if ok:
+                node.metrics.incr("receipts_verified_total")
+                self.receipts.append((rid, rec))
+                # trust the billed tenant label only after the
+                # signature checks out
+                tenant = str(rec.get("tenant") or tenant)[:128]
+            else:
+                node.metrics.incr("receipts_bad_total")
+                node.flight.record(
+                    "receipt.client_reject", "warn",
+                    worker=h["result_peer"].node_id[:16],
+                    rid=int(h["remote_rid"]), reason=why,
+                )
+        return tenant
 
     async def _pipeline_head(self) -> Peer:
         """Locate the stage-0 (head) worker of the target pipeline via
@@ -2027,6 +2089,12 @@ class RemoteServingClient:
             except BaseException:
                 self._terminal(rid, h)
                 raise
+        tokens = np.asarray(resp["tokens"], np.int32)
+        tenant = self._note_receipt(rid, h, resp)
+        node.record_receipt_obs(
+            h["result_peer"].node_id, int(h["remote_rid"]),
+            tenant, int(tokens.size),
+        )
         node.tracer.finish_span(h["root"])
         del self._handles[rid]
-        return np.asarray(resp["tokens"], np.int32)
+        return tokens

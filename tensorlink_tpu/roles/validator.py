@@ -19,6 +19,7 @@ from tensorlink_tpu.config import NodeConfig
 from tensorlink_tpu.p2p.node import Node, Peer, wire_guard
 from tensorlink_tpu.roles.jobs import JobRecord, validate_job_request
 from tensorlink_tpu.roles.registry import Registry
+from tensorlink_tpu.runtime.ledger import ReceiptAuditor
 
 
 def roofline_score(cap: dict, leg: str) -> tuple[float, float]:
@@ -149,6 +150,47 @@ class ValidatorNode(Node):
         self.registry = registry
         self.jobs: dict[str, JobRecord] = {}
         self.job_state: dict[str, dict] = {}  # job_id -> {loss, accuracy,...}
+        # Work-receipt auditor: ingests signed meters harvested from
+        # worker PONGs / heartbeats, cross-checks them against the
+        # worker's own published capability record and the user-side
+        # token observations, and keeps the per-tenant / per-worker
+        # ledgers served at GET /ledger. The presence of this attribute
+        # is what turns on the receipt piggyback in Node.ping().
+        self.receipt_auditor = ReceiptAuditor(
+            metrics=self.metrics,
+            recorder=self.flight,
+            capability_for=self.peer_capabilities.get,
+            on_anomaly=self._receipt_demerit,
+        )
+
+    def _receipt_demerit(self, wid: str, reason: str) -> None:
+        """Reputation demerit for a worker whose receipt was rejected or
+        flagged. A metering lie is cheaper to tell than a failed
+        re-execution audit is to engineer, so this halves reputation
+        instead of zeroing it the way ``_finish_audit`` does — honest
+        one-off clock skew survives, repeat offenders converge to 0.
+        ``token_mismatch`` is exempt: there the *user's* observation
+        disagrees with the claim and either side could be lying."""
+        if reason == "token_mismatch":
+            return
+        peer = self.peers.get(wid)
+        rep = peer.reputation if peer is not None else 1.0
+        new = max(float(rep), 0.0) * 0.5
+        if peer is not None:
+            peer.reputation = new
+        self.dht.put_local(f"rep:{wid}", new)
+        if self.registry is not None:
+            async def _demote(reg=self.registry, wid=wid, new=new):
+                try:
+                    await asyncio.to_thread(reg.set_reputation, wid, new)
+                except Exception as e:
+                    self.log.warning("registry demerit failed: %s", e)
+
+            self._spawn(_demote())
+        self.flight.record(
+            "receipt.demerit", "warn",
+            worker=wid[:16], reason=reason, reputation=new,
+        )
 
     def on_peer_lost(self, peer: Peer) -> None:
         """A dead worker that holds live placements degrades every job

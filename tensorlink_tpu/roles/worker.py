@@ -17,6 +17,7 @@ Re-design of src/roles/worker.py. Differences that matter on TPU:
 from __future__ import annotations
 
 import asyncio
+import collections
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -557,6 +558,13 @@ class WorkerNode(Node):
         # live stage by MODULE_SPEC (author-only), or expired — never
         # leaked (review finding).
         self._reservations: dict[tuple[str, int], tuple[int, float, str]] = {}
+        # signed work receipts by engine rid (runtime/ledger.py):
+        # built once per finished request — the SAME signed object
+        # rides the SERVE_TOKENS reply and the heartbeat PONG, so a
+        # validator seeing both dedups by content, not by luck
+        self._receipts: "collections.OrderedDict[int, dict]" = (
+            collections.OrderedDict()
+        )
         self.training = False
         # disaggregated serving (ROADMAP item 1): a worker may host a
         # continuous-batching scheduler and advertise a serving leg —
@@ -805,8 +813,57 @@ class WorkerNode(Node):
             )
         self._build_serving(engine, paged=paged, **kw)
         self.serving_mode = mode
+        # what this engine's finished requests bill as on their work
+        # receipts (runtime/ledger.py)
+        self.serving.meter_kind = {
+            "colocated": "serve", "prefill": "prefill_leg",
+            "decode": "decode_leg",
+        }[mode]
         self.flight.record("serving.attached", mode=mode, paged=paged)
         return self.serving
+
+    # ------------------------------------------------- work receipts
+    def work_receipt(self, rid: int) -> dict | None:
+        """The signed WorkReceipt for a finished request — None until
+        it finishes, when metering is off, or after bounded eviction.
+        Built once and cached: the reply path and the heartbeat drain
+        hand out the SAME signed object, so a validator seeing both
+        dedups by canonical content."""
+        r = self._receipts.get(rid)
+        if r is not None:
+            return r
+        serving = self.serving
+        if serving is None or not getattr(serving, "metering", False):
+            return None
+        meter = serving.meter(rid)
+        if meter is None:
+            return None
+        return self._receipt_for_meter(meter)
+
+    def _receipt_for_meter(self, meter: dict) -> dict:
+        from tensorlink_tpu.runtime.ledger import build_receipt
+
+        rid = int(meter["rid"])
+        r = self._receipts.get(rid)
+        if r is None:
+            r = build_receipt(meter, self.identity)
+            self._receipts[rid] = r
+            while len(self._receipts) > 4096:
+                self._receipts.popitem(last=False)
+            self.metrics.incr("receipts_issued_total")
+        return r
+
+    def pending_receipts(self, limit: int = 64) -> list[dict]:
+        """Receipts for finished requests not yet shipped to a
+        validator — the PONG piggyback source (p2p/node.py _h_ping).
+        Drains the engine's fresh-meter queue exactly once."""
+        serving = self.serving
+        if serving is None or not hasattr(serving, "drain_meters"):
+            return []
+        return [
+            self._receipt_for_meter(m)
+            for m in serving.drain_meters(limit)
+        ]
 
     def _serving_or_error(self, need_paged: bool = False):
         serving = self.serving
@@ -826,7 +883,7 @@ class WorkerNode(Node):
         return serving, None
 
     @staticmethod
-    def _serve_kwargs(msg: dict) -> dict:
+    def _serve_kwargs(msg: dict, peer=None) -> dict:
         out = {
             "seed": int(msg.get("seed", 0)),
             "priority": str(msg.get("priority", "standard"))[:32],
@@ -835,6 +892,13 @@ class WorkerNode(Node):
             out["max_new"] = int(msg["max_new"])
         if msg.get("deadline_s") is not None:
             out["deadline_s"] = float(msg["deadline_s"])
+        # billing identity for the work receipt: the submitter's
+        # declared tenant, defaulting to the submitting peer's node id
+        # — an absent field never bills to another tenant's name
+        if msg.get("tenant") is not None:
+            out["tenant"] = str(msg["tenant"])[:128]
+        elif peer is not None:
+            out["tenant"] = str(peer.node_id)[:128]
         return out
 
     def _serve_ids(self, msg: dict) -> np.ndarray:
@@ -863,7 +927,7 @@ class WorkerNode(Node):
             return err
         ids = self._serve_ids(msg)
         try:
-            rid = await serving.asubmit(ids, **self._serve_kwargs(msg))
+            rid = await serving.asubmit(ids, **self._serve_kwargs(msg, peer))
         except Exception as e:  # noqa: BLE001 — typed across the wire
             return serve_error_to_wire(e)
         return {"type": "SERVE_ACCEPTED", "rid": rid}
@@ -884,11 +948,18 @@ class WorkerNode(Node):
             tokens = await serving.aresult(int(msg["rid"]), **kw)
         except Exception as e:  # noqa: BLE001 — typed across the wire
             return serve_error_to_wire(e)
-        return {
+        out = {
             "type": "SERVE_TOKENS",
             "rid": int(msg["rid"]),
             "tokens": [int(t) for t in np.asarray(tokens).reshape(-1)],
         }
+        # the signed work receipt rides the reply the user already
+        # waits for: the client can verify the claim against the
+        # tokens in the SAME frame (runtime/ledger.py)
+        receipt = self.work_receipt(int(msg["rid"]))
+        if receipt is not None:
+            out["receipt"] = receipt
+        return out
 
     @wire_guard
     async def _h_serve_prefill(self, node, peer, msg) -> dict:
@@ -911,7 +982,7 @@ class WorkerNode(Node):
         if err is not None:
             return err
         ids = self._serve_ids(msg)
-        kw = self._serve_kwargs(msg)
+        kw = self._serve_kwargs(msg, peer)
         t0 = time.perf_counter()
         try:
             with self.tracer.span(
@@ -945,6 +1016,8 @@ class WorkerNode(Node):
             "priority": kw.get("priority", "standard"),
             "deadline_s": kw.get("deadline_s"),
             "origin": peer.node_id,
+            # the decode leg bills the SAME tenant as the prefill leg
+            "tenant": kw.get("tenant"),
         }
         reason = None
         t1 = time.perf_counter()
@@ -1054,6 +1127,9 @@ class WorkerNode(Node):
         kw = {"priority": meta.get("priority", "standard")}
         if meta.get("deadline_s") is not None:
             kw["deadline_s"] = float(meta["deadline_s"])
+        tenant = meta.get("tenant") or meta.get("origin") or peer.node_id
+        if tenant:
+            kw["tenant"] = str(tenant)[:128]
         try:
             with self.tracer.span(
                 "serving.kv_import", {"bytes": len(msg["blob"])}
@@ -1062,7 +1138,9 @@ class WorkerNode(Node):
                     unpack_kv_payload, bytes(msg["blob"])
                 )
                 rid = await asyncio.to_thread(
-                    serving.import_prefill, payload, **kw
+                    lambda: serving.import_prefill(
+                        payload, wire_bytes=len(msg["blob"]), **kw
+                    )
                 )
         except ValueError as e:
             # malformed or incompatible wire payload: CRC mismatch, or a

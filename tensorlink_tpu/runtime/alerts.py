@@ -294,6 +294,7 @@ def default_rules(slo: dict | None = None) -> list[AlertRule]:
          "tpot_p99_s": {"interactive": 0.1},   # or a bare float for
          "windows_s": [30, 120],               # the overall histogram
          "shed_budget_frac": 0.01,
+         "receipt_anomaly_frac": 0.01,
          "host_gap_frac": 0.3,
          "kv_used_frac": 0.9,
          "heartbeat_stale_s": 10}
@@ -329,6 +330,14 @@ def default_rules(slo: dict | None = None) -> list[AlertRule]:
             burn_factor=float(slo.get("burn_factor", 10.0)),
             windows_s=windows, severity="error",
         ))
+    rules.append(AlertRule(
+        name="receipt-anomaly-burn", kind="budget_burn",
+        numerator="receipt_anomaly_total",
+        denominator="receipt_accepted_total",
+        budget_frac=float(slo.get("receipt_anomaly_frac", 0.01)),
+        burn_factor=float(slo.get("burn_factor", 10.0)),
+        windows_s=windows, severity="error",
+    ))
     rules.append(AlertRule(
         name="host-bound", kind="threshold", series="host_gap_frac",
         target=float(slo.get("host_gap_frac", 0.3)),

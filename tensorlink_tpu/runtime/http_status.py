@@ -9,6 +9,8 @@ node can serve status: a dependency-free asyncio HTTP/1.1 responder with
     GET /metrics  -> node.metrics snapshot       (loss, throughput, ...)
                      ?format=prom -> Prometheus text exposition
     GET /jobs     -> validator job table         (when the node has one)
+    GET /ledger   -> receipt auditor snapshot    (per-tenant/per-worker
+                     metering rollups + anomaly counts, validator only)
     GET /spans    -> tracer span buffer as Chrome-trace JSON
                      (open in Perfetto / chrome://tracing)
     GET /events   -> flight-recorder ring buffer (runtime/flight.py)
@@ -190,6 +192,9 @@ class StatusServer:
                 return out
 
             routes["/fleet"] = fleet_route
+        auditor = getattr(node, "receipt_auditor", None)
+        if auditor is not None:
+            routes["/ledger"] = lambda q: auditor.snapshot()
         if hasattr(node, "jobs"):
             routes["/jobs"] = lambda q: {
                 jid: {

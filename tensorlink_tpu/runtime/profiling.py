@@ -285,13 +285,17 @@ class _Dispatch:
     """One in-flight program dispatch: host enqueue time + an output
     array probed for readiness (never a donated input)."""
 
-    __slots__ = ("program", "t_dispatch", "probe", "done")
+    __slots__ = ("program", "t_dispatch", "probe", "done", "busy_s")
 
     def __init__(self, program: str, t_dispatch: float, probe: Any):
         self.program = program
         self.t_dispatch = t_dispatch
         self.probe = probe
         self.done = False
+        # stamped at finalization: this dispatch's device-busy share.
+        # Callers that kept the handle (the serving engines) read it to
+        # apportion device time to the requests the chunk served.
+        self.busy_s = 0.0
 
 
 def _probe_ready(probe: Any) -> bool:
@@ -427,6 +431,7 @@ class DispatchTimer:
             else max(e.t_dispatch, self._frontier)
         )
         busy = max(t_ready - start, 0.0)
+        e.busy_s = busy
         gap = (
             max(e.t_dispatch - self._frontier, 0.0)
             if self._frontier is not None else 0.0

@@ -704,3 +704,53 @@ async def test_malformed_frame_fuzz_no_handler_crashes():
     finally:
         for n in (fuzzer, worker, validator):
             await n.stop()
+
+
+@pytest.mark.asyncio
+async def test_hostile_receipt_payloads_rejected_typed():
+    """Tampered, truncated, and type-mutated work receipts harvested
+    over the REAL wire path (validator pings the peer; receipts ride
+    the PONG) are rejected with typed reasons — never a handler crash,
+    never a ledger entry, and the link still answers afterwards."""
+    from tensorlink_tpu.roles.validator import ValidatorNode
+    from tensorlink_tpu.runtime.ledger import build_receipt
+
+    fuzzer = Node(_cfg("worker"))
+    validator = ValidatorNode(_cfg("validator"))
+    for n in (fuzzer, validator):
+        await n.start()
+    try:
+        good = build_receipt(
+            {"rid": 1, "tenant": "t", "kind": "serve",
+             "t_start": 1.0, "t_end": 2.0, "prompt_tokens": 4,
+             "emitted_tokens": 2, "busy_s": 0.1, "wire_bytes": 0},
+            fuzzer.identity,
+        )
+        tampered = dict(good, emitted_tokens=10**6)     # sig mismatch
+        truncated = {k: v for k, v in good.items() if k != "sig"}
+        mutated = dict(good, busy_s="NaN")              # wrong kind
+        batch = [tampered, truncated, mutated, 42, {"schema": 99}]
+        fuzzer.pending_receipts = lambda limit=64: list(batch)
+        peer = await validator.connect("127.0.0.1", fuzzer.port)
+        await validator.ping(peer)
+        aud = validator.receipt_auditor
+        assert aud.accepted_total == 0
+        assert aud.rejected_total == len(batch)
+        assert aud.anomaly_counts["bad_signature"] >= 1
+        assert aud.anomaly_counts["bad_schema"] >= 1
+        counters = validator.metrics.counters
+        assert counters.get("receipt_rejected_total", 0) == len(batch)
+        assert counters.get("receipt_accepted_total", 0) == 0
+        assert counters.get("dispatch_errors_total", 0) == 0
+        # the typed rejects left per-reason flight events behind
+        reasons = {
+            e.get("attrs", {}).get("reason")
+            for e in validator.flight.events()
+            if e.get("kind") == "receipt.anomaly"
+        }
+        assert {"bad_signature", "bad_schema"} <= reasons
+        # no wedge: the same connection still answers
+        assert await validator.ping(peer) >= 0
+    finally:
+        for n in (fuzzer, validator):
+            await n.stop()
