@@ -548,6 +548,47 @@ def serving_disagg_round() -> dict:
         f"unique, {NREQ} requests, {SLOTS} slots, block 16, {Nn} new "
         "tokens; wire = pack+CRC+unpack loopback"
     )
+
+    # -- int8 KV wire (ISSUE 20): the SAME export/loopback/import flow
+    # with kv_quant="int8" engines on both legs — the wire ships int8
+    # block stacks + f32 scale siblings natively (KV_WIRE_INT8_SCHEMA),
+    # never a dequantized intermediate, so bytes/token should drop
+    # toward 2x vs the float pools above (scale overhead = 4 bytes per
+    # D-vector; zstd squeezes both sides)
+    try:
+        def paged_q(eng):
+            return PagedContinuousBatchingEngine(
+                eng, slots=SLOTS, gen=gen, decode_chunk=16,
+                block_size=16, prefill_chunk=64, kv_quant="int8",
+            )
+
+        Aq, Bq = paged_q(engine()), paged_q(engine())
+        warmq = Aq.prefill_export(prompts[0])
+        Bq.result(
+            Bq.import_prefill(unpack_kv_payload(pack_kv_payload(warmq)))
+        )
+        qwire = 0
+        qrids = []
+        for p_ in prompts:
+            blob = pack_kv_payload(Aq.prefill_export(p_))
+            qwire += len(blob)
+            got = unpack_kv_payload(blob)
+            while True:
+                try:
+                    qrids.append(Bq.import_prefill(got))
+                    break
+                except OverloadedError:
+                    Bq.step()
+        Bq.run_until_idle()
+        qtok = sum(len(Bq.result(rid)) for rid in qrids)
+        out["kv_wire_bytes_per_token_int8"] = round(qwire / qtok, 1)
+        out["kv_wire_int8_config"] = (
+            "same workload, kv_quant=int8 both legs; blobs carry int8 "
+            "blocks + f32 per-(slot,head) scales under "
+            "KV_WIRE_INT8_SCHEMA"
+        )
+    except Exception as e:  # noqa: BLE001 — must not sink the round
+        out["kv_wire_int8_error"] = str(e)[:200]
     return out
 
 
@@ -1643,7 +1684,7 @@ def main() -> None:
                 ]
                 psch = PagedContinuousBatchingEngine(
                     cbeng, slots=SLOTS, gen=cbgen, decode_chunk=16,
-                    block_size=16, prefill_chunk=64,
+                    block_size=16, prefill_chunk=64, capability=cap,
                 )
                 # warm round: compile + seed the prefix index so the
                 # measured round's hit rate reflects steady state
@@ -1690,6 +1731,116 @@ def main() -> None:
                     f"{NREQ} requests over {SLOTS} slots, block_size 16, "
                     f"prefill_chunk 64, pool {pool.num_blocks} blocks"
                 )
+                # decode MBU on the paged XLA gather path — the
+                # "before kernel" side of the ISSUE 20 pair
+                pdt = psch.device_time() or {}
+                pprog = (pdt.get("programs") or {}).get("decode") or {}
+                if pprog.get("mbu") is not None:
+                    out["decode_mbu_paged_xla"] = pprog["mbu"]
+
+                # -- int8 KV blocks (ISSUE 20): the same traffic on
+                # quantized pools. The footprint ratio goes BYTE-aware
+                # here: int8 blocks + f32 scale siblings in use vs the
+                # contiguous cache (slots x max_len at the float
+                # engine's per-token width) the engine would otherwise
+                # pin — the ~2x HBM win int8 exists for.
+                try:
+                    psq = PagedContinuousBatchingEngine(
+                        cbeng, slots=SLOTS, gen=cbgen, decode_chunk=16,
+                        block_size=16, prefill_chunk=64,
+                        kv_quant="int8",
+                    )
+                    psq.result(psq.submit(pgprompts[0]))
+                    psq.peak_blocks_in_use = psq.pool.in_use
+                    t0 = time.perf_counter()
+                    qrids = [psq.submit(p_) for p_ in pgprompts]
+                    psq.run_until_idle()
+                    qdt = time.perf_counter() - t0
+                    qtok = sum(len(psq.result(rid)) for rid in qrids)
+                    out["serving_paged_int8_tokens_per_sec"] = round(
+                        qtok / qdt, 1
+                    )
+                    contig_bytes = (
+                        SLOTS * cbeng.cache_len
+                        * psch.kv_block_bytes / psch.block_size
+                    )
+                    out["kv_footprint_vs_contiguous_int8"] = round(
+                        psq.peak_blocks_in_use * psq.kv_block_bytes
+                        / contig_bytes, 4
+                    )
+                except Exception as e:  # noqa: BLE001
+                    out["serving_paged_int8_error"] = str(e)[:200]
+
+                # -- paged-decode kernel vs the XLA gather path (ISSUE
+                # 20 tentpole): the same engine geometry decoding a
+                # deliberately tiny workload twice — TL_PAGED_KERNEL=0
+                # vs the Pallas kernel. Off-TPU the kernel runs in
+                # interpret-mode EMULATION, so the ratio prices the
+                # emulator (< 1.0 expected) while still proving token
+                # parity end-to-end; on a TPU backend the same key
+                # reports the real fused-kernel speedup.
+                try:
+                    KP, KN, KREQ, KSLOTS = 16, 8, 4, 4
+                    kprompts = [
+                        rcb.integers(0, cbcfg.vocab_size, (KP,))
+                        for _ in range(KREQ)
+                    ]
+                    kgen = GenerationConfig(max_new_tokens=KN)
+
+                    def _kernel_run(mode):
+                        prev = os.environ.get("TL_PAGED_KERNEL")
+                        os.environ["TL_PAGED_KERNEL"] = mode
+                        try:
+                            ksch = PagedContinuousBatchingEngine(
+                                cbeng, slots=KSLOTS, gen=kgen,
+                                decode_chunk=4, block_size=16,
+                                prefill_chunk=32, capability=cap,
+                            )
+                            ksch.result(ksch.submit(kprompts[0]))
+                            t0 = time.perf_counter()
+                            rids = [
+                                ksch.submit(p_) for p_ in kprompts
+                            ]
+                            ksch.run_until_idle()
+                            dt = time.perf_counter() - t0
+                            toks = [
+                                np.asarray(ksch.result(r_))
+                                for r_ in rids
+                            ]
+                            tps = sum(len(t_) for t_ in toks) / dt
+                            return ksch, toks, tps
+                        finally:
+                            if prev is None:
+                                os.environ.pop("TL_PAGED_KERNEL", None)
+                            else:
+                                os.environ["TL_PAGED_KERNEL"] = prev
+
+                    kmode = (
+                        "1" if jax.default_backend() == "tpu"
+                        else "interpret"
+                    )
+                    _, xtoks, x_tps = _kernel_run("0")
+                    ksch, ktoks, k_tps = _kernel_run(kmode)
+                    out["paged_kernel_vs_xla_tokens_per_sec"] = round(
+                        k_tps / x_tps, 3
+                    )
+                    out["paged_kernel_token_parity"] = float(all(
+                        np.array_equal(a, b)
+                        for a, b in zip(xtoks, ktoks)
+                    ))
+                    kdt = ksch.device_time() or {}
+                    kprog = (
+                        (kdt.get("programs") or {}).get("decode") or {}
+                    )
+                    if kprog.get("mbu") is not None:
+                        out["decode_mbu_paged_kernel"] = kprog["mbu"]
+                    out["paged_kernel_config"] = (
+                        f"{KREQ} requests (P{KP} N{KN}) over "
+                        f"{KSLOTS} slots, block 16, "
+                        f"TL_PAGED_KERNEL={kmode} vs 0"
+                    )
+                except Exception as e:  # noqa: BLE001
+                    out["paged_kernel_error"] = str(e)[:200]
             except Exception as e:  # noqa: BLE001
                 out["serving_paged_error"] = str(e)[:200]
 

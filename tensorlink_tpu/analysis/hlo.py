@@ -762,6 +762,47 @@ def canonical_programs(
             it["group"] = "paged"
             if it["name"] not in {o["name"] for o in out}:
                 out.append(it)
+        # int8 KV pools (ISSUE 20): same engine geometry, quantized
+        # block form — still ONE decode + ONE prefill-chunk program
+        # (TLH105), with the TLH106 temp/argument budgets pinned LOWER
+        # (int8 blocks + f32 scales vs bf16) and the write-time
+        # quantize / read-time dequantize converts under TLH103
+        pint8 = PagedContinuousBatchingEngine(
+            eng, block_size=8, prefill_chunk=16, kv_quant="int8", **kw
+        )
+        for it in pint8.audit_programs():
+            it["name"] = f"paged_int8.{it['name']}"
+            it["group"] = "paged_int8"
+            out.append(it)
+        # kernel-bearing decode (ISSUE 20 tentpole): the same decode
+        # chunk traced WITH the Pallas paged-decode kernel engaged.
+        # interpret mode lowers the kernel to plain HLO on any backend,
+        # so the canonical audit pins the kernel-bearing program's
+        # donation/budget/dtype discipline even on the CPU manifest.
+        # TL_PAGED_KERNEL is read at TRACE time, so the env toggle must
+        # wrap the lazy ``lower()`` thunk, not this enumeration
+        pkern = PagedContinuousBatchingEngine(
+            eng, block_size=8, prefill_chunk=16, kv_quant="int8", **kw
+        )
+        for it in pkern.audit_programs():
+            if it["name"] != "decode":
+                continue
+
+            def _lower_with_kernel(_base=it["lower"]):
+                prev = os.environ.get("TL_PAGED_KERNEL")
+                os.environ["TL_PAGED_KERNEL"] = "interpret"
+                try:
+                    return _base()
+                finally:
+                    if prev is None:
+                        os.environ.pop("TL_PAGED_KERNEL", None)
+                    else:
+                        os.environ["TL_PAGED_KERNEL"] = prev
+
+            it["lower"] = _lower_with_kernel
+            it["name"] = f"paged_kernel.{it['name']}"
+            it["group"] = "paged_kernel"
+            out.append(it)
         return out
 
     # serving engines carry their own group prefixes (two groups from

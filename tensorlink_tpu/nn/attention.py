@@ -790,6 +790,18 @@ class MultiHeadAttention(Module):
         view-width and further restricts (validity); unmapped/garbage
         view slots are harmless because they are never inside
         ``kpos <= index``-coverage of a mapped row.
+
+        int8 pools (``init_paged_cache(quant="int8")`` — detected by
+        the ``k_scale`` sibling): fresh k/v quantize at WRITE time
+        (``ops/quant.py quantize_kv_int8``, one scale per (token slot,
+        kv head)) and dequantize only at READ — inside the Pallas
+        kernel per page, or over the gathered view on the XLA path —
+        so bf16/f32 KV never materializes at cache width.
+
+        The read side dispatches to the block-table-native Pallas
+        kernel (``ops/pallas/paged_decode.py``) when it can engage
+        (TPU or ``TL_PAGED_KERNEL=interpret``; ``TL_PAGED_KERNEL=0``
+        pins the pure-XLA gather path bit-for-bit).
         """
         B, T = q.shape[0], q.shape[1]
         bt = cache["block_table"]
@@ -809,25 +821,69 @@ class MultiHeadAttention(Module):
         blk = jnp.take_along_axis(bt, jnp.minimum(bslot, MB - 1), axis=1)
         blk = jnp.where(bslot >= MB, NB, blk)
         off = tpos % bs
-        ck = cache["k"].at[blk, off].set(
-            k.astype(cache["k"].dtype), mode="drop"
+        quant = "k_scale" in cache
+        cks = cvs = None
+        if quant:
+            from tensorlink_tpu.ops.quant import quantize_kv_int8
+
+            qk, sk = quantize_kv_int8(k)
+            qv, sv = quantize_kv_int8(v)
+            ck = cache["k"].at[blk, off].set(qk, mode="drop")
+            cv = cache["v"].at[blk, off].set(qv, mode="drop")
+            cks = cache["k_scale"].at[blk, off].set(sk, mode="drop")
+            cvs = cache["v_scale"].at[blk, off].set(sv, mode="drop")
+            new_cache = {
+                "k": ck, "v": cv, "k_scale": cks, "v_scale": cvs,
+                "index": idx + T, "block_table": bt,
+            }
+        else:
+            ck = cache["k"].at[blk, off].set(
+                k.astype(cache["k"].dtype), mode="drop"
+            )
+            cv = cache["v"].at[blk, off].set(
+                v.astype(cache["v"].dtype), mode="drop"
+            )
+            new_cache = {
+                "k": ck, "v": cv, "index": idx + T, "block_table": bt,
+            }
+        if mask is not None and mask.shape[-1] != Lv:
+            raise ValueError(
+                f"paged cache attention needs a view-width mask "
+                f"(last dim {Lv}), got {mask.shape}"
+            )
+        win = getattr(self, "window", None)
+        from tensorlink_tpu.ops.pallas.paged_decode import (
+            paged_decode_attention, paged_decode_ok,
         )
-        cv = cache["v"].at[blk, off].set(
-            v.astype(cache["v"].dtype), mode="drop"
-        )
-        new_cache = {
-            "k": ck, "v": cv, "index": idx + T, "block_table": bt,
-        }
+
+        if (
+            getattr(self, "scale", None) is None
+            and paged_decode_ok(q, ck, mask=mask)
+        ):
+            # block-table-native kernel: the table lookup runs in the
+            # BlockSpec index maps, no logical view ever materializes
+            # (and int8 pages dequantize in VMEM)
+            out = paged_decode_attention(
+                q, ck, cv, bt, idx + T,
+                k_scale=cks, v_scale=cvs, mask=mask, window=win,
+            )
+            out = out.reshape(B, T, self.num_heads * self.head_dim)
+            out = self.children["o"].apply(params["o"], out)
+            return out, new_cache
         # gather the logical view: [B, MB, bs, Hkv, D] -> [B, Lv, ...].
         # Sentinel table entries clamp into the last pool block — pure
         # garbage, but the positional keep below never reaches them
         # (a mapped row's attendable range is covered by real blocks).
         kk = ck[bt].reshape(B, Lv, *ck.shape[2:])
         vv = cv[bt].reshape(B, Lv, *cv.shape[2:])
+        if quant:
+            from tensorlink_tpu.ops.quant import dequantize_kv
+
+            kk = dequantize_kv(kk, cks[bt].reshape(B, Lv, -1), q.dtype)
+            vv = dequantize_kv(vv, cvs[bt].reshape(B, Lv, -1), q.dtype)
         kpos = jnp.arange(Lv)[None, None, None, :]
         qpos = tpos[:, None, :, None]  # [B, 1, T, 1]
         keep = kpos <= qpos  # causal in logical coordinates
-        win = getattr(self, "window", None)
         win_start = None
         if win is not None:
             # block-skip bound from the EARLIEST query (T > 1 verify:
@@ -836,11 +892,6 @@ class MultiHeadAttention(Module):
             win_start = jnp.maximum(tpos[:, 0] + 1 - win, 0)  # [B]
             keep = jnp.logical_and(keep, kpos > qpos - win)
         if mask is not None:
-            if mask.shape[-1] != Lv:
-                raise ValueError(
-                    f"paged cache attention needs a view-width mask "
-                    f"(last dim {Lv}), got {mask.shape}"
-                )
             keep = jnp.logical_and(keep, mask)
         blocks_min = (
             DECODE_BLOCK if win is not None
@@ -910,19 +961,36 @@ class MultiHeadAttention(Module):
     def init_paged_cache(
         self, num_blocks: int, block_size: int, batch: int,
         max_blocks: int, dtype=jnp.bfloat16,
+        quant: str | None = None,
     ):
         """Paged cache form (see ``_apply_paged``): per-layer k/v POOLS
         of ``num_blocks`` fixed-size blocks shared by all ``batch``
         rows, a per-row logical write index, and a per-row block table
         initialized to the ``num_blocks`` sentinel (unmapped — writes
         drop). HBM scales with blocks actually mapped by the host-side
-        ``BlockPool``, not ``batch x max_len``."""
+        ``BlockPool``, not ``batch x max_len``.
+
+        ``quant="int8"``: the pools hold int8 with per-(token slot,
+        kv head) f32 scales as sibling arrays (``k_scale``/``v_scale``,
+        shape ``[num_blocks, block_size, Hkv]``) — ~2x the bf16 pool
+        bytes saved at head dims >= 32. ``dtype`` is then ignored for
+        k/v. Scales init to 1.0 so unwritten blocks dequantize to exact
+        zeros."""
+        if quant not in (None, "int8"):
+            raise ValueError(f"unknown paged cache quant {quant!r}")
         shape = (num_blocks, block_size, self.num_kv_heads, self.head_dim)
-        return {
-            "k": jnp.zeros(shape, dtype),
-            "v": jnp.zeros(shape, dtype),
+        cache = {
             "index": jnp.zeros((batch,), jnp.int32),
             "block_table": jnp.full(
                 (batch, max_blocks), num_blocks, jnp.int32
             ),
         }
+        if quant == "int8":
+            cache["k"] = jnp.zeros(shape, jnp.int8)
+            cache["v"] = jnp.zeros(shape, jnp.int8)
+            cache["k_scale"] = jnp.ones(shape[:-1], jnp.float32)
+            cache["v_scale"] = jnp.ones(shape[:-1], jnp.float32)
+        else:
+            cache["k"] = jnp.zeros(shape, dtype)
+            cache["v"] = jnp.zeros(shape, dtype)
+        return cache

@@ -9,10 +9,13 @@ quality loss is the per-channel rounding error only (symmetric absmax,
 ~0.4% relative on typical layers).
 
 Scope: 2-D ``{"w": ...}`` leaves of Dense-shaped subtrees (matmul
-weights — where the bytes are). Embeddings, norms, biases, and KV caches
-stay in their original dtypes. Training is unaffected: quantize at
-serving time (InferenceEngine ``quantize="int8"``), never in the
-optimizer loop.
+weights — where the bytes are), plus the paged KV-block form
+(``quantize_kv_int8``/``dequantize_kv`` — per-token-slot, per-kv-head
+scales riding the block pools as sibling arrays, see
+``nn/attention.py init_paged_cache(quant="int8")``). Embeddings, norms,
+biases, and contiguous KV caches stay in their original dtypes.
+Training is unaffected: quantize at serving time (InferenceEngine
+``quantize="int8"``), never in the optimizer loop.
 """
 
 from __future__ import annotations
@@ -36,6 +39,30 @@ def quantize_weight_int8(w) -> dict:
 
 def dequantize_weight(qw: dict, dtype=jnp.float32):
     return (qw["q"].astype(dtype) * qw["s"].astype(dtype))
+
+
+def quantize_kv_int8(x):
+    """[..., D] float -> (int8 [..., D], f32 scale [...]) with a
+    symmetric per-vector absmax scale — one scale per (token slot,
+    kv head), computable at cache-WRITE time from the fresh k/v alone
+    (no pool read-modify), which is what lets the paged decode/prefill
+    programs quantize in place. Same scale convention as
+    ``quantize_weight_int8``; a zero vector takes scale 1.0 so it
+    round-trips to exact zeros."""
+    xf = jnp.asarray(x).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    s = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def dequantize_kv(q, s, dtype=jnp.float32):
+    """Inverse of ``quantize_kv_int8``: int8 [..., D] + scale [...] ->
+    ``dtype`` [..., D] (f32 multiply, then one cast — the form both the
+    XLA paged fallback and the Pallas kernel share)."""
+    return (
+        q.astype(jnp.float32) * s[..., None].astype(jnp.float32)
+    ).astype(dtype)
 
 
 def quantize_params_int8(module, params):

@@ -12,7 +12,11 @@ the wire format between the two legs:
   granularity, never a contiguous ``[T]``-width cache (the
   bandwidth-optimal discipline of arXiv 2112.01075: ship exactly the
   logical blocks, reassemble through indirection, no materialized
-  intermediate on either side);
+  intermediate on either side); int8-quantized pools
+  (``kv_quant="int8"``) ship their int8 block stacks plus the
+  per-(slot, kv-head) f32 scale siblings natively under
+  ``KV_WIRE_INT8_SCHEMA`` — roughly half the bf16 wire bytes, and a
+  pre-int8 peer rejects the blob on the schema check;
 - bytes ride the native CRC-framed gather (``p2p/serialization.py
   pack_arrays`` over ``native/wirecodec.cpp``): one memory pass
   concatenates + checksums, and the receiver rejects a corrupt blob
@@ -38,6 +42,12 @@ from tensorlink_tpu.p2p.serialization import pack_arrays, unpack_arrays
 # bump when the payload schema changes: an old decode worker must
 # reject a new prefill worker's blob with a typed error, not misread it
 KV_WIRE_SCHEMA = 1
+# int8-quantized payloads (kv_quant="int8": per-layer scale stacks ride
+# beside the block stacks) stamp THIS version instead: a float payload
+# stays byte-identical to schema 1 — old peers interop untouched —
+# while a quantized blob reaching a pre-int8 build fails the schema
+# check instead of grafting int8 bytes as if they were bf16
+KV_WIRE_INT8_SCHEMA = 2
 
 _SCALARS = (
     "schema", "n_valid", "tok0", "seed", "remaining", "block_size",
@@ -48,12 +58,16 @@ def flatten_kv_payload(payload: dict) -> dict[str, np.ndarray]:
     """Payload dict -> flat ``{name: array}`` for the CRC-framed gather.
     Every field — per-layer block stacks, prompt ids, scalars — becomes
     an array so ONE checksum covers the whole payload."""
+    quant = payload.get("kv_quant")
+    if quant not in (None, "int8"):
+        raise ValueError(f"unknown payload kv_quant {quant!r}")
+    schema = KV_WIRE_INT8_SCHEMA if quant == "int8" else KV_WIRE_SCHEMA
     flat: dict[str, np.ndarray] = {
         "prompt_ids": np.asarray(payload["prompt_ids"], np.int32),
     }
     for name in _SCALARS:
         if name == "schema":
-            flat[name] = np.asarray(KV_WIRE_SCHEMA, np.int64)
+            flat[name] = np.asarray(schema, np.int64)
         else:
             flat[name] = np.asarray(int(payload[name]), np.int64)
     digest = payload.get("prefix_digest")
@@ -62,6 +76,12 @@ def flatten_kv_payload(payload: dict) -> dict[str, np.ndarray]:
     for i, layer in enumerate(payload["layers"]):
         flat[f"L{i}.k"] = np.asarray(layer["k"])
         flat[f"L{i}.v"] = np.asarray(layer["v"])
+        if quant == "int8":
+            # the wire pays int8 block bytes + f32 scale siblings —
+            # never a dequantized intermediate (the whole point of
+            # shipping the quantized form natively)
+            flat[f"L{i}.ks"] = np.asarray(layer["k_scale"], np.float32)
+            flat[f"L{i}.vs"] = np.asarray(layer["v_scale"], np.float32)
     return flat
 
 
@@ -71,23 +91,36 @@ def _scalar(v) -> int:
 
 def unflatten_kv_payload(flat: dict[str, np.ndarray]) -> dict:
     schema = _scalar(flat["schema"]) if "schema" in flat else -1
-    if schema != KV_WIRE_SCHEMA:
+    if schema not in (KV_WIRE_SCHEMA, KV_WIRE_INT8_SCHEMA):
         raise ValueError(
-            f"kv wire schema {schema} != {KV_WIRE_SCHEMA} (peer runs an "
+            f"kv wire schema {schema} not in "
+            f"({KV_WIRE_SCHEMA}, {KV_WIRE_INT8_SCHEMA}) (peer runs an "
             "incompatible build)"
         )
+    quant = schema == KV_WIRE_INT8_SCHEMA
     layers = []
     for i in range(len(flat)):
         k = flat.get(f"L{i}.k")
         if k is None:
             break
-        layers.append({"k": k, "v": flat[f"L{i}.v"]})
+        layer = {"k": k, "v": flat[f"L{i}.v"]}
+        if quant:
+            try:
+                layer["k_scale"] = flat[f"L{i}.ks"]
+                layer["v_scale"] = flat[f"L{i}.vs"]
+            except KeyError as e:
+                raise ValueError(
+                    f"int8 kv wire payload layer {i} missing scales"
+                ) from e
+        layers.append(layer)
     if not layers:
         raise ValueError("kv wire payload carries no layer blocks")
     out = {
         "prompt_ids": np.asarray(flat["prompt_ids"], np.int32),
         "layers": layers,
     }
+    if quant:
+        out["kv_quant"] = "int8"
     for name in _SCALARS[1:]:
         out[name] = _scalar(flat[name])
     if "prefix_digest" in flat:

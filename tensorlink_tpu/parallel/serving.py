@@ -91,6 +91,7 @@ from tensorlink_tpu.runtime import chaos
 from tensorlink_tpu.runtime.autotune import (
     AutotuneStore,
     apply_flash_overrides,
+    apply_paged_overrides,
     model_fingerprint,
     store_key,
 )
@@ -601,11 +602,13 @@ class ContinuousBatchingEngine:
         if rec is None:
             return
         applied = apply_flash_overrides(rec)
+        paged_applied = apply_paged_overrides(rec)
         self._autotune_record = rec
         self.autotune_warm_start_s = round(time.perf_counter() - t0, 4)
         self._event(
             "autotune.warm_start", key=self._autotune_key,
             flash_overrides=applied,
+            paged_overrides=paged_applied,
             has_k_prior=bool(rec.get("k_prior")),
             warm_start_s=self.autotune_warm_start_s,
         )
@@ -626,10 +629,14 @@ class ContinuousBatchingEngine:
         import json
 
         from tensorlink_tpu.ops.flash import flash_block_overrides
+        from tensorlink_tpu.ops.pallas.paged_decode import (
+            paged_block_overrides,
+        )
 
         with self._lock:  # a self-heal may be swapping the controller
             rec = {
                 "flash_blocks": [list(t) for t in flash_block_overrides()],
+                "paged_kernel": [list(t) for t in paged_block_overrides()],
                 "prefill_buckets": list(self._autotune_buckets()),
             }
             if self._kctl is not None:
@@ -2335,6 +2342,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         num_blocks: int | None = None,
         prefill_chunk: int = 32,
         prefix_cache: bool = True,
+        kv_quant: str | None = None,
         **kw,
     ):
         if block_size < 1:
@@ -2343,9 +2351,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}"
             )
+        if kv_quant not in (None, "int8"):
+            raise ValueError(
+                f"unknown kv_quant {kv_quant!r} (None or 'int8')"
+            )
         self.block_size = int(block_size)
         self.prefill_chunk = int(prefill_chunk)
         self.prefix_cache = bool(prefix_cache)
+        self.kv_quant = kv_quant
         self._num_blocks_arg = num_blocks
         super().__init__(engine, **kw)
 
@@ -2377,7 +2390,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             attns = [blk.children["attn"] for blk in stack.blocks()]
             caches = [
                 {"attn": a.init_paged_cache(
-                    self.pool.num_blocks, bs, S, MB, dtype=eng.cache_dtype
+                    self.pool.num_blocks, bs, S, MB,
+                    dtype=eng.cache_dtype, quant=self.kv_quant,
                 )}
                 for a in attns
             ]
@@ -2396,6 +2410,22 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         self.prompt_tokens_total = 0
         self.prefilled_tokens = 0
         self.peak_blocks_in_use = 0
+        # the pool siblings beyond k/v (int8 scales) ride every block
+        # operation — prefill chunk, copy, graft, export — by key, so
+        # the programs built below stay form-agnostic (set BEFORE the
+        # builds: the op closures bind it)
+        self._pool_keys = tuple(
+            name for name in caches[0]["attn"]
+            if name not in ("index", "block_table")
+        )
+        # bytes ONE pool block occupies across all layers (k + v + any
+        # scale siblings) — the unit the footprint/wire bench keys and
+        # the serve_llm savings printout multiply by
+        self.kv_block_bytes = len(caches) * int(sum(
+            int(np.prod(a.shape[1:])) * a.dtype.itemsize
+            for name, a in caches[0]["attn"].items()
+            if name in self._pool_keys
+        ))
         self._prefill_chunk_fn = self._build_prefill_chunk()
         self._table_op = self._build_table_op()
         self._retire_op = self._build_retire_op()
@@ -2457,10 +2487,11 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         def chunk(params, dparams, state, ids, slot, start, nreal, seed,
                   max_new, is_final):
             caches = state["caches"]
+            # pool arrays (k/v and any int8 scale siblings) pass through
+            # by key; only index/block_table take the 1-row slot view
             tmp = [
                 {"attn": {
-                    "k": lc["attn"]["k"],
-                    "v": lc["attn"]["v"],
+                    **{name: lc["attn"][name] for name in self._pool_keys},
                     "index": jnp.full((1,), start, jnp.int32),
                     "block_table": jax.lax.dynamic_slice_in_dim(
                         lc["attn"]["block_table"], slot, 1, axis=0
@@ -2476,8 +2507,7 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             )
             new_caches = [
                 {"attn": {
-                    "k": nt["attn"]["k"],
-                    "v": nt["attn"]["v"],
+                    **{name: nt["attn"][name] for name in self._pool_keys},
                     "index": lc["attn"]["index"].at[slot].set(start + nreal),
                     "block_table": lc["attn"]["block_table"],
                 }}
@@ -2615,16 +2645,20 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def _build_copy_op(self):
         """Copy-on-write: duplicate block ``src`` into ``dst`` across
-        every layer's k/v pools (the sharer keeps ``src`` byte-for-byte;
-        the writer extends ``dst``)."""
+        every layer's pool arrays — k/v AND any int8 scale siblings
+        (the sharer keeps ``src`` byte-for-byte; the writer extends
+        ``dst``)."""
+        keys = self._pool_keys
 
         def run(state, src, dst):
             return self._map_caches(
                 state,
                 lambda c: {
                     **c,
-                    "k": c["k"].at[dst].set(c["k"][src]),
-                    "v": c["v"].at[dst].set(c["v"][src]),
+                    **{
+                        name: c[name].at[dst].set(c[name][src])
+                        for name in keys
+                    },
                 },
             )
 
@@ -2646,20 +2680,21 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
 
     def _build_graft_op(self):
         """Scatter up to ``_GRAFT_WIDTH`` received blocks into every
-        layer's k/v pools at once: ``bids`` rows past the pool width
-        (the padding sentinel) DROP, so one shape-static program
-        serves any block count."""
+        layer's pool arrays at once — k/v and any int8 scale siblings:
+        ``bids`` rows past the pool width (the padding sentinel) DROP,
+        so one shape-static program serves any block count."""
+        keys = self._pool_keys
 
         def run(state, blocks, bids):
             def upd(c, bl):
                 return {
                     **c,
-                    "k": c["k"].at[bids].set(
-                        bl["k"].astype(c["k"].dtype), mode="drop"
-                    ),
-                    "v": c["v"].at[bids].set(
-                        bl["v"].astype(c["v"].dtype), mode="drop"
-                    ),
+                    **{
+                        name: c[name].at[bids].set(
+                            bl[name].astype(c[name].dtype), mode="drop"
+                        )
+                        for name in keys
+                    },
                 }
 
             return {
@@ -2791,6 +2826,42 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 not progressed and req.failed is None and not req.done
             )
 
+    def _coerce_kv_form(self, layers: list, src_quant: str | None) -> list:
+        """Convert imported KV layers from the payload's pool form into
+        THIS engine's form, host-side in numpy. Matching forms pass
+        through untouched (int8 blocks + scales graft natively — the
+        wire and the staging both pay quantized bytes). int8 -> float
+        engines dequantize to f32 (the graft op casts to the pool dtype
+        on device); float -> int8 engines quantize with the exact
+        ``ops.quant.quantize_kv_int8`` math so a re-export is
+        bit-identical to a locally-written pool."""
+        if src_quant == self.kv_quant:
+            return layers
+        out = []
+        if src_quant == "int8":  # -> float pools
+            for bl in layers:
+                ent = {}
+                for kv in ("k", "v"):
+                    q = np.asarray(bl[kv], np.float32)
+                    s = np.asarray(bl[kv + "_scale"], np.float32)
+                    ent[kv] = q * s[..., None]
+                out.append(ent)
+            return out
+        for bl in layers:  # float -> int8 pools
+            ent = {}
+            for kv in ("k", "v"):
+                xf = np.asarray(bl[kv]).astype(np.float32)
+                absmax = np.max(np.abs(xf), axis=-1)
+                s = np.where(absmax > 0, absmax / 127.0, 1.0).astype(
+                    np.float32
+                )
+                ent[kv] = np.clip(
+                    np.rint(xf / s[..., None]), -127, 127
+                ).astype(np.int8)
+                ent[kv + "_scale"] = s
+            out.append(ent)
+        return out
+
     def _export_slot_locked(self, req: _Request, slot: int) -> dict:
         bs = self.block_size
         prompt_ids = np.asarray(req.ids, np.int32).reshape(-1)
@@ -2815,8 +2886,8 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
         idx = jnp.asarray(np.asarray(bids, np.int32))
         layers = [
             {
-                "k": np.asarray(lc["attn"]["k"][idx]),
-                "v": np.asarray(lc["attn"]["v"][idx]),
+                name: np.asarray(lc["attn"][name][idx])
+                for name in self._pool_keys
             }
             for lc in self._state["caches"]
         ]
@@ -2829,6 +2900,10 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             "remaining": int(req.max_new) - 1,
             "block_size": bs,
         }
+        if self.kv_quant is not None:
+            # int8 blocks + scales ship NATIVELY: the wire pays the
+            # quantized bytes, never a dequantized intermediate
+            payload["kv_quant"] = self.kv_quant
         if self.index is not None:
             payload["prefix_digest"] = self.index.chain_digest(prompt_ids)
         self.disagg["exports"] += 1
@@ -2901,15 +2976,33 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
                 f"payload has {len(layers)} layers, engine has "
                 f"{self._n_layers}"
             )
-        want = (nblk, *self._block_shape)
+        src_quant = payload.get("kv_quant")
+        if src_quant is None and "k_scale" in layers[0]:
+            src_quant = "int8"  # older producer shipping scales inline
+        if src_quant not in (None, "int8"):
+            raise ValueError(f"unknown payload kv_quant {src_quant!r}")
+        src_keys = (
+            ("k", "v", "k_scale", "v_scale") if src_quant == "int8"
+            else ("k", "v")
+        )
         for i, bl in enumerate(layers):
-            for kv in ("k", "v"):
-                shape = tuple(np.asarray(bl[kv]).shape)
+            for name in src_keys:
+                if name not in bl:
+                    raise ValueError(
+                        f"layer {i} missing {name} blocks for "
+                        f"kv_quant={src_quant!r}"
+                    )
+                want = (
+                    (nblk, *self._block_shape) if name in ("k", "v")
+                    else (nblk, *self._block_shape[:-1])
+                )
+                shape = tuple(np.asarray(bl[name]).shape)
                 if shape != want:
                     raise ValueError(
-                        f"layer {i} {kv} blocks have shape {shape}, "
+                        f"layer {i} {name} blocks have shape {shape}, "
                         f"expected {want}"
                     )
+        layers = self._coerce_kv_form(layers, src_quant)
         # pre-stage the graft groups (pad the tail group to the fixed
         # _GRAFT_WIDTH); only the tiny bid arrays depend on allocation
         W = self._GRAFT_WIDTH
@@ -2918,14 +3011,14 @@ class PagedContinuousBatchingEngine(ContinuousBatchingEngine):
             stacked = []
             for bl in layers:
                 ent = {}
-                for kv in ("k", "v"):
-                    arr = np.asarray(bl[kv])[off:off + W]
+                for name in self._pool_keys:
+                    arr = np.asarray(bl[name])[off:off + W]
                     if arr.shape[0] < W:
                         pad = np.zeros(
                             (W - arr.shape[0], *arr.shape[1:]), arr.dtype
                         )
                         arr = np.concatenate([arr, pad], axis=0)
-                    ent[kv] = jnp.asarray(arr)
+                    ent[name] = jnp.asarray(arr)
                 stacked.append(ent)
             groups.append(stacked)
         ids_row = np.zeros((self.L,), np.int32)

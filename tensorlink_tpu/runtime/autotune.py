@@ -42,6 +42,7 @@ from tensorlink_tpu.runtime.flight import default_recorder
 __all__ = [
     "AutotuneStore",
     "apply_flash_overrides",
+    "apply_paged_overrides",
     "model_fingerprint",
     "store_key",
 ]
@@ -98,6 +99,30 @@ def apply_flash_overrides(record: dict) -> int:
             set_flash_block_override(
                 int(seq), int(block),
                 batch=None if batch is None else int(batch),
+            )
+            applied += 1
+        except (TypeError, ValueError):
+            continue
+    return applied
+
+
+def apply_paged_overrides(record: dict) -> int:
+    """Install a record's persisted paged-decode kernel tuning
+    (``[[max_blocks, block_size|null, pages], ...]`` — the
+    pages-per-superstep choice per table geometry, see
+    ``ops/pallas/paged_decode.py``); returns how many applied. Same
+    skip-not-crash discipline as ``apply_flash_overrides``."""
+    from tensorlink_tpu.ops.pallas.paged_decode import (
+        set_paged_block_override,
+    )
+
+    applied = 0
+    for entry in record.get("paged_kernel") or []:
+        try:
+            max_blocks, block_size, pages = entry
+            set_paged_block_override(
+                int(max_blocks), int(pages),
+                block_size=None if block_size is None else int(block_size),
             )
             applied += 1
         except (TypeError, ValueError):

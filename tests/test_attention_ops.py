@@ -1233,3 +1233,205 @@ def test_flash_override_kernel_parity():
     finally:
         clear_flash_block_overrides()
     np.testing.assert_allclose(out, ref, atol=2e-5)
+
+
+# ------------------------------------------- paged-decode kernel (ISSUE 20)
+
+
+def _paged_case(
+    *, B=2, T=1, H=4, Hkv=4, D=16, bs=4, MB=4, lives=None, quant=False,
+    seed=0,
+):
+    """Random paged-pool case: distinct physical pages per live block,
+    sentinel (NB) table entries past the write frontier, garbage in
+    unmapped pool slots — the layout the serving engine produces."""
+    from tensorlink_tpu.ops.quant import quantize_kv_int8
+
+    r = np.random.default_rng(seed)
+    lives = list(lives) if lives is not None else [bs * MB] * B
+    NB = B * MB + 3  # spare pages so garbage slots exist
+    q = jnp.asarray(r.standard_normal((B, T, H, D)), jnp.float32)
+    k = jnp.asarray(r.standard_normal((NB, bs, Hkv, D)), jnp.float32)
+    v = jnp.asarray(r.standard_normal((NB, bs, Hkv, D)), jnp.float32)
+    perm = r.permutation(NB)
+    bt = np.full((B, MB), NB, np.int32)  # sentinel everywhere first
+    nxt = 0
+    for b, live in enumerate(lives):
+        for j in range(-(-live // bs)):
+            bt[b, j] = perm[nxt]
+            nxt += 1
+    lengths = jnp.asarray(lives, jnp.int32)
+    scales = {}
+    if quant:
+        k, ks = quantize_kv_int8(k)
+        v, vs = quantize_kv_int8(v)
+        scales = {"k_scale": ks, "v_scale": vs}
+    return q, k, v, jnp.asarray(bt), lengths, scales
+
+
+def _paged_pair(case, **kw):
+    from tensorlink_tpu.ops.pallas.paged_decode import (
+        paged_decode_attention,
+        paged_decode_reference,
+    )
+
+    q, k, v, bt, lengths, scales = case
+    ref_kw = {k_: v_ for k_, v_ in kw.items() if k_ != "pages_per_step"}
+    ref = paged_decode_reference(q, k, v, bt, lengths, **scales, **ref_kw)
+    out = paged_decode_attention(
+        q, k, v, bt, lengths, **scales, interpret=True, **kw
+    )
+    return np.asarray(ref), np.asarray(out)
+
+
+@pytest.mark.parametrize("live", [1, 3, 4, 5, 8, 16])
+def test_paged_kernel_parity_block_boundaries(live):
+    """Kernel == jnp reference at every live-length alignment: mid-
+    block, exact block boundary, single token, full view (bs=4, 4
+    pages). Rows past the frontier hold sentinel table entries."""
+    ref, out = _paged_pair(_paged_case(lives=[live, max(1, live - 1)]))
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_paged_kernel_parity_gqa_and_garbage_pool():
+    """GQA (H=4 over Hkv=2) reads the unrepeated pools via the
+    h//group index map; NaN garbage in unmapped pool slots must never
+    leak — the clamped index maps only ever DMA LIVE pages, so the
+    kernel on a NaN-poisoned pool must equal the reference on the
+    clean one (the jnp reference itself would 0*NaN-poison, which is
+    fine: production pools hold finite stale data, never NaN)."""
+    from tensorlink_tpu.ops.pallas.paged_decode import (
+        paged_decode_attention,
+        paged_decode_reference,
+    )
+
+    q, k, v, bt, lengths, _ = _paged_case(H=4, Hkv=2, lives=[5, 9], seed=3)
+    ref = np.asarray(paged_decode_reference(q, k, v, bt, lengths))
+    mapped = np.unique(np.asarray(bt)[np.asarray(bt) < k.shape[0]])
+    poison_k, poison_v = np.array(k), np.array(v)
+    for slot in range(k.shape[0]):
+        if slot not in mapped:
+            poison_k[slot] = np.nan
+            poison_v[slot] = np.nan
+    out = np.asarray(paged_decode_attention(
+        q, jnp.asarray(poison_k), jnp.asarray(poison_v), bt, lengths,
+        interpret=True,
+    ))
+    assert np.all(np.isfinite(out))
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("window", [1, 3, 8])
+def test_paged_kernel_parity_window(window):
+    """Sliding-window masking in logical coordinates, including a
+    window small enough that whole leading pages fall out of the band
+    (their index maps clamp to the band start — no re-DMA, no math)."""
+    ref, out = _paged_pair(
+        _paged_case(lives=[16, 7], seed=1), window=window
+    )
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("T", [2, 3, 5])
+def test_paged_kernel_parity_verify_widths(T):
+    """T > 1 (speculative verify-K chunks): query t sits at logical
+    position lengths - T + t, so each chunk row sees a different
+    causal frontier inside the same page."""
+    ref, out = _paged_pair(
+        _paged_case(T=T, lives=[16, max(T, 6)], seed=2)
+    )
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_paged_kernel_parity_int8_pools():
+    """int8 pools + per-(slot, head) scales: the kernel dequantizes in
+    VMEM, the reference in the gathered view — identical math, so the
+    parity bound stays the float one."""
+    ref, out = _paged_pair(
+        _paged_case(lives=[11, 4], quant=True, seed=4)
+    )
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+    ref, out = _paged_pair(
+        _paged_case(T=3, H=4, Hkv=2, lives=[16, 9], quant=True, seed=5),
+        window=5,
+    )
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_paged_kernel_parity_explicit_mask_and_masked_rows():
+    """A view-width boolean mask composes with the causal/positional
+    keep; a row whose mask kills EVERY position must return zeros via
+    the l==0 guard, not NaN."""
+    case = _paged_case(lives=[9, 6], seed=6)
+    q, k, v, bt, lengths, scales = case
+    B, T = q.shape[0], q.shape[1]
+    Lv = bt.shape[1] * k.shape[1]
+    r = np.random.default_rng(7)
+    mask = r.integers(0, 2, (B, 1, T, Lv)).astype(bool)
+    mask[1] = False  # fully masked row
+    ref, out = _paged_pair(case, mask=jnp.asarray(mask))
+    assert np.all(np.isfinite(out))
+    np.testing.assert_array_equal(out[1], np.zeros_like(out[1]))
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("pages", [1, 2, 4])
+def test_paged_kernel_pages_per_step_changes_grid_not_math(pages):
+    """G (pages per superstep — the autotuned knob) re-shapes the
+    scratch stripe and grid only."""
+    case = _paged_case(lives=[13, 16], seed=8)
+    ref, out = _paged_pair(case, pages_per_step=pages)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_paged_kernel_kill_switch_and_gate(monkeypatch):
+    """TL_PAGED_KERNEL=0 gates the kernel off everywhere (the serving
+    path then runs the pre-kernel XLA gather bit-for-bit); "interpret"
+    force-engages the emulated kernel off-TPU; per-head masks and
+    ragged GQA stay on the XLA path."""
+    from tensorlink_tpu.ops.pallas.paged_decode import paged_decode_ok
+
+    case = _paged_case(lives=[4])
+    q, k = case[0], case[1]
+    monkeypatch.setenv("TL_PAGED_KERNEL", "0")
+    assert not paged_decode_ok(q, k, interpret=True)
+    monkeypatch.setenv("TL_PAGED_KERNEL", "interpret")
+    assert paged_decode_ok(q, k)
+    # D=16 is not lane-aligned: real-TPU mode refuses, interpret allows
+    assert not paged_decode_ok(q, k, interpret=False) or (
+        jax.devices()[0].platform == "tpu" and q.shape[-1] % 128 == 0
+    )
+    bad_mask = jnp.ones((2, 4, 1, 16), bool)  # per-head mask
+    assert not paged_decode_ok(q, k, mask=bad_mask, interpret=True)
+
+
+def test_paged_override_roundtrip_and_validation():
+    """set/clear/snapshot mirror the flash-block override discipline;
+    resolution prefers exact (max_blocks, block_size) over agnostic,
+    then the LANES//bs heuristic."""
+    from tensorlink_tpu.ops.pallas.paged_decode import (
+        clear_paged_block_overrides,
+        paged_block_overrides,
+        paged_pages_for,
+        set_paged_block_override,
+    )
+
+    clear_paged_block_overrides()
+    try:
+        assert paged_pages_for(16, 8) == 16  # heuristic: LANES//8 capped
+        assert paged_pages_for(4, 64) == 2
+        set_paged_block_override(16, 4)
+        set_paged_block_override(16, 2, block_size=8)
+        assert paged_block_overrides() == [(16, None, 4), (16, 8, 2)]
+        # idempotent re-set: same value, no retrace churn
+        set_paged_block_override(16, 4)
+        assert paged_block_overrides() == [(16, None, 4), (16, 8, 2)]
+        assert paged_pages_for(16, 8) == 2   # exact wins
+        assert paged_pages_for(16, 16) == 4  # agnostic next
+        with pytest.raises(ValueError, match="outside"):
+            set_paged_block_override(8, 9)
+        with pytest.raises(ValueError, match="outside"):
+            set_paged_block_override(8, 0)
+    finally:
+        clear_paged_block_overrides()
+    assert paged_pages_for(16, 8) == 16

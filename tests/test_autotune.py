@@ -291,3 +291,109 @@ def test_worker_loads_chip_global_record(tmp_path):
         assert w.save_autotune() is not None  # round-trips its own view
     finally:
         clear_flash_block_overrides()
+
+
+# ------------------------------------------ paged-kernel block persistence
+_PAGED_PROC_SCRIPT = """
+import hashlib, json, sys
+import jax, jax.numpy as jnp, numpy as np
+from tensorlink_tpu.config import MeshConfig
+from tensorlink_tpu.models.llama import Llama, LlamaConfig
+from tensorlink_tpu.ops.pallas.paged_decode import (
+    paged_block_overrides, paged_pages_for,
+)
+from tensorlink_tpu.parallel.inference import GenerationConfig, InferenceEngine
+from tensorlink_tpu.parallel.serving import PagedContinuousBatchingEngine
+from tensorlink_tpu.runtime.mesh import make_mesh
+
+mode, tune_dir = sys.argv[1], sys.argv[2]
+cfg = LlamaConfig.tiny()
+m = Llama(cfg)
+p = m.init(jax.random.key(0))
+eng = InferenceEngine(
+    make_mesh(MeshConfig()), m, p, max_len=32,
+    cache_dtype=jnp.float32, param_dtype=jnp.float32,
+)
+if mode == "measure":
+    # "measure": the paged-grid sweep this process pays for once
+    from tensorlink_tpu.ops.pallas.paged_decode import (
+        set_paged_block_override,
+    )
+    set_paged_block_override(8, 2, block_size=4)
+    set_paged_block_override(16, 4)
+sch = PagedContinuousBatchingEngine(
+    eng, slots=2, gen=GenerationConfig(max_new_tokens=6),
+    decode_chunk=2, block_size=4, prefill_chunk=8, autotune_dir=tune_dir,
+)
+r = np.random.default_rng(0)
+for i in range(3):
+    sch.result(sch.submit(r.integers(0, cfg.vocab_size, (4 + i,))))
+if mode == "measure":
+    path = sch.save_autotune()
+else:
+    path = str(sch._autotune.path(sch._autotune_key))
+blob = open(path, "rb").read()
+print(json.dumps({
+    "path": path,
+    "sha": hashlib.sha256(blob).hexdigest(),
+    "warm_start_s": sch.autotune_warm_start_s,
+    "pages_8_4": paged_pages_for(8, 4),
+    "pages_16_any": paged_pages_for(16, 2),
+    "overrides": [list(t) for t in paged_block_overrides()],
+    "record": json.loads(blob),
+}))
+"""
+
+
+def _run_paged_proc(mode: str, tune_dir: str) -> dict:
+    out = subprocess.run(
+        [sys.executable, "-c", _PAGED_PROC_SCRIPT, mode, tune_dir],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def test_paged_two_process_restart_round_trips_tuning(tmp_path):
+    """ISSUE-20 acceptance: process A measures paged-kernel block
+    choices (exact and block-size-agnostic) and persists them under the
+    same fingerprint key; process B warm-starts with the overrides live
+    before any trace — no set_paged_block_override call, store bytes
+    byte-identical to what A wrote."""
+    d = str(tmp_path / "tune")
+    a = _run_paged_proc("measure", d)
+    assert a["warm_start_s"] is None  # cold start: nothing to load
+    assert sorted(a["record"]["paged_kernel"]) == sorted(
+        [[8, 4, 2], [16, None, 4]]
+    )
+    assert a["pages_8_4"] == 2 and a["pages_16_any"] == 4
+    b = _run_paged_proc("load", d)
+    # B warm-started: overrides installed from the record alone
+    assert b["warm_start_s"] is not None
+    assert b["pages_8_4"] == 2
+    assert b["pages_16_any"] == 4
+    assert [8, 4, 2] in b["overrides"] and [16, None, 4] in b["overrides"]
+    assert b["sha"] == a["sha"]
+
+
+def test_apply_paged_overrides_skips_malformed_rows():
+    """Record rows from older/corrupt stores must skip, never crash:
+    loading tuning is telemetry-grade."""
+    from tensorlink_tpu.ops.pallas.paged_decode import (
+        clear_paged_block_overrides,
+        paged_block_overrides,
+    )
+    from tensorlink_tpu.runtime.autotune import apply_paged_overrides
+
+    clear_paged_block_overrides()
+    try:
+        applied = apply_paged_overrides({"paged_kernel": [
+            [8, None, 2],        # good
+            [4, 2, 9],           # pages > max_blocks: ValueError, skipped
+            ["x", None, 1],      # junk types, skipped
+            [1, 2],              # wrong arity, skipped
+        ]})
+        assert applied == 1
+        assert paged_block_overrides() == [(8, None, 2)]
+    finally:
+        clear_paged_block_overrides()

@@ -843,9 +843,11 @@ def test_bench_diff_devtime_key_directions():
 
 def test_bench_diff_disagg_key_directions():
     """Disaggregated-serving keys: the vs-colocated ratio is
-    higher-better, the per-leg TTFT decomposition is lower-better, and
-    the wire-byte keys are deliberately directionless (payload size is
-    a property of the workload, not a regression axis)."""
+    higher-better, the per-leg TTFT decomposition is lower-better, the
+    wire-byte TOTAL is deliberately directionless (payload size scales
+    with the workload) — but per-token wire bytes became lower-better
+    with ISSUE 20: at fixed traffic, int8 pools exist to shrink them,
+    and a diff must flag them creeping back up."""
     old = {"metric": "x", "serving_disagg_vs_colocated": 1.2,
            "disagg_ttft_transfer_s": 0.010,
            "disagg_ttft_prefill_s": 0.020,
@@ -858,9 +860,46 @@ def test_bench_diff_disagg_key_directions():
     assert "serving_disagg_vs_colocated" in d["regressions"]
     assert "disagg_ttft_transfer_s" in d["regressions"]
     assert "disagg_ttft_prefill_s" in d["improvements"]
-    for k in ("kv_wire_bytes_total", "kv_wire_bytes_per_token"):
-        assert d["keys"][k]["direction"] is None
-        assert k not in d["regressions"]
+    assert d["keys"]["kv_wire_bytes_total"]["direction"] is None
+    assert "kv_wire_bytes_total" not in d["regressions"]
+    assert d["keys"]["kv_wire_bytes_per_token"]["direction"] == "lower"
+    assert "kv_wire_bytes_per_token" in d["regressions"]
+
+
+def test_bench_diff_paged_kernel_int8_key_directions():
+    """ISSUE-20 keys: KV footprint ratios and per-token wire bytes are
+    lower-better (the int8 win), decode MBU on either paged path and
+    the kernel-vs-XLA tokens/sec ratio are higher-better, and the
+    parity pin carries no direction worth diffing — but a footprint
+    'improvement' verdict on a RISING ratio would bless a quantization
+    regression, which is exactly what these entries prevent."""
+    old = {
+        "kv_footprint_vs_contiguous": 0.40,
+        "kv_footprint_vs_contiguous_int8": 0.20,
+        "kv_wire_bytes_per_token_int8": 20.0,
+        "decode_mbu_paged_xla": 0.50,
+        "decode_mbu_paged_kernel": 0.60,
+        "paged_kernel_vs_xla_tokens_per_sec": 1.2,
+    }
+    new = {
+        "kv_footprint_vs_contiguous": 0.30,         # -25% -> improvement
+        "kv_footprint_vs_contiguous_int8": 0.30,    # +50% -> regression
+        "kv_wire_bytes_per_token_int8": 40.0,       # doubled -> regression
+        "decode_mbu_paged_xla": 0.40,               # -20% -> regression
+        "decode_mbu_paged_kernel": 0.75,            # +25% -> improvement
+        "paged_kernel_vs_xla_tokens_per_sec": 1.5,  # +25% -> improvement
+    }
+    d = bench_diff(old, new, threshold=0.05)
+    assert set(d["regressions"]) == {
+        "kv_footprint_vs_contiguous_int8",
+        "kv_wire_bytes_per_token_int8",
+        "decode_mbu_paged_xla",
+    }
+    assert set(d["improvements"]) == {
+        "kv_footprint_vs_contiguous",
+        "decode_mbu_paged_kernel",
+        "paged_kernel_vs_xla_tokens_per_sec",
+    }
 
 
 def _disagg_scrape(serving, capability=None):

@@ -515,6 +515,14 @@ def test_canonical_bf16_programs_have_no_f32_dot(canonical_audit):
     checked = 0
     for a in audits:
         if a.dtype == "bfloat16":
+            if a.group == "paged_kernel":
+                # the interpret-mode lowering inlines the Pallas
+                # kernel's f32 online-softmax accumulator as visible
+                # f32 dots (by design — on TPU they live inside the
+                # fused custom call); TLH103 pins the exact count in
+                # the manifest instead
+                assert a.stable.f32_dot > 0, a.name
+                continue
             assert a.stable.f32_dot == 0, a.name
             checked += 1
     assert checked >= 7

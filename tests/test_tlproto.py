@@ -405,6 +405,7 @@ def test_committed_manifest_covers_protocol():
     for frame, rec in manifest["frames"].items():
         assert set(rec) >= {"fields", "senders", "handlers"}, frame
     assert manifest["versions"]["KV_WIRE_SCHEMA"] == 1
+    assert manifest["versions"]["KV_WIRE_INT8_SCHEMA"] == 2
     assert manifest["versions"]["TS_DELTA_SCHEMA"] == 1
 
 
